@@ -1,0 +1,55 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet's capabilities.
+
+Built from scratch on JAX/XLA (compute) for TPU hardware; see SURVEY.md for
+the map from the reference (`sxjscience/mxnet`) to this design.  Import as::
+
+    import mxnet_tpu as mx
+    x = mx.np.ones((2, 3), ctx=mx.tpu())
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# MXNet float32 ops compute in true float32 (CUDA/MKL kernels); XLA's
+# "fastest" default would silently downcast matmul/conv inputs to bf16 on
+# TPU.  Half-precision speed is opt-in via bf16 arrays / amp, as in the
+# reference (float32 lowers to the MXU's 3-pass f32 path).
+_jax.config.update("jax_default_matmul_precision", "float32")
+
+from .base import MXNetError
+from .context import (
+    Context, cpu, gpu, tpu, cpu_pinned, cpu_shared,
+    num_gpus, num_tpus, current_context, current_device,
+)
+from .ndarray.ndarray import NDArray, waitall
+from . import ndarray
+from . import ndarray as nd
+from . import numpy  # noqa: F401
+from . import numpy as np  # the mx.np namespace (shadows stdlib-style import on purpose)
+from . import numpy_extension as npx
+from . import autograd
+from . import random
+from . import util
+from .util import set_np, reset_np, is_np_array, use_np
+
+from . import initializer
+from . import init  # alias module
+from . import optimizer
+from . import lr_scheduler
+from . import kvstore as kv
+from . import kvstore
+from . import gluon
+from . import parallel
+from . import amp
+from . import profiler
+from .runtime import Features, feature_list
+from . import test_utils
+
+__all__ = [
+    "MXNetError", "Context", "cpu", "gpu", "tpu", "NDArray", "nd", "np",
+    "npx", "autograd", "random", "gluon", "optimizer", "kvstore", "kv",
+    "initializer", "init", "lr_scheduler", "parallel", "amp", "profiler",
+    "waitall", "current_context", "num_gpus", "num_tpus", "test_utils",
+]

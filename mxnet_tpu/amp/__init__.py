@@ -1,0 +1,113 @@
+"""Automatic mixed precision.
+
+Reference: `python/mxnet/amp/amp.py` (`init()` monkey-patches op namespaces
+to insert casts per curated fp16/bf16 lists, `amp.py:98,310`) plus
+`LossScaler` dynamic scaling (`amp/loss_scaler.py:26`).
+
+TPU-native design: the MXU is bf16-native, so the default target dtype is
+bfloat16 and **no loss scaling is required** (bf16 keeps f32's exponent
+range); `LossScaler` is kept API-compatible and is a no-op for bf16, dynamic
+for float16.  `init()` patches the compute-heavy ops (conv / FC / matmul
+family — the reference's FP16_FUNCS list) to cast float32 array inputs down;
+reductions and normalizations stay f32 (reference's FP32 list), which matches
+the `preferred_element_type=f32` accumulation in `ops/nn.py`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..ndarray.ndarray import NDArray
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "convert_hybrid_block", "LossScaler",
+           "scale_loss", "unscale"]
+
+_initialized = False
+_target_dtype = None
+
+# reference: python/mxnet/amp/lists/symbol_fp16.py FP16_FUNCS (the
+# matmul/conv family that is numerically safe in half precision)
+_CAST_FUNCS = [
+    ("numpy_extension", ["convolution", "deconvolution", "fully_connected",
+                         "batch_dot"]),
+    ("numpy", ["matmul", "dot", "einsum", "tensordot", "inner", "outer"]),
+]
+
+
+def init(target_dtype="bfloat16"):
+    """Patch compute ops to run in ``target_dtype`` (reference `amp.py:98`)."""
+    global _initialized, _target_dtype
+    if _initialized:
+        return
+    target = jnp.bfloat16 if str(target_dtype) in ("bfloat16", "bf16") \
+        else onp.float16
+    _target_dtype = target
+
+    import importlib
+
+    for mod_name, names in _CAST_FUNCS:
+        mod = importlib.import_module(f"mxnet_tpu.{mod_name}")
+        for name in names:
+            orig = getattr(mod, name, None)
+            if orig is None:
+                continue
+            setattr(mod, name, _wrap_cast(orig, target))
+    _initialized = True
+
+
+def _wrap_cast(fn, target):
+    def wrapped(*args, **kwargs):
+        cast_args = tuple(
+            a.astype(target) if isinstance(a, NDArray) and
+            a.dtype == onp.float32 else a
+            for a in args)
+        out = fn(*cast_args, **kwargs)
+        return out
+
+    wrapped.__name__ = getattr(fn, "__name__", "amp_op")
+    wrapped._amp_wrapped = fn
+    return wrapped
+
+
+def init_trainer(trainer):
+    """Attach a loss scaler to the trainer (reference `amp.py` init_trainer)."""
+    trainer._amp_loss_scaler = LossScaler(
+        dynamic=_target_dtype == onp.float16)
+    trainer._amp_original_scale = trainer._scale
+    return trainer
+
+
+class scale_loss:
+    """``with amp.scale_loss(loss, trainer) as scaled:`` (reference API)."""
+
+    def __init__(self, loss, trainer):
+        self.loss = loss
+        self.trainer = trainer
+
+    def __enter__(self):
+        scaler = getattr(self.trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            return self.loss
+        self.trainer._scale = self.trainer._amp_original_scale / scaler.loss_scale
+        if isinstance(self.loss, (list, tuple)):
+            return [l * scaler.loss_scale for l in self.loss]
+        return self.loss * scaler.loss_scale
+
+    def __exit__(self, *_exc):
+        return False
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is not None:
+        trainer._scale = trainer._amp_original_scale
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", **_kwargs):
+    """Cast a block's params to the target dtype (the graph-conversion pass
+    of the reference, `amp.py:672`, collapses to a dtype cast under XLA —
+    the compiler re-fuses everything)."""
+    target = "bfloat16" if str(target_dtype) in ("bfloat16", "bf16") else "float16"
+    block.cast(target)
+    return block

@@ -1,0 +1,41 @@
+"""Dynamic loss scaling (reference: `python/mxnet/amp/loss_scaler.py:26`).
+
+Needed only for float16; bf16 (the TPU default) keeps f32's exponent range,
+so the scaler initializes to 1.0 and stays there.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+
+class LossScaler:
+    def __init__(self, dynamic=True, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = init_scale if dynamic else 1.0
+        self._dynamic = dynamic
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """Check grads for inf/nan (reference checks via multi_all_finite)."""
+        if not self._dynamic:
+            return False
+        for p in params:
+            for g in p.list_grad():
+                a = g.asnumpy()
+                if not onp.isfinite(a).all():
+                    return True
+        return False
+
+    def update_scale(self, overflow):
+        if not self._dynamic:
+            return
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

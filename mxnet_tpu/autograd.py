@@ -1,0 +1,157 @@
+"""Autograd user API.
+
+Reference: `python/mxnet/autograd.py` (record/pause scopes :92-180,
+mark_variables :196, backward :245, grad :272, custom Function :369).
+The tape itself lives in `ops/invoke.py`; this module provides the scoping
+API with identical semantics (recording and train-mode are separate
+thread-local flags, as in `src/imperative/imperative.cc:40-41`).
+"""
+from __future__ import annotations
+
+from .ops import invoke as _iv
+from .ndarray.ndarray import NDArray
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "set_recording",
+    "set_training",
+    "Function",
+]
+
+is_recording = _iv.is_recording
+is_training = _iv.is_training
+set_recording = _iv.set_recording
+set_training = _iv.set_training
+
+
+class _RecordingStateScope:
+    """Reference: `_RecordingStateScope`, `python/mxnet/autograd.py:34-66`."""
+
+    def __init__(self, is_record, train_mode):
+        self._enter_record = is_record
+        self._enter_train = train_mode
+        self._prev_record = None
+        self._prev_train = None
+
+    def __enter__(self):
+        if self._enter_record is not None:
+            self._prev_record = _iv.set_recording(self._enter_record)
+        if self._enter_train is not None:
+            self._prev_train = _iv.set_training(self._enter_train)
+        return self
+
+    def __exit__(self, *_exc):
+        if self._enter_record is not None:
+            _iv.set_recording(self._prev_record)
+        if self._enter_train is not None:
+            _iv.set_training(self._prev_train)
+
+
+def record(train_mode=True):
+    """Scope enabling tape recording (and train mode by default)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (reference `autograd.py:196`)."""
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._node = None
+        v._grad = g
+        v._grad_req = req
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             create_graph=False):
+    _iv.backward(heads, head_grads, retain_graph=retain_graph,
+                 create_graph=create_graph)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False):
+    return _iv.grad(heads, variables, head_grads=head_grads,
+                    retain_graph=retain_graph, create_graph=create_graph)
+
+
+class Function:
+    """Custom differentiable function (reference `autograd.py:369-519`).
+
+    Subclass and implement ``forward`` and ``backward``; both receive/return
+    NDArrays.  The backward is recorded as an opaque tape node.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def backward(self, *output_grads):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if single else list(outputs)
+
+        if _iv.is_recording() and any(
+            isinstance(i, NDArray) and _iv._attached(i) for i in inputs
+        ):
+            nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
+
+            func = self
+
+            class _CustomVjp:
+                def __call__(self, cotangents):
+                    cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                    ct_nd = [NDArray(c) for c in cts]
+                    with pause():
+                        in_grads = func.backward(*ct_nd)
+                    if not isinstance(in_grads, (list, tuple)):
+                        in_grads = [in_grads]
+                    return tuple(g._data if isinstance(g, NDArray) else g
+                                 for g in in_grads)
+
+            import jax as _jax
+            node = _iv.Node(
+                type(self).__name__,
+                _CustomVjp(),
+                [(a, a._node, a._node_idx) for a in nd_inputs],
+                [_jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_list],
+            )
+            for idx, o in enumerate(out_list):
+                o._node = node
+                o._node_idx = idx
+        return out_list[0] if single else tuple(out_list)

@@ -1,0 +1,106 @@
+"""Foundational helpers shared across the framework.
+
+The reference keeps its foundation in dmlc-core (`3rdparty/dmlc-core`) and
+`python/mxnet/base.py` (ctypes loader, registry helpers).  In the TPU-native
+rebuild there is no `libmxnet.so` to dlopen -- JAX/XLA is the native substrate --
+so this module only carries the pure-python pieces: error types, the string
+registry (the analogue of dmlc's registry used by optimizers / initializers /
+kvstores), and dtype utilities.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = [
+    "MXNetError",
+    "classproperty",
+    "registry",
+    "numeric_types",
+    "integer_types",
+    "string_types",
+]
+
+
+class MXNetError(RuntimeError):
+    """Root error type (reference: `python/mxnet/error.py`)."""
+
+
+numeric_types = (float, int, onp.generic)
+integer_types = (int, onp.integer)
+string_types = (str,)
+
+
+class classproperty:  # noqa: N801 - mirrors the reference helper name
+    def __init__(self, fget):
+        self.fget = fget
+
+    def __get__(self, _obj, owner):
+        return self.fget(owner)
+
+
+class _Registry:
+    """String-keyed class registry.
+
+    The analogue of dmlc-core's ``Registry<T>`` that the reference uses for
+    optimizers (`python/mxnet/optimizer/optimizer.py:29`), initializers and
+    kvstores (`python/mxnet/kvstore/base.py:74`).
+    """
+
+    def __init__(self, name):
+        self.name = name
+        self._entries = {}
+
+    def register(self, klass, name=None):
+        key = (name or klass.__name__).lower()
+        self._entries[key] = klass
+        return klass
+
+    def get(self, name):
+        key = name.lower()
+        if key not in self._entries:
+            raise ValueError(
+                f"Cannot find {self.name} '{name}'. Registered: {sorted(self._entries)}"
+            )
+        return self._entries[key]
+
+    def find(self, name):
+        return self._entries.get(name.lower())
+
+    def entries(self):
+        return dict(self._entries)
+
+    def create(self, name, *args, **kwargs):
+        return self.get(name)(*args, **kwargs)
+
+
+class registry:  # noqa: N801 - namespace, mirrors mx.registry
+    _registries = {}
+
+    @staticmethod
+    def get_registry(name):
+        if name not in registry._registries:
+            registry._registries[name] = _Registry(name)
+        return registry._registries[name]
+
+    @staticmethod
+    def get_register_func(base_class, nickname):
+        reg = registry.get_registry(nickname)
+
+        def register(klass, name=None):
+            assert issubclass(klass, base_class), (
+                f"Can only register subclass of {base_class.__name__}"
+            )
+            return reg.register(klass, name)
+
+        return register
+
+    @staticmethod
+    def get_create_func(base_class, nickname):
+        reg = registry.get_registry(nickname)
+
+        def create(name, *args, **kwargs):
+            if isinstance(name, base_class):
+                return name
+            return reg.create(name, *args, **kwargs)
+
+        return create

@@ -1,0 +1,16 @@
+"""Gluon (reference: `python/mxnet/gluon/`)."""
+from .parameter import Parameter, Constant
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import metric
+from . import data
+from . import model_zoo
+from . import utils
+from .utils import split_and_load, clip_global_norm
+
+__all__ = ["Parameter", "Constant", "Block", "HybridBlock", "SymbolBlock",
+           "Trainer", "nn", "rnn", "loss", "metric", "data", "model_zoo",
+           "utils", "split_and_load", "clip_global_norm"]

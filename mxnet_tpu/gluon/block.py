@@ -1,0 +1,399 @@
+"""Gluon Block / HybridBlock.
+
+Reference: `python/mxnet/gluon/block.py` — `Block` (:202, child registry,
+param collection, hooks, save/load), `HybridBlock` (:860, deferred-compute
+tracing `_build_cache`:994 → CachedOp:1085).
+
+TPU-native design: ``hybridize()`` does not build an nnvm CachedOp — it
+wraps a *functional* forward (parameters passed as arguments, param access
+redirected through a trace-scope override) in ``jax.jit``:
+
+* shape-keyed recompilation = the reference's per-signature
+  `SetForwardGraph` re-inference (`cached_op.cc:168-234`);
+* XLA fusion/memory planning = `MXPlanMemory` + pointwise fusion for free;
+* under ``autograd.record`` the whole compiled program becomes ONE tape node
+  via `jax.vjp` — forward is one XLA executable, backward another (the
+  CachedOp backward graph equivalent);
+* randomness: a fresh PRNG key is an *argument* per call (no baked-in
+  constants), threaded to dropout etc. through `random.key_stream_scope`;
+* BatchNorm moving stats: traced updates are extra outputs written back
+  after execution (`ops/aux_scope.py`) — the engine-write-var analogue.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray
+from ..ops.invoke import invoke, is_recording, is_training, set_recording, set_training
+from ..ops.aux_scope import aux_update_scope
+from .. import initializer as _initializer
+from .. import random as _rng
+from .parameter import Parameter, DeferredInitializationError, _param_override_scope
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+def _is_nd(x):
+    return isinstance(x, NDArray)
+
+
+class Block:
+    """Base building block (reference `block.py:202`)."""
+
+    def __init__(self):
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    # -- attribute registration (reference `__setattr__`, block.py) -------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            existing = self.__dict__.get("_reg_params")
+            if existing is not None:
+                existing[name] = value
+        super().__setattr__(name, value)
+
+    # -- parameter collection ---------------------------------------------
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def collect_params(self, select=None):
+        ret = {}
+        for name, param in self._collect_params_with_prefix().items():
+            param._structure_name = name
+            if select is None or re.match(select, name):
+                ret[name] = param
+        return ret
+
+    @property
+    def params(self):
+        return dict(self._reg_params)
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = _initializer.Uniform()
+        params = self.collect_params()
+        for _name, param in params.items():
+            param.initialize(init=param.init, ctx=ctx, default_init=init,
+                             force_reinit=force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for param in self._reg_params.values():
+            if onp.dtype(param.dtype).kind == "f" or str(param.dtype) == "bfloat16":
+                param.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def reset_ctx(self, ctx):
+        for param in self.collect_params().values():
+            param.reset_ctx(ctx)
+
+    reset_device = reset_ctx
+
+    def zero_grad(self):
+        for param in self.collect_params().values():
+            param.zero_grad()
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return _HookHandle(self._forward_pre_hooks, hook)
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return _HookHandle(self._forward_hooks, hook)
+
+    # -- save / load (reference block.py:340,376) ---------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        params = self._collect_params_with_prefix()
+        arg_dict = {}
+        seen = {}
+        for name, param in params.items():
+            if param._data is None:
+                continue
+            arr = param.data()
+            if deduplicate and id(param) in seen:
+                continue
+            seen[id(param)] = name
+            arg_dict[name] = arr
+        from ..utils.serialization import save_ndarrays
+        save_ndarrays(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from ..utils.serialization import load_ndarrays
+        loaded = load_ndarrays(filename, ctx=ctx)
+        params = self._collect_params_with_prefix()
+        if not allow_missing:
+            for name in params:
+                if name not in loaded and params[name]._data is None and \
+                        params[name]._deferred_init is None:
+                    pass  # uninitialized + missing: will fail at use
+        for name, param in params.items():
+            if name not in loaded:
+                if not allow_missing:
+                    raise AssertionError(
+                        f"Parameter '{name}' is missing in '{filename}'")
+                continue
+            value = loaded[name]
+            if cast_dtype:
+                value = value.astype(param.dtype)
+            if ctx is not None:
+                param.reset_ctx(ctx)
+            param.set_data(value)
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise AssertionError(
+                    f"Parameters {sorted(extra)} in file '{filename}' are "
+                    "not present in this Block")
+
+    def load_dict(self, param_dict, ctx=None, allow_missing=False,
+                  ignore_extra=False, cast_dtype=False):
+        params = self._collect_params_with_prefix()
+        for name, param in params.items():
+            if name in param_dict:
+                param.set_data(param_dict[name])
+            elif not allow_missing:
+                raise AssertionError(f"Parameter '{name}' missing")
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a per-layer summary (reference block.py `summary`)."""
+        lines = []
+
+        def walk(block, prefix):
+            pcount = sum(int(onp.prod(p.shape)) for p in
+                         block._reg_params.values() if p._shape_known())
+            lines.append(f"{prefix}{type(block).__name__}: {pcount} params")
+            for name, child in block._children.items():
+                walk(child, prefix + "  ")
+
+        walk(self, "")
+        total = sum(int(onp.prod(p.shape)) for p in
+                    self.collect_params().values() if p._shape_known())
+        lines.append(f"Total params: {total}")
+        print("\n".join(lines))
+
+    def __repr__(self):
+        children = "\n".join(
+            f"  ({name}): {repr(child).splitlines()[0]}"
+            for name, child in self._children.items())
+        return f"{type(self).__name__}(\n{children}\n)" if children else \
+            f"{type(self).__name__}()"
+
+
+class _HookHandle:
+    def __init__(self, collection, hook):
+        self._collection = collection
+        self._hook = hook
+
+    def detach(self):
+        if self._hook in self._collection:
+            self._collection.remove(self._hook)
+
+
+class HybridBlock(Block):
+    """Block whose forward can be compiled to one XLA program
+    (reference `block.py:860`)."""
+
+    def __init__(self):
+        super().__init__()
+        self._active = False
+        self._jit_flags = {}
+        self._jit_cache = {}      # training-flag -> jitted functional
+        self._cached_param_list = None
+        self._aux_param_holder = []
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=None, backend=None, **kwargs):
+        """Compile the forward with XLA.  ``static_alloc``/``static_shape``
+        map to buffer donation / single-signature assumptions and are
+        accepted for compatibility (XLA plans memory either way,
+        `cached_op.h:413-432` in the reference)."""
+        self._active = active
+        self._jit_flags = dict(static_alloc=static_alloc,
+                               static_shape=static_shape)
+        self._clear_cached()
+        super().hybridize(active=False)  # children run inside this trace
+
+    def _clear_cached(self):
+        self._jit_cache = {}
+        self._cached_param_list = None
+
+    def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
+        """Reference `block.py:1142`: partition/optimize for a backend.  The
+        XLA analogue: hybridize + warm the jit cache with this input."""
+        self.hybridize(True)
+        out = self(x, *args)
+        if isinstance(out, NDArray):
+            out.wait_to_read()
+        return out
+
+    def cast(self, dtype):
+        self._clear_cached()
+        super().cast(dtype)
+
+    # -- deferred shape inference ------------------------------------------
+    def _ensure_shapes(self, *args):
+        params = self.collect_params()
+        pending = [p for p in params.values() if p._deferred_init is not None]
+        if not pending:
+            return
+        # one eager forward infers shapes & finishes deferred init
+        # (reference: deferred compute's shape inference, block.py:994)
+        prev_rec = set_recording(False)
+        try:
+            self.forward(*args)
+        finally:
+            set_recording(prev_rec)
+
+    # -- the compiled path --------------------------------------------------
+    def _build_functional(self, training):
+        block = self
+        holder = self._aux_param_holder
+
+        def functional(param_datas, key, flat_inputs, treedef_id):
+            # runs only at trace time (jit caches by shape after that)
+            params = block._cached_param_list
+            mapping = {}
+            for p, d in zip(params, param_datas):
+                nd = NDArray(d)
+                nd._param_ref = p
+                mapping[id(p)] = nd
+            treedef = _TREEDEFS[treedef_id]
+            wrapped = [NDArray(d) for d in flat_inputs]
+            args = jax.tree_util.tree_unflatten(treedef, wrapped)
+            prev_rec = set_recording(False)
+            prev_tr = set_training(training)
+            try:
+                with _param_override_scope(mapping), \
+                        _rng.key_stream_scope(key), \
+                        aux_update_scope() as aux:
+                    out = block.forward(*args)
+            finally:
+                set_recording(prev_rec)
+                set_training(prev_tr)
+            out_datas = jax.tree_util.tree_map(
+                lambda o: o._data if _is_nd(o) else o, out,
+                is_leaf=_is_nd)
+            holder.clear()
+            holder.extend(getattr(a, "_param_ref", None)
+                          for a, _v in aux.updates)
+            aux_datas = [v._data if _is_nd(v) else v for _a, v in aux.updates]
+            return out_datas, aux_datas
+
+        return jax.jit(functional, static_argnums=(3,))
+
+    def _call_cached(self, *args):
+        if self._cached_param_list is None:
+            self._ensure_shapes(*args)
+            params = self.collect_params()
+            self._cached_param_list = [params[k] for k in sorted(params)]
+        plist = self._cached_param_list
+        training = is_training()
+        jit_fn = self._jit_cache.get(training)
+        if jit_fn is None:
+            jit_fn = self._build_functional(training)
+            self._jit_cache[training] = jit_fn
+
+        flat, treedef = jax.tree_util.tree_flatten(args, is_leaf=_is_nd)
+        treedef_id = _intern_treedef(treedef)
+        param_nds = [p.data() for p in plist]
+        key = _rng.new_key()
+
+        out, aux_vals = invoke(
+            jit_fn, (param_nds, key, flat, treedef_id),
+            name=f"{type(self).__name__}.hybrid_forward")
+        # write deferred aux updates (BatchNorm moving stats) back
+        for p, v in zip(self._aux_param_holder, aux_vals):
+            if p is not None:
+                p.data()._rebind(v._data if _is_nd(v) else v)
+        return out
+
+    def __call__(self, *args, **kwargs):
+        if self._active and not kwargs:
+            for hook in self._forward_pre_hooks:
+                hook(self, args)
+            out = self._call_cached(*args)
+            for hook in self._forward_hooks:
+                hook(self, args, out)
+            return out
+        return super().__call__(*args, **kwargs)
+
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Serialize params for deployment (reference block.py:1300).  The
+        graph itself is XLA-compiled at load time; only params are stored."""
+        fname = f"{path}-{epoch:04d}.params"
+        self.save_parameters(fname)
+        return fname, None
+
+    def infer_shape(self, *args):
+        self._ensure_shapes(*args)
+
+
+# treedefs are hashable but not weak-refable; intern them for static_argnums
+_TREEDEFS = {}
+
+
+def _intern_treedef(td):
+    key = hash(td)
+    _TREEDEFS[key] = td
+    return key
+
+
+class SymbolBlock(HybridBlock):
+    """Reference `block.py:1500` — runs a serialized symbol graph.  The TPU
+    build has no symbol JSON format; model structure is python code.  Kept
+    as a loader for checkpoints saved by `HybridBlock.export`."""
+
+    def __init__(self, outputs=None, inputs=None, params=None):
+        raise NotImplementedError(
+            "symbol JSON graphs do not exist in the TPU build; instantiate "
+            "the python Block and use load_parameters() instead "
+            "(see HybridBlock.export)")
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        raise NotImplementedError(
+            "symbol JSON import is not supported; rebuild the Block in "
+            "python and call load_parameters()")

@@ -1,0 +1,372 @@
+"""Loss functions.
+
+Reference: `python/mxnet/gluon/loss.py` (15 loss classes).  Same weighting
+conventions: ``sample_weight`` multiplies per-element losses, ``batch_axis``
+is averaged last.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import numpy as mxnp
+from .. import numpy_extension as npx
+from ..ndarray.ndarray import NDArray
+from .block import HybridBlock
+
+__all__ = [
+    "Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
+    "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss", "KLDivLoss",
+    "CTCLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss", "LogisticLoss",
+    "TripletLoss", "PoissonNLLLoss", "CosineEmbeddingLoss", "SDMLLoss",
+]
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(pred, label):
+    if label.shape != pred.shape:
+        label = label.reshape(pred.shape)
+    return label
+
+
+def _batch_mean(loss, batch_axis):
+    axes = tuple(i for i in range(loss.ndim) if i != batch_axis)
+    if axes:
+        return mxnp.mean(loss, axis=axes)
+    return loss
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight, batch_axis):
+        super().__init__()
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_axis={self._batch_axis}, w={self._weight})"
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = mxnp.square(label - pred)
+        loss = _apply_weighting(loss, self._weight / 2, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = mxnp.abs(label - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        label = _reshape_like(pred, label)
+        if not self._from_sigmoid:
+            if pos_weight is None:
+                # stable: max(x,0) - x*z + log(1+exp(-|x|))
+                loss = npx.relu(pred) - pred * label + \
+                    mxnp.log(1.0 + mxnp.exp(-mxnp.abs(pred)))
+            else:
+                log_w = 1 + (pos_weight - 1) * label
+                loss = pred - pred * label + log_w * (
+                    mxnp.log(1.0 + mxnp.exp(-mxnp.abs(pred))) +
+                    npx.relu(-pred))
+        else:
+            eps = 1e-12
+            if pos_weight is None:
+                loss = -(mxnp.log(pred + eps) * label +
+                         mxnp.log(1.0 - pred + eps) * (1.0 - label))
+            else:
+                loss = -(mxnp.log(pred + eps) * label * pos_weight +
+                         mxnp.log(1.0 - pred + eps) * (1.0 - label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Reference loss.py SoftmaxCrossEntropyLoss (sparse_label picks the
+    label-class log-prob; axis is the class axis)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = npx.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -npx.pick(pred, label, axis=self._axis, keepdims=False)
+        else:
+            label = _reshape_like(pred, label)
+            loss = -mxnp.sum(pred * label, axis=self._axis)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = npx.log_softmax(pred, axis=self._axis)
+        loss = label * (mxnp.log(label + 1e-12) - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification (reference
+    `src/operator/nn/ctc_loss.cc`), computed with a `lax.scan` dynamic
+    program over the extended label sequence (blank-interleaved), in log
+    space — the XLA-native form of the reference's warp-ctc kernels."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        assert layout in ("NTC", "TNC")
+        assert label_layout in ("NT", "TN")
+        self._layout = layout
+        self._label_layout = label_layout
+        batch_axis = label_layout.find("N")
+        super().__init__(weight, batch_axis)
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        import jax
+        import jax.numpy as jnp
+        from ..ops.invoke import invoke
+
+        if self._layout == "NTC":
+            pred = pred.swapaxes(0, 1)  # -> (T, N, C)
+        if self._label_layout == "TN":
+            label = label.swapaxes(0, 1)  # -> (N, L)
+
+        def ctc(log_probs_tnc, labels_nl, in_len, lab_len):
+            t_max, n, c = log_probs_tnc.shape
+            l_max = labels_nl.shape[1]
+            blank = 0
+            logp = jax.nn.log_softmax(log_probs_tnc.astype(jnp.float32), axis=-1)
+            # extended labels: blank, l1, blank, l2, ..., blank (2L+1)
+            ext = jnp.full((n, 2 * l_max + 1), blank, jnp.int32)
+            ext = ext.at[:, 1::2].set(labels_nl.astype(jnp.int32))
+            s = 2 * l_max + 1
+            neg_inf = jnp.asarray(-1e30, jnp.float32)
+            # alpha init
+            alpha0 = jnp.full((n, s), neg_inf)
+            alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+            first_lab = jnp.take_along_axis(
+                logp[0], ext[:, 1:2], axis=1)[:, 0]
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.where(lab_len > 0, first_lab, neg_inf))
+
+            same_as_prev2 = jnp.concatenate(
+                [jnp.ones((n, 2), bool),
+                 ext[:, 2:] == ext[:, :-2]], axis=1)
+
+            def step(alpha, logp_t):
+                a_shift1 = jnp.concatenate(
+                    [jnp.full((n, 1), neg_inf), alpha[:, :-1]], axis=1)
+                a_shift2 = jnp.concatenate(
+                    [jnp.full((n, 2), neg_inf), alpha[:, :-2]], axis=1)
+                a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+                merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+                emit = jnp.take_along_axis(
+                    logp_t, jnp.clip(ext, 0, c - 1), axis=1)
+                return merged + emit, None
+
+            def scan_step(carry, inputs):
+                alpha, t = carry
+                logp_t = inputs
+                new_alpha, _ = step(alpha, logp_t)
+                # freeze past in_len
+                new_alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+                return (new_alpha, t + 1), None
+
+            (alpha, _), _ = jax.lax.scan(scan_step, (alpha0, jnp.ones((), jnp.int32)),
+                                         logp[1:])
+            end1 = 2 * lab_len.astype(jnp.int32)
+            end0 = jnp.maximum(end1 - 1, 0)
+            ll = jnp.logaddexp(
+                jnp.take_along_axis(alpha, end1[:, None], axis=1)[:, 0],
+                jnp.take_along_axis(alpha, end0[:, None], axis=1)[:, 0])
+            return -ll
+
+        t_max = pred.shape[0]
+        n = pred.shape[1]
+        if pred_lengths is None:
+            pred_lengths = mxnp.full((n,), t_max, dtype="int32")
+        if label_lengths is None:
+            label_lengths = mxnp.full((n,), label.shape[1], dtype="int32")
+        loss = invoke(ctc, (pred, label, pred_lengths, label_lengths),
+                      name="ctc_loss")
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = mxnp.abs(label - pred)
+        loss = mxnp.where(loss > self._rho,
+                          loss - 0.5 * self._rho,
+                          (0.5 / self._rho) * mxnp.square(loss))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = npx.relu(self._margin - pred * label)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = mxnp.square(npx.relu(self._margin - pred * label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, label_format="signed"):
+        super().__init__(weight, batch_axis)
+        self._label_format = label_format
+        if label_format not in ("signed", "binary"):
+            raise ValueError(f"bad label_format {label_format}")
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        if self._label_format == "signed":
+            label = (label + 1.0) / 2.0
+        loss = npx.relu(pred) - pred * label + \
+            mxnp.log(1.0 + mxnp.exp(-mxnp.abs(pred)))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _batch_mean(loss, self._batch_axis)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        positive = _reshape_like(pred, positive)
+        negative = _reshape_like(pred, negative)
+        axes = tuple(range(1, pred.ndim))
+        loss = mxnp.sum(mxnp.square(positive - pred) -
+                        mxnp.square(negative - pred), axis=axes)
+        loss = npx.relu(loss + self._margin)
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=1.0, from_logits=True, batch_axis=0,
+                 compute_full=False):
+        super().__init__(weight, batch_axis)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, target, sample_weight=None, epsilon=1e-08):
+        target = _reshape_like(pred, target)
+        if self._from_logits:
+            loss = mxnp.exp(pred) - target * pred
+        else:
+            loss = pred - target * mxnp.log(pred + epsilon)
+        if self._compute_full:
+            stirling = target * mxnp.log(target + 1e-12) - target + \
+                0.5 * mxnp.log(2 * onp.pi * (target + 1e-12))
+            stirling = mxnp.where(target <= 1, mxnp.zeros_like(stirling),
+                                  stirling)
+            loss = loss + stirling
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return mxnp.mean(loss)
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, margin=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        input1 = _reshape_like(input1, input2)
+        cos = mxnp.sum(input1 * input2, axis=-1) / (
+            mxnp.sqrt(mxnp.sum(mxnp.square(input1), axis=-1)) *
+            mxnp.sqrt(mxnp.sum(mxnp.square(input2), axis=-1)) + 1e-12)
+        label = label.reshape(cos.shape)
+        loss = mxnp.where(label == 1, 1.0 - cos,
+                          npx.relu(cos - self._margin))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (reference loss.py SDMLLoss)."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self.kl_loss = KLDivLoss(from_logits=True)
+        self.smoothing_parameter = smoothing_parameter
+
+    def forward(self, x1, x2):
+        batch_size = x1.shape[0]
+        labels = self._compute_labels(batch_size)
+        distances = self._compute_distances(x1, x2)
+        log_probabilities = npx.log_softmax(-distances, axis=1)
+        return self.kl_loss(log_probabilities, labels) * batch_size
+
+    def _compute_labels(self, batch_size):
+        gold = mxnp.eye(batch_size)
+        labels = gold * (1 - self.smoothing_parameter) + \
+            (1 - gold) * self.smoothing_parameter / (batch_size - 1)
+        return labels
+
+    def _compute_distances(self, x1, x2):
+        x1_ = mxnp.expand_dims(x1, 1).broadcast_to(
+            (x1.shape[0], x2.shape[0], x1.shape[1]))
+        x2_ = mxnp.expand_dims(x2, 0).broadcast_to(
+            (x1.shape[0], x2.shape[0], x2.shape[1]))
+        return mxnp.sum(mxnp.square(x1_ - x2_), axis=2)

@@ -1,0 +1,25 @@
+"""Vision model zoo (reference: `python/mxnet/gluon/model_zoo/vision/`)."""
+from .resnet import *  # noqa: F401,F403
+from .alexnet import *  # noqa: F401,F403
+from .vgg import *  # noqa: F401,F403
+from .resnet import __all__ as _resnet_all
+from .alexnet import __all__ as _alexnet_all
+from .vgg import __all__ as _vgg_all
+
+_models = {}
+for _name in _resnet_all + _alexnet_all + _vgg_all:
+    _obj = globals()[_name]
+    if callable(_obj) and _name[0].islower() and not _name.startswith("get_"):
+        _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    """Create a model by name (reference vision/__init__.py get_model)."""
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(
+            f"Model {name} is not supported. Available: {sorted(_models)}")
+    return _models[name](**kwargs)
+
+
+__all__ = list(_models) + ["get_model"]

@@ -1,0 +1,403 @@
+"""Basic neural network layers.
+
+Reference: `python/mxnet/gluon/nn/basic_layers.py` (Dense, Dropout,
+BatchNorm, LayerNorm/GroupNorm/InstanceNorm, Embedding, activations,
+Sequential...).  Each forward is written in mx ops, so it runs eagerly op-by
+-op or compiles to one XLA program under `hybridize()`.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ... import numpy as mxnp
+from ... import numpy_extension as npx
+from ...ndarray.ndarray import NDArray
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = [
+    "Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
+    "BatchNorm", "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+    "Flatten", "Lambda", "HybridLambda", "Identity", "Activation",
+    "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish", "SiLU",
+    "HybridConcatenate", "Concatenate",
+]
+
+
+class Sequential(Block):
+    """Sequential container (reference basic_layers.py Sequential)."""
+
+    def __init__(self):
+        super().__init__()
+        self._layers = []
+
+    def add(self, *blocks):
+        for block in blocks:
+            idx = len(self._layers)
+            self._layers.append(block)
+            setattr(self, str(idx), block)
+
+    def forward(self, x, *args):
+        for block in self._layers:
+            x = block(x, *args)
+            args = ()
+            if isinstance(x, (tuple, list)):
+                args = tuple(x[1:])
+                x = x[0]
+        if args:
+            return (x,) + args
+        return x
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            out = type(self)()
+            out.add(*self._layers[i])
+            return out
+        return self._layers[i]
+
+    def __len__(self):
+        return len(self._layers)
+
+    def __iter__(self):
+        return iter(self._layers)
+
+
+class HybridSequential(Sequential, HybridBlock):
+    def __init__(self):
+        HybridBlock.__init__(self)
+        self._layers = []
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (reference basic_layers.py Dense over
+    `src/operator/nn/fully_connected.cc`)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0):
+        super().__init__()
+        self._units = units
+        self._in_units = in_units
+        self._flatten = flatten
+        self._activation = activation
+        self.weight = Parameter("weight", shape=(units, in_units), dtype=dtype,
+                                init=_resolve_init(weight_initializer),
+                                allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(units,), dtype=dtype,
+                              init=_resolve_init(bias_initializer),
+                              allow_deferred_init=True) if use_bias else None
+        self.act = Activation(activation) if activation is not None else None
+
+    def forward(self, x):
+        if self.weight.shape[1] == 0:
+            in_units = int(onp.prod(x.shape[1:])) if self._flatten else x.shape[-1]
+            self.weight.shape = (self._units, in_units)
+            self.weight.finish_deferred_init()
+        if self.bias is not None and self.bias._data is None:
+            self.bias.finish_deferred_init()
+        out = npx.fully_connected(
+            x, self.weight.data(), None if self.bias is None else self.bias.data(),
+            num_hidden=self._units, flatten=self._flatten)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return (f"Dense({self._units}, linear)" if self.act is None else
+                f"Dense({self._units}, {self._activation})")
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        return npx.dropout(x, p=self._rate, axes=self._axes)
+
+
+class Embedding(HybridBlock):
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False):
+        super().__init__()
+        if sparse_grad:
+            raise NotImplementedError(
+                "sparse_grad embeddings are a row_sparse optimization for "
+                "CPU parameter servers; on TPU dense gather/scatter is the "
+                "fast path (SURVEY.md §7)")
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype,
+                                init=_resolve_init(weight_initializer))
+
+    def forward(self, x):
+        return npx.embedding(x, self.weight.data(), input_dim=self._input_dim,
+                             output_dim=self._output_dim)
+
+
+class BatchNorm(HybridBlock):
+    """Reference basic_layers.py BatchNorm over `src/operator/nn/batch_norm
+    .cc`; moving stats update via the deferred-aux protocol."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__()
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=_resolve_init(gamma_initializer),
+                               differentiable=scale,
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=_resolve_init(beta_initializer),
+                              differentiable=center,
+                              allow_deferred_init=True)
+        self.running_mean = Parameter(
+            "running_mean", shape=(in_channels,),
+            init=_resolve_init(running_mean_initializer),
+            differentiable=False, allow_deferred_init=True)
+        self.running_var = Parameter(
+            "running_var", shape=(in_channels,),
+            init=_resolve_init(running_variance_initializer),
+            differentiable=False, allow_deferred_init=True)
+
+    def forward(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            if not p._shape_known():
+                p.shape = (c,)
+            if p._data is None:
+                p.finish_deferred_init()
+        return npx.batch_norm(
+            x, self.gamma.data(), self.beta.data(), self.running_mean.data(),
+            self.running_var.data(), eps=self._epsilon,
+            momentum=self._momentum, fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Reference `contrib/nn/basic_layers.py` SyncBatchNorm: cross-device
+    batch stats.  Under SPMD jit the batch axis is globally sharded, so XLA
+    already computes global statistics — this is an alias with the
+    reference's signature."""
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        kwargs.pop("ndev", None)
+        super().__init__(in_channels=in_channels, **kwargs)
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=_resolve_init(gamma_initializer),
+                               differentiable=scale, allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=_resolve_init(beta_initializer),
+                              differentiable=center, allow_deferred_init=True)
+
+    def forward(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta):
+            if not p._shape_known():
+                p.shape = (c,)
+            if p._data is None:
+                p.finish_deferred_init()
+        return npx.layer_norm(x, self.gamma.data(), self.beta.data(),
+                              axis=self._axis, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=_resolve_init(gamma_initializer),
+                               differentiable=scale, allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=_resolve_init(beta_initializer),
+                              differentiable=center, allow_deferred_init=True)
+
+    def forward(self, x):
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if not p._shape_known():
+                p.shape = (c,)
+            if p._data is None:
+                p.finish_deferred_init()
+        return npx.group_norm(x, self.gamma.data(), self.beta.data(),
+                              num_groups=self._num_groups, eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=_resolve_init(gamma_initializer),
+                               differentiable=scale, allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=_resolve_init(beta_initializer),
+                              differentiable=center, allow_deferred_init=True)
+
+    def forward(self, x):
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if not p._shape_known():
+                p.shape = (c,)
+            if p._data is None:
+                p.finish_deferred_init()
+        return npx.instance_norm(x, self.gamma.data(), self.beta.data(),
+                                 eps=self._epsilon)
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return x.reshape((x.shape[0], -1))
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Lambda(Block):
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            function = getattr(mxnp, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            fn = getattr(mxnp, function, None) or getattr(npx, function)
+            function = fn
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation):
+        super().__init__()
+        self._act_type = activation
+
+    def forward(self, x):
+        return npx.activation(x, act_type=self._act_type)
+
+    def __repr__(self):
+        return f"Activation({self._act_type})"
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="leaky", slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=None, in_channels=1):
+        super().__init__()
+        from ...initializer import Constant
+        self.alpha = Parameter("alpha", shape=(in_channels,),
+                               init=_resolve_init(alpha_initializer) or
+                               Constant(0.25))
+
+    def forward(self, x):
+        return npx.leaky_relu(x, gamma=self.alpha.data(), act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf"):
+        super().__init__()
+        self._approx = approximation
+
+    def forward(self, x):
+        act = "gelu" if self._approx == "erf" else "gelu_tanh"
+        return npx.leaky_relu(x, act_type=act)
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0):
+        super().__init__()
+        self._beta = beta
+
+    def forward(self, x):
+        return x * npx.sigmoid(self._beta * x)
+
+
+SiLU = Swish
+
+
+class HybridConcatenate(HybridBlock):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+        self._layers = []
+
+    def add(self, *blocks):
+        for block in blocks:
+            idx = len(self._layers)
+            self._layers.append(block)
+            setattr(self, str(idx), block)
+
+    def forward(self, x):
+        return mxnp.concatenate([block(x) for block in self._layers],
+                                axis=self.axis)
+
+
+Concatenate = HybridConcatenate
+
+
+def _resolve_init(init):
+    from ... import initializer as I
+    if init is None or isinstance(init, I.Initializer):
+        return init
+    if isinstance(init, str):
+        return I.registry.get_registry("initializer").get(init)()
+    return init

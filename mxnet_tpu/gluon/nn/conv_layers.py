@@ -1,0 +1,276 @@
+"""Convolution and pooling layers.
+
+Reference: `python/mxnet/gluon/nn/conv_layers.py` over
+`src/operator/nn/convolution.cc` / `pooling.cc`.  Layout default is the
+reference's NCHW family; pass layout='NHWC' for the TPU-preferred layout
+(XLA re-lays out internally either way).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .basic_layers import Activation, _resolve_init
+
+__all__ = [
+    "Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+    "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D",
+    "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D", "GlobalMaxPool2D",
+    "GlobalMaxPool3D", "GlobalAvgPool1D", "GlobalAvgPool2D",
+    "GlobalAvgPool3D", "ReflectionPad2D",
+]
+
+
+def _pair(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", dtype="float32", ndim=2,
+                 transpose=False, output_padding=0):
+        super().__init__()
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = _pair(kernel_size, ndim)
+        self._strides = _pair(strides, ndim)
+        self._padding = _pair(padding, ndim)
+        self._dilation = _pair(dilation, ndim)
+        self._groups = groups
+        self._layout = layout
+        self._ndim = ndim
+        self._transpose = transpose
+        self._output_padding = _pair(output_padding, ndim)
+        if transpose:
+            wshape = (in_channels, channels // groups) + self._kernel
+        else:
+            wshape = (channels, in_channels // groups if in_channels else 0) \
+                + self._kernel
+        self.weight = Parameter("weight", shape=wshape, dtype=dtype,
+                                init=_resolve_init(weight_initializer),
+                                allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(channels,), dtype=dtype,
+                              init=_resolve_init(bias_initializer),
+                              allow_deferred_init=True) if use_bias else None
+        self.act = Activation(activation) if activation else None
+
+    def forward(self, x):
+        c_axis = self._layout.index("C")
+        in_c = x.shape[c_axis]
+        if self._transpose:
+            if self.weight.shape[0] == 0:
+                self.weight.shape = (in_c, self._channels // self._groups) + \
+                    self._kernel
+        else:
+            if self.weight.shape[1] == 0:
+                self.weight.shape = (self._channels, in_c // self._groups) + \
+                    self._kernel
+        if self.weight._data is None:
+            self.weight.finish_deferred_init()
+        if self.bias is not None and self.bias._data is None:
+            self.bias.finish_deferred_init()
+        bias = None if self.bias is None else self.bias.data()
+        if self._transpose:
+            out = npx.deconvolution(
+                x, self.weight.data(), bias, kernel=self._kernel,
+                stride=self._strides, dilate=self._dilation,
+                pad=self._padding, adj=self._output_padding,
+                num_filter=self._channels, num_group=self._groups,
+                layout=self._layout)
+        else:
+            out = npx.convolution(
+                x, self.weight.data(), bias, kernel=self._kernel,
+                stride=self._strides, dilate=self._dilation,
+                pad=self._padding, num_filter=self._channels,
+                num_group=self._groups, layout=self._layout)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._channels}, "
+                f"kernel_size={self._kernel}, stride={self._strides})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, dtype="float32"):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, dtype, ndim=1)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, dtype="float32"):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, dtype, ndim=2)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, dtype="float32"):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, dtype, ndim=3)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, dtype="float32"):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, dtype, ndim=1,
+                         transpose=True, output_padding=output_padding)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, dtype="float32"):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, dtype, ndim=2,
+                         transpose=True, output_padding=output_padding)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, dtype="float32"):
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, dtype, ndim=3,
+                         transpose=True, output_padding=output_padding)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, global_pool, pool_type,
+                 layout, count_include_pad=True, ndim=2):
+        super().__init__()
+        self._kernel = _pair(pool_size, ndim)
+        self._strides = _pair(strides if strides is not None else pool_size,
+                              ndim)
+        self._padding = _pair(padding, ndim)
+        self._global = global_pool
+        self._pool_type = pool_type
+        self._layout = layout
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x):
+        return npx.pooling(
+            x, kernel=self._kernel, pool_type=self._pool_type,
+            stride=self._strides, pad=self._padding,
+            global_pool=self._global,
+            count_include_pad=self._count_include_pad, layout=self._layout)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(size={self._kernel}, "
+                f"stride={self._strides}, padding={self._padding})")
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW"):
+        super().__init__(pool_size, strides, padding, False, "max", layout,
+                         ndim=1)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW"):
+        super().__init__(pool_size, strides, padding, False, "max", layout,
+                         ndim=2)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW"):
+        super().__init__(pool_size, strides, padding, False, "max", layout,
+                         ndim=3)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 count_include_pad=True):
+        super().__init__(pool_size, strides, padding, False, "avg", layout,
+                         count_include_pad, ndim=1)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", count_include_pad=True):
+        super().__init__(pool_size, strides, padding, False, "avg", layout,
+                         count_include_pad, ndim=2)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", count_include_pad=True):
+        super().__init__(pool_size, strides, padding, False, "avg", layout,
+                         count_include_pad, ndim=3)
+
+
+class GlobalMaxPool1D(_Pool):
+    def __init__(self, layout="NCW"):
+        super().__init__(1, None, 0, True, "max", layout, ndim=1)
+
+
+class GlobalMaxPool2D(_Pool):
+    def __init__(self, layout="NCHW"):
+        super().__init__(1, None, 0, True, "max", layout, ndim=2)
+
+
+class GlobalMaxPool3D(_Pool):
+    def __init__(self, layout="NCDHW"):
+        super().__init__(1, None, 0, True, "max", layout, ndim=3)
+
+
+class GlobalAvgPool1D(_Pool):
+    def __init__(self, layout="NCW"):
+        super().__init__(1, None, 0, True, "avg", layout, ndim=1)
+
+
+class GlobalAvgPool2D(_Pool):
+    def __init__(self, layout="NCHW"):
+        super().__init__(1, None, 0, True, "avg", layout, ndim=2)
+
+
+class GlobalAvgPool3D(_Pool):
+    def __init__(self, layout="NCDHW"):
+        super().__init__(1, None, 0, True, "avg", layout, ndim=3)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0):
+        super().__init__()
+        self._padding = _pair(padding, 2) if isinstance(padding, int) else \
+            tuple(padding)
+
+    def forward(self, x):
+        from ... import numpy as mxnp
+        p = self._padding
+        if len(p) == 2:
+            pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+        else:
+            pads = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3]))
+        return mxnp.pad(x, pads, mode="reflect")

@@ -1,0 +1,307 @@
+"""Gluon Parameter.
+
+Reference: `python/mxnet/gluon/parameter.py:47` — lazy shape-deferred init,
+per-context data/grad copies, grad_req, lr/wd multipliers.
+
+TPU-native notes: a parameter usually holds ONE jax.Array which may be
+*sharded or replicated over the whole mesh* (`parallel.shard_parameters`) —
+the SPMD generalization of the reference's per-GPU copy list.  The classic
+multi-context copy list is still supported for `split_and_load`-style data
+parallelism.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray
+from .. import initializer
+from ..ops.invoke import is_recording
+
+__all__ = ["Parameter", "Constant", "DeferredInitializationError", "tensor_types"]
+
+tensor_types = (NDArray,)
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization (reference
+    `parameter.py` same name)."""
+
+
+_trace_state = threading.local()
+
+
+def _overrides():
+    if not hasattr(_trace_state, "stack"):
+        _trace_state.stack = []
+    return _trace_state.stack
+
+
+class _param_override_scope:
+    """Maps Parameter -> tracer NDArray during a hybridize trace."""
+
+    def __init__(self, mapping):
+        self.mapping = mapping  # dict id(param) -> NDArray
+
+    def __enter__(self):
+        _overrides().append(self.mapping)
+        return self
+
+    def __exit__(self, *_exc):
+        _overrides().pop()
+
+
+class Parameter:
+    def __init__(self, name="weight", grad_req="write", shape=None,
+                 dtype=onp.float32, lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default"):
+        self._name = name
+        self._shape = tuple(shape) if isinstance(shape, (list, tuple)) else \
+            ((shape,) if isinstance(shape, int) else shape)
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = grad_req
+        if stype != "default" or grad_stype != "default":
+            raise NotImplementedError(
+                "sparse parameter storage is not supported on TPU "
+                "(SURVEY.md §7: XLA has no sparse buffers)")
+        self._data = None   # dict Context -> NDArray
+        self._grad = None
+        self._deferred_init = None  # (init, ctx_list, default_init)
+        self._structure_name = None  # set by Block registration
+
+    # -- naming -----------------------------------------------------------
+    @property
+    def name(self):
+        return self._structure_name or self._name
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self._shape}, dtype={self.dtype})"
+
+    # -- shape ------------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        assert len(self._shape) == len(new_shape) and all(
+            s == 0 or s == ns for s, ns in zip(self._shape, new_shape)), (
+            f"Expected shape {self._shape} is incompatible with given shape "
+            f"{new_shape} for Parameter {self.name}")
+        self._shape = tuple(new_shape)
+
+    def _shape_known(self):
+        return self._shape is not None and all(s > 0 for s in self._shape)
+
+    # -- grad_req ---------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._grad = None
+                for arr in self._data.values():
+                    arr._grad = None
+                    arr._grad_req = "null"
+            else:
+                self._init_grad()
+
+    # -- initialization ---------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        if default_init is None:
+            default_init = initializer.Uniform()
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        ctx = [Context(c) for c in ctx]
+        if not self._shape_known():
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise ValueError(
+                f"Cannot initialize Parameter {self.name} because it has "
+                f"invalid shape {self._shape}; use allow_deferred_init=True "
+                "or specify in_units/in_channels.")
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx_list, default_init):
+        self._deferred_init = None
+        data = {}
+        for c in ctx_list:
+            arr = NDArray(jnp.zeros(self._shape, self.dtype), ctx=c)
+            (init or self.init or default_init)(
+                initializer.InitDesc(self.name), arr)
+            data[c] = arr
+        self._data = data
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self):
+        self._grad = {}
+        for c, arr in self._data.items():
+            arr.attach_grad(self._grad_req)
+            self._grad[c] = arr.grad
+
+    def finish_deferred_init(self):
+        """Called by layers once the input shape is known."""
+        if self._deferred_init is None:
+            return
+        if not self._shape_known():
+            raise DeferredInitializationError(
+                f"Parameter {self.name} has unknown shape {self._shape}")
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    # -- access -----------------------------------------------------------
+    def _check_init(self):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} has not been initialized yet "
+                    "because initialization was deferred. Actual "
+                    "initialization happens during the first forward pass.")
+            raise RuntimeError(
+                f"Parameter {self.name} has not been initialized. You "
+                "should initialize parameters with Block.initialize().")
+
+    def data(self, ctx=None):
+        # hybridize-trace override takes precedence
+        for mapping in reversed(_overrides()):
+            hit = mapping.get(id(self))
+            if hit is not None:
+                return hit
+        self._check_init()
+        if ctx is None:
+            if len(self._data) == 1:
+                return next(iter(self._data.values()))
+            ctx = current_context()
+        ctx = Context(ctx)
+        if ctx not in self._data:
+            raise RuntimeError(
+                f"Parameter {self.name} was not initialized on context {ctx}; "
+                f"it lives on {list(self._data)}.")
+        return self._data[ctx]
+
+    def list_data(self):
+        self._check_init()
+        return list(self._data.values())
+
+    def grad(self, ctx=None):
+        self._check_init()
+        if self._grad is None:
+            raise RuntimeError(
+                f"Cannot get gradient array for Parameter {self.name} "
+                "because grad_req='null'")
+        if ctx is None:
+            if len(self._grad) == 1:
+                return next(iter(self._grad.values()))
+            ctx = current_context()
+        return self._grad[Context(ctx)]
+
+    def list_grad(self):
+        self._check_init()
+        if self._grad is None:
+            return []
+        return list(self._grad.values())
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init is not None:
+            return self._deferred_init[1]
+        self._check_init()
+        return list(self._data.keys())
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            if self._deferred_init is not None:
+                init, ctx, default_init = self._deferred_init
+                self._finish_init(init, ctx, default_init)
+            else:
+                self._data = {}
+                c = data.ctx if isinstance(data, NDArray) else current_context()
+                self._data[c] = NDArray(jnp.zeros(self._shape, self.dtype), ctx=c)
+                if self._grad_req != "null":
+                    self._init_grad()
+        src = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        for c, arr in self._data.items():
+            import jax as _jax
+            arr._rebind(_jax.device_put(src.astype(arr.dtype), c.jax_device()))
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        for g in self._grad.values():
+            g._rebind(jnp.zeros(g.shape, g.dtype))
+
+    def reset_ctx(self, ctx):
+        ctx = [Context(c) for c in (ctx if isinstance(ctx, (list, tuple)) else [ctx])]
+        if self._data is not None:
+            src = next(iter(self._data.values()))
+            self._data = {c: src.as_in_ctx(c).copy() if c not in self._data
+                          else self._data[c] for c in ctx}
+            self._data = {c: v for c, v in self._data.items() if c in ctx}
+            if self._grad_req != "null":
+                self._init_grad()
+        elif self._deferred_init is not None:
+            init, _old, default_init = self._deferred_init
+            self._deferred_init = (init, ctx, default_init)
+
+    reset_device = reset_ctx
+
+    def cast(self, dtype):
+        self.dtype = onp.dtype(dtype) if not isinstance(dtype, type(jnp.bfloat16)) else dtype
+        if self._data is None:
+            return
+        for arr in self._data.values():
+            arr._rebind(arr._data.astype(dtype))
+        if self._grad is not None:
+            self._init_grad()
+
+    @property
+    def stype(self):
+        return "default"
+
+    def var(self):
+        raise NotImplementedError(
+            "symbol variables do not exist in the TPU build; hybridize "
+            "traces directly to XLA")
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (reference `parameter.py:708`)."""
+
+    def __init__(self, value, name="const"):
+        if not isinstance(value, NDArray):
+            value = NDArray(onp.asarray(value))
+        self._value = value
+        super().__init__(name=name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=initializer.Constant(value))

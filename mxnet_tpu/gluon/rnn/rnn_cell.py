@@ -1,0 +1,320 @@
+"""RNN cells — imperatively steppable building blocks.
+
+Reference: `python/mxnet/gluon/rnn/rnn_cell.py` (RNNCell/LSTMCell/GRUCell +
+modifier cells).  `unroll` uses a python loop of mx ops; wrap the enclosing
+block in `hybridize()` to compile the unrolled graph, or prefer the fused
+`rnn.LSTM`-style layers (lax.scan) for long sequences.
+"""
+from __future__ import annotations
+
+from ... import numpy as mxnp
+from ... import numpy_extension as npx
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ..nn.basic_layers import _resolve_init
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            shape = info["shape"]
+            states.append(mxnp.zeros(shape, ctx=ctx))
+        return states
+
+    def reset(self):
+        pass
+
+    def __call__(self, inputs, states, **kwargs):
+        return super().__call__(inputs, states, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch_size,
+                                           ctx=inputs.ctx)
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            step_input = mxnp.squeeze(
+                mxnp.take(inputs, mxnp.array([i], dtype="int32"), axis=axis),
+                axis=axis)
+            out, states = self(step_input, states)
+            outputs.append(out)
+        if valid_length is not None:
+            stacked = mxnp.stack(outputs, axis=0)  # (T, N, ...)
+            stacked = npx.sequence_mask(stacked, valid_length,
+                                        use_sequence_length=True, axis=0)
+            outputs = [stacked[i] for i in range(length)]
+        if merge_outputs is None or merge_outputs:
+            merged = mxnp.stack(outputs, axis=axis)
+            return merged, states
+        return outputs, states
+
+
+class _BaseRNNCell(RecurrentCell):
+    def __init__(self, hidden_size, num_gates, input_size,
+                 i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = num_gates
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(ng * hidden_size, input_size),
+            init=_resolve_init(i2h_weight_initializer),
+            allow_deferred_init=True)
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(ng * hidden_size, hidden_size),
+            init=_resolve_init(h2h_weight_initializer),
+            allow_deferred_init=True)
+        self.i2h_bias = Parameter(
+            "i2h_bias", shape=(ng * hidden_size,),
+            init=_resolve_init(i2h_bias_initializer),
+            allow_deferred_init=True)
+        self.h2h_bias = Parameter(
+            "h2h_bias", shape=(ng * hidden_size,),
+            init=_resolve_init(h2h_bias_initializer),
+            allow_deferred_init=True)
+        self._ng = ng
+
+    def _finish(self, x):
+        if self.i2h_weight.shape[1] == 0:
+            self.i2h_weight.shape = (self._ng * self._hidden_size, x.shape[-1])
+        for p in (self.i2h_weight, self.h2h_weight, self.i2h_bias,
+                  self.h2h_bias):
+            if p._data is None:
+                p.finish_deferred_init()
+
+    def _proj(self, x, states):
+        self._finish(x)
+        i2h = npx.fully_connected(x, self.i2h_weight.data(),
+                                  self.i2h_bias.data(),
+                                  num_hidden=self._ng * self._hidden_size,
+                                  flatten=False)
+        h2h = npx.fully_connected(states[0], self.h2h_weight.data(),
+                                  self.h2h_bias.data(),
+                                  num_hidden=self._ng * self._hidden_size,
+                                  flatten=False)
+        return i2h, h2h
+
+
+class RNNCell(_BaseRNNCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__(hidden_size, 1, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._proj(inputs, states)
+        out = npx.activation(i2h + h2h, act_type=self._activation) \
+            if self._activation in ("relu", "tanh", "sigmoid", "softrelu") \
+            else getattr(npx, self._activation)(i2h + h2h)
+        return out, [out]
+
+
+class LSTMCell(_BaseRNNCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 activation="tanh", recurrent_activation="sigmoid"):
+        super().__init__(hidden_size, 4, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._proj(inputs, states)
+        gates = i2h + h2h
+        h = self._hidden_size
+        i = npx.sigmoid(gates[:, :h])
+        f = npx.sigmoid(gates[:, h:2 * h])
+        c_in = mxnp.tanh(gates[:, 2 * h:3 * h])
+        o = npx.sigmoid(gates[:, 3 * h:])
+        next_c = f * states[1] + i * c_in
+        next_h = o * mxnp.tanh(next_c)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(_BaseRNNCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__(hidden_size, 3, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._proj(inputs, states)
+        h = self._hidden_size
+        r = npx.sigmoid(i2h[:, :h] + h2h[:, :h])
+        z = npx.sigmoid(i2h[:, h:2 * h] + h2h[:, h:2 * h])
+        n = mxnp.tanh(i2h[:, 2 * h:] + r * h2h[:, 2 * h:])
+        next_h = (1 - z) * n + z * states[0]
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self):
+        super().__init__()
+        self._cells = []
+
+    def add(self, cell):
+        idx = len(self._cells)
+        self._cells.append(cell)
+        setattr(self, str(idx), cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for cell in self._cells:
+            out.extend(cell.state_info(batch_size))
+        return out
+
+    def forward(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info())
+            inputs, st = cell(inputs, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._cells)
+
+    def __getitem__(self, i):
+        return self._cells[i]
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        if self._rate > 0:
+            inputs = npx.dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ZoneoutCell(_ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        next_output, next_states = self.base_cell(inputs, states)
+        from ...ops.invoke import is_training
+        if not is_training():
+            return next_output, next_states
+        prev_output = self._prev_output
+        if prev_output is None:
+            prev_output = mxnp.zeros_like(next_output)
+
+        def zone(new, old, rate):
+            if rate == 0.0:
+                return new
+            # mask==1 -> keep the previous (zoned-out) value
+            mask = (mxnp.random.uniform(size=new.shape) < rate).astype(new.dtype)
+            return mask * old + (1 - mask) * new
+
+        output = zone(next_output, prev_output, self._zoneout_outputs)
+        new_states = [zone(ns, os, self._zoneout_states)
+                      for ns, os in zip(next_states, states)]
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(_ModifierCell):
+    def forward(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell):
+        super().__init__()
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def forward(self, inputs, states):
+        raise NotImplementedError(
+            "BidirectionalCell supports unroll() only (step direction is "
+            "ambiguous), as in the reference")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch_size = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size=batch_size,
+                                           ctx=inputs.ctx)
+        n_l = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, begin_state[:n_l], layout, True, valid_length)
+        rev = npx.sequence_reverse(
+            inputs.swapaxes(0, axis) if axis != 0 else inputs,
+            valid_length, use_sequence_length=valid_length is not None, axis=0)
+        if axis != 0:
+            rev = rev.swapaxes(0, axis)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev, begin_state[n_l:], layout, True, valid_length)
+        r_out_seq = r_out.swapaxes(0, axis) if axis != 0 else r_out
+        r_out_seq = npx.sequence_reverse(
+            r_out_seq, valid_length,
+            use_sequence_length=valid_length is not None, axis=0)
+        if axis != 0:
+            r_out_seq = r_out_seq.swapaxes(0, axis)
+        out = mxnp.concatenate([l_out, r_out_seq], axis=-1)
+        return out, l_states + r_states

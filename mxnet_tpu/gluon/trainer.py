@@ -1,0 +1,246 @@
+"""Gluon Trainer.
+
+Reference: `python/mxnet/gluon/trainer.py:31` — owns the optimizer, wires
+gradients through the kvstore (`_allreduce_grads` :385 with priority=-i for
+comm/compute overlap) and applies updates.
+
+TPU-native design: the update for ALL parameters is fused into one jitted
+XLA program with donated buffers (the analogue of the reference's
+multi-tensor `multi_sgd_mom_update` kernels + engine bulking) — one dispatch
+per step instead of one per parameter.  Communication overlap comes from
+XLA's async collectives instead of engine priorities: gradients of replicated
+params over sharded batches are reduced *inside* the compiled
+forward/backward, so `_allreduce_grads` is a no-op on the SPMD path and only
+does explicit reductions for classic per-device-copy parallelism.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import optimizer as opt
+from ..kvstore import base as kvstore_base
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict,)):
+            params = [params[k] for k in sorted(params)]
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("params must be a dict or list of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(f"element {i} is not a Parameter")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        if compression_params is not None:
+            # 2-bit gradient compression exists for slow PCIe/TCP links
+            # (`src/kvstore/gradient_compression.h`); ICI bandwidth makes it
+            # counterproductive on TPU.
+            raise NotImplementedError(
+                "gradient compression is not supported on kvstore='tpu_ici'")
+        self._scale = 1.0
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._updaters = None
+        self._fused_cache = {}
+        self._states = None
+
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, (
+                "optimizer_params must be None if optimizer is an Optimizer "
+                "instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- kvstore ----------------------------------------------------------
+    def _init_kvstore(self):
+        if self._kv_initialized:
+            return
+        kv = self._kvstore_type
+        if kv is None or kv is False:
+            self._kvstore = None
+        elif isinstance(kv, kvstore_base.KVStoreBase):
+            self._kvstore = kv
+        elif isinstance(kv, str):
+            # single device + local store: skip the round-trip entirely
+            multi_device = any(len(p.list_ctx()) > 1 for p in self._params)
+            multi_worker = jax.process_count() > 1
+            if kv in ("local", "device") and not multi_device and not multi_worker:
+                self._kvstore = None
+            else:
+                self._kvstore = kvstore_base.create(kv)
+        else:
+            raise MXNetError(f"invalid kvstore {kv!r}")
+        if self._update_on_kvstore is None:
+            self._update_on_kvstore = False  # optimizer runs in-worker on TPU
+        if self._update_on_kvstore and self._kvstore is not None:
+            if not self._kvstore.is_capable(kvstore_base.KVStoreBase.OPTIMIZER):
+                raise ValueError(
+                    f"kvstore {self._kvstore.type} does not support "
+                    "update_on_kvstore")
+            self._kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    @property
+    def kvstore(self):
+        self._init_kvstore()
+        return self._kvstore
+
+    # -- states -----------------------------------------------------------
+    def _init_states(self):
+        if self._states is None:
+            self._states = {}
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._states[i] = \
+                        self._optimizer.create_state_multi_precision(
+                            i, param.data())
+
+    # -- step -------------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + optimizer update; ``batch_size`` normalizes gradients
+        (reference trainer.py:334)."""
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                grads = param.list_grad()
+                # priority -i preserved for API parity; XLA's scheduler
+                # handles overlap on the SPMD path
+                self._kvstore.pushpull(i, grads, priority=-i)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore:
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._kvstore.push(i, param.list_grad())
+                    self._kvstore.pull(i, param.list_data())
+            return
+        self._init_states()
+        fused = self._try_fused_update()
+        if fused:
+            return
+        # per-parameter eager fallback (multi-device copies, odd optimizers)
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            for w, g in zip(param.list_data(), param.list_grad()):
+                self._optimizer.update([i], [w], [g], [self._states[i]])
+
+    # -- the fused path ----------------------------------------------------
+    def _try_fused_update(self):
+        if getattr(self._optimizer, "supports_fused", True) is False:
+            return False
+        idxs = [i for i, p in enumerate(self._params)
+                if p.grad_req != "null" and len(p.list_ctx()) == 1]
+        if len(idxs) != sum(1 for p in self._params if p.grad_req != "null"):
+            return False
+        if not idxs:
+            return True
+        optimizer = self._optimizer
+        key = (id(optimizer), tuple(idxs))
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            def fused(ws, gs, states, lrs, wds, ts, rescale, clip):
+                new_ws, new_states = [], []
+                for w, g, st, lr, wd, t in zip(ws, gs, states, lrs, wds, ts):
+                    g = g * rescale
+                    if clip is not None:
+                        g = jnp.clip(g, -clip, clip)
+                    nw, nst = optimizer.update_math(w, g, st, lr, wd, t)
+                    new_ws.append(nw)
+                    new_states.append(nst)
+                return new_ws, new_states
+
+            fn = jax.jit(fused, donate_argnums=(0, 2), static_argnums=(7,))
+            self._fused_cache[key] = fn
+
+        ws = [self._params[i].data()._data for i in idxs]
+        gs = [self._params[i].grad()._data for i in idxs]
+        states = [tuple(s._data for s in _as_tuple(self._states[i]))
+                  for i in idxs]
+        lrs, wds, ts = [], [], []
+        for i in idxs:
+            optimizer._update_count(i)
+            lrs.append(jnp.float32(optimizer._get_lr(i)))
+            wds.append(jnp.float32(optimizer._get_wd(i)))
+            ts.append(jnp.float32(optimizer._index_update_count[i]))
+        new_ws, new_states = fn(ws, gs, states, lrs, wds, ts,
+                                jnp.float32(optimizer.rescale_grad),
+                                optimizer.clip_gradient)
+        for i, nw, nst in zip(idxs, new_ws, new_states):
+            self._params[i].data()._rebind(nw)
+            for s_nd, s_new in zip(_as_tuple(self._states[i]), _as_tuple(nst)):
+                s_nd._rebind(s_new)
+        return True
+
+    # -- state I/O (reference trainer.py save_states/load_states) ----------
+    def save_states(self, fname):
+        self._init_states()
+        updater = opt.Updater(self._optimizer)
+        updater.states = dict(self._states or {})
+        with open(fname, "wb") as f:
+            f.write(updater.get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        updater = opt.Updater(self._optimizer)
+        with open(fname, "rb") as f:
+            updater.set_states(f.read())
+        self._init_states()
+        for i, st in updater.states.items():
+            if i in self._states:
+                for cur, new in zip(_as_tuple(self._states[i]), _as_tuple(st)):
+                    cur._rebind(new._data)
+
+
+def _as_tuple(x):
+    if x is None:
+        return ()
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,)

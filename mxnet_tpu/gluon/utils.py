@@ -1,0 +1,108 @@
+"""Gluon utilities.
+
+Reference: `python/mxnet/gluon/utils.py` (split_and_load, clip_global_norm,
+download helpers).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray.ndarray import NDArray
+from .. import numpy as mxnp
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download", "shape_is_known"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}.")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = tuple(slice(None) if ax != batch_axis else slice(begin, end)
+                    for ax in range(data.ndim))
+        slices.append(data[idx])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch across contexts (reference utils.py split_and_load).
+    On a TPU mesh prefer `parallel.data_sharding` + a single sharded array;
+    this per-device list form feeds the classic kvstore path."""
+    if not isinstance(data, NDArray):
+        data = mxnp.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_ctx(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_ctx(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so their joint L2 norm is at most max_norm (in place,
+    like the reference)."""
+    assert len(arrays) > 0
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+                         for a in arrays))
+    total_host = float(total)
+    if check_isfinite and not onp.isfinite(total_host):
+        import warnings
+        warnings.warn(UserWarning(
+            f"nan or inf is detected. Clipping results will be undefined."),
+            stacklevel=2)
+    scale = max_norm / (total_host + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._rebind(a._data * scale)
+    return total_host if check_isfinite else total
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s > 0 for s in shape)
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Kept for API parity; this environment has no egress, so only
+    file:// URLs or already-present files resolve."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    if url.startswith("file://"):
+        import shutil
+        shutil.copyfile(url[7:], fname)
+        return fname
+    raise MXNetError(
+        f"cannot download {url}: no network egress in this environment; "
+        f"place the file at {fname} manually")

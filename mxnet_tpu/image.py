@@ -1,0 +1,116 @@
+"""Image I/O and processing.
+
+Reference: `python/mxnet/image/` + `src/operator/image/` (OpenCV-backed
+imdecode/resize/augmenters).  The TPU build decodes on host CPU via Pillow
+when available (no OpenCV dependency); array-level ops (resize/crop/
+normalize) are numpy, matching where they run in the pipeline (DataLoader
+workers), keeping the TPU for training math.
+"""
+from __future__ import annotations
+
+import io as _io
+
+import numpy as onp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from . import numpy as mxnp
+
+__all__ = ["imread", "imdecode", "imencode", "imresize", "resize_short",
+           "center_crop", "random_crop", "fixed_crop", "color_normalize"]
+
+
+def _pil():
+    try:
+        from PIL import Image
+        return Image
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError(
+            "image decoding requires Pillow, which is not installed; "
+            "pre-decode your dataset to .npy/.rec instead") from e
+
+
+def imread(filename, flag=1, to_rgb=True):
+    Image = _pil()
+    img = Image.open(filename)
+    img = img.convert("RGB" if flag else "L")
+    arr = onp.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if flag and not to_rgb:
+        arr = arr[:, :, ::-1]
+    return mxnp.array(arr, dtype=onp.uint8)
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    Image = _pil()
+    img = Image.open(_io.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = onp.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if flag and not to_rgb:
+        arr = arr[:, :, ::-1]
+    return mxnp.array(arr, dtype=onp.uint8)
+
+
+def imencode(img, img_fmt=".jpg", quality=95):
+    Image = _pil()
+    arr = img.asnumpy() if isinstance(img, NDArray) else onp.asarray(img)
+    if arr.shape[-1] == 1:
+        arr = arr[:, :, 0]
+    pil = Image.fromarray(arr)
+    buf = _io.BytesIO()
+    fmt = {".jpg": "JPEG", ".jpeg": "JPEG", ".png": "PNG"}[img_fmt.lower()]
+    pil.save(buf, format=fmt, quality=quality)
+    return buf.getvalue()
+
+
+def imresize(src, w, h, interp=1):
+    from .gluon.data.vision.transforms import _resize_hwc
+    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    return mxnp.array(_resize_hwc(arr, (w, h)))
+
+
+def resize_short(src, size, interp=1):
+    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(size * h / w)
+    else:
+        new_w, new_h = int(size * w / h), size
+    return imresize(arr, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(out, size[0], size[1], interp)
+    return mxnp.array(out)
+
+
+def center_crop(src, size, interp=1):
+    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = size
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    return fixed_crop(arr, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=1):
+    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = size
+    x0 = onp.random.randint(0, w - new_w + 1)
+    y0 = onp.random.randint(0, h - new_h + 1)
+    return fixed_crop(arr, x0, y0, new_w, new_h), (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src if isinstance(src, NDArray) else mxnp.array(src)
+    src = src.astype(onp.float32) - mean
+    if std is not None:
+        src = src / std
+    return src

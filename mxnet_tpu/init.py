@@ -1,0 +1,3 @@
+"""``mx.init`` alias for the initializer module (reference exposes both)."""
+from .initializer import *  # noqa: F401,F403
+from .initializer import __all__  # noqa: F401

@@ -1,0 +1,241 @@
+"""Weight initializers.
+
+Reference: `python/mxnet/initializer.py` (registry + Xavier/MSRAPrelu/
+Bilinear/LSTMBias/...).  Initializers fill an NDArray in place (rebind),
+running on the array's own device so large params never stage through host.
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import registry
+from .ndarray.ndarray import NDArray
+from . import random as _rng
+
+__all__ = [
+    "Initializer", "register", "create", "Zero", "One", "Constant", "Uniform",
+    "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+    "InitDesc", "Mixed",
+]
+
+
+class InitDesc(str):
+    """Name + attrs describing what is being initialized (reference
+    `initializer.py` InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        self.init_weight(desc, arr)
+
+    def init_weight(self, desc, arr):
+        name = str(desc).lower()
+        if name.endswith("bias"):
+            self._init_zero(arr)
+        elif name.endswith("gamma"):
+            self._init_one(arr)
+        elif name.endswith("beta"):
+            self._init_zero(arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(arr)
+        else:
+            self._init_weight(desc, arr)
+
+    def _init_zero(self, arr):
+        arr._rebind(jnp.zeros(arr.shape, arr.dtype))
+
+    def _init_one(self, arr):
+        arr._rebind(jnp.ones(arr.shape, arr.dtype))
+
+    def _init_weight(self, desc, arr):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+
+register = registry.get_register_func(Initializer, "initializer")
+create = registry.get_create_func(Initializer, "initializer")
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        self._init_zero(arr)
+
+
+registry.get_registry("initializer").register(Zero, "zeros")
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        self._init_one(arr)
+
+
+registry.get_registry("initializer").register(One, "ones")
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        if isinstance(self.value, NDArray):
+            arr._rebind(jnp.broadcast_to(self.value._data, arr.shape).astype(arr.dtype))
+        else:
+            arr._rebind(jnp.full(arr.shape, self.value, arr.dtype))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        k = _rng.new_key()
+        arr._rebind(jax.random.uniform(
+            k, arr.shape, jnp.float32, -self.scale, self.scale).astype(arr.dtype))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        k = _rng.new_key()
+        arr._rebind((jax.random.normal(k, arr.shape, jnp.float32) *
+                     self.sigma).astype(arr.dtype))
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        k = _rng.new_key()
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(k, (nout, nin), jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(k, (nout, nin), jnp.float32)
+        u, _v, q = jnp.linalg.svd(tmp, full_matrices=False)
+        w = u if u.shape == (nout, nin) else q
+        arr._rebind((self.scale * w).reshape(arr.shape).astype(arr.dtype))
+
+
+@register
+class Xavier(Initializer):
+    """Reference `initializer.py` Xavier: gaussian/uniform over fan avg/in/out."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(
+                f"Xavier initializer needs >= 2D shape, got {shape} for {desc}")
+        if len(shape) > 2:
+            hw_scale = onp.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {
+            "avg": (fan_in + fan_out) / 2.0,
+            "in": fan_in,
+            "out": fan_out,
+        }[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        k = _rng.new_key()
+        if self.rnd_type == "uniform":
+            w = jax.random.uniform(k, shape, jnp.float32, -scale, scale)
+        elif self.rnd_type == "gaussian":
+            w = jax.random.normal(k, shape, jnp.float32) * scale
+        else:
+            raise ValueError(f"unknown rnd_type {self.rnd_type!r}")
+        arr._rebind(w.astype(arr.dtype))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, desc, arr):
+        shape = arr.shape
+        weight = onp.zeros(int(onp.prod(shape)), dtype=onp.float32)
+        f = onp.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._rebind(jnp.asarray(weight.reshape(shape), arr.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (reference `initializer.py` LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = onp.zeros(arr.shape, onp.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr._rebind(jnp.asarray(b, arr.dtype))
+
+
+class Mixed:
+    """Patterns → initializers (reference `initializer.py` Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        assert len(patterns) == len(initializers)
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError(f"parameter {name} did not match any pattern")

@@ -1,0 +1,138 @@
+"""KVStore plugin API.
+
+Reference: `python/mxnet/kvstore/base.py:74-144` — ``KVStoreBase`` with the
+capability interface (``broadcast``/``pushpull``/``is_capable``) that Horovod
+and BytePS plug into; the native stores live behind `KVStore::Create`
+(`src/kvstore/kvstore.cc:42-80`).
+
+TPU-native design: collectives are XLA all-reduce over ICI/DCN instead of
+NCCL/ps-lite.  Store names accepted by :func:`create`:
+
+=================  ====================================================
+name               backend
+=================  ====================================================
+``local``          single-process reduce of per-device copies
+``device``         alias of ``local`` (reduction placement is XLA's call)
+``tpu_ici``        XLA collectives over the chip interconnect (the point
+                   of this build); multi-host via `jax.distributed`
+``nccl``           alias of ``tpu_ici`` so GPU scripts run unmodified
+``horovod``        alias of ``tpu_ici`` (allreduce-only capability set)
+``dist_sync`` /    multi-host ``tpu_ici`` (synchronous only — dist-async
+``dist_device_     has no faithful SPMD analogue, documented unsupported
+sync``             like `nccl` does for some ops in the reference)
+=================  ====================================================
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["KVStoreBase", "create", "TestStore"]
+
+
+class KVStoreBase:
+    """Reference: `python/mxnet/kvstore/base.py:74`."""
+
+    OPTIMIZER = "optimizer"
+
+    kv_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        KVStoreBase.kv_registry[name] = klass
+        return klass
+
+    # -- interface --------------------------------------------------------
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    @staticmethod
+    def is_capable(capability):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        raise NotImplementedError
+
+    @property
+    def num_workers(self):
+        raise NotImplementedError
+
+
+_ALIASES = {
+    "local": "local",
+    "device": "local",
+    "local_allreduce_cpu": "local",
+    "local_allreduce_device": "local",
+    "tpu_ici": "tpuicistore",
+    "nccl": "tpuicistore",
+    "horovod": "tpuicistore",
+    "dist_sync": "tpuicistore",
+    "dist_device_sync": "tpuicistore",
+    "dist_sync_device": "tpuicistore",
+    "teststore": "teststore",
+}
+
+
+def create(name="local"):
+    """Factory (reference `KVStore::Create`, `src/kvstore/kvstore.cc:42`)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    key = name.lower()
+    if key in ("dist_async", "p3", "dist_sync_device_p3", "dist_device_sync_p3"):
+        raise MXNetError(
+            f"kvstore type '{name}' (asynchronous/priority parameter-server) "
+            "has no faithful analogue on SPMD TPU collectives; use "
+            "'tpu_ici' (synchronous allreduce). See SURVEY.md §7 hard-part 5.")
+    target = _ALIASES.get(key)
+    if target is None:
+        raise MXNetError(f"unknown kvstore type '{name}'")
+    if target == "local":
+        from .local import LocalKVStore
+        return LocalKVStore()
+    klass = KVStoreBase.kv_registry.get(target)
+    if klass is None:
+        raise MXNetError(f"kvstore backend '{target}' not registered")
+    return klass()
+
+
+@KVStoreBase.register
+class TestStore(KVStoreBase):
+    """Pure-python single-worker store for tests (reference
+    `python/mxnet/kvstore/base.py:246`)."""
+
+    def broadcast(self, key, value, out, priority=0):
+        values = value if isinstance(value, list) else [value]
+        outs = out if isinstance(out, list) else [out]
+        for o in outs:
+            values[0].copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        values = value if isinstance(value, list) else [value]
+        reduced = values[0]
+        for v in values[1:]:
+            reduced = reduced + v.as_in_ctx(reduced.ctx)
+        if out is None:
+            for v in values:
+                reduced.as_in_ctx(v.ctx).copyto(v)
+        else:
+            outs = out if isinstance(out, list) else [out]
+            for o in outs:
+                reduced.as_in_ctx(o.ctx).copyto(o)
+
+    @staticmethod
+    def is_capable(capability):
+        if capability.lower() == KVStoreBase.OPTIMIZER:
+            return False
+        raise MXNetError(f"unknown capability: {capability}")
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1

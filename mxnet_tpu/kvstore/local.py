@@ -1,0 +1,131 @@
+"""Single-process kvstore with the classic init/push/pull API.
+
+Reference: `src/kvstore/kvstore_local.h:240-274` (push = ``comm_->Reduce``
+over device copies, pull = broadcast; optional local updater running the
+optimizer at the store) and `comm.h` CommCPU/CommDevice.
+
+TPU-native design: per-device copies are summed by staging through the
+first value's device (PjRt issues the inter-device DMAs; on a TPU slice
+these ride ICI).  When an optimizer is set (`update_on_kvstore`), updates
+run through an `optimizer.Updater`, as the reference server does.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase
+
+__all__ = ["LocalKVStore"]
+
+
+class LocalKVStore(KVStoreBase):
+    def __init__(self):
+        self._store = {}
+        self._updater = None
+
+    # -- classic API (reference include/mxnet/kvstore.h) ------------------
+    def init(self, key, value):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = _first(v).copy()
+
+    def push(self, key, value, priority=0):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            reduced = _reduce(v)
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError(f"key {k} not initialized")
+                self._updater(_int_key(k), reduced, self._store[k])
+            else:
+                self._store[k] = reduced
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _normalize(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            src = self._store[k]
+            for dst in _as_list(o):
+                src.as_in_ctx(dst.ctx).copyto(dst)
+
+    def set_optimizer(self, optimizer):
+        from ..optimizer import Updater
+        self._updater = Updater(optimizer)
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    # -- KVStoreBase API ---------------------------------------------------
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        keys, values = _normalize(key, value)
+        _, outs = _normalize(key, out) if out is not None else (keys, values)
+        for k, v, o in zip(keys, values, outs):
+            reduced = _reduce(v)
+            for dst in _as_list(o):
+                if dst is not reduced:
+                    reduced.as_in_ctx(dst.ctx).copyto(dst)
+
+    @staticmethod
+    def is_capable(capability):
+        if capability.lower() == KVStoreBase.OPTIMIZER:
+            return True
+        raise MXNetError(f"unknown capability: {capability}")
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    @property
+    def type(self):
+        return "local"
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("optimizer is not set")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("optimizer is not set")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def _first(v):
+    return v[0] if isinstance(v, (list, tuple)) else v
+
+
+def _int_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def _reduce(v):
+    vals = _as_list(v)
+    acc = vals[0]
+    for x in vals[1:]:
+        acc = acc + x.as_in_ctx(acc.ctx)
+    return acc
+
+
+def _normalize(key, value):
+    if isinstance(key, (list, tuple)):
+        assert isinstance(value, (list, tuple)) and len(key) == len(value)
+        return list(key), list(value)
+    return [key], [value]

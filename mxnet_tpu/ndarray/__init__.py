@@ -1,0 +1,47 @@
+"""``mx.nd`` — legacy NDArray namespace.
+
+Reference: `python/mxnet/ndarray/` (21k LoC of generated wrappers).  The TPU
+rebuild is natively NumPy-semantics; this namespace re-exports the np surface
+under the legacy names users expect (`mx.nd.array`, `mx.nd.waitall`,
+`elemwise_add`, ...) so Gluon-era scripts keep running.
+"""
+from __future__ import annotations
+
+from .ndarray import NDArray, array, empty, from_jax, waitall
+
+
+def _lazy_np():
+    from .. import numpy as _np
+    return _np
+
+
+def __getattr__(name):
+    legacy = {
+        "elemwise_add": "add",
+        "elemwise_sub": "subtract",
+        "elemwise_mul": "multiply",
+        "elemwise_div": "true_divide",
+        "broadcast_add": "add",
+        "broadcast_sub": "subtract",
+        "broadcast_mul": "multiply",
+        "broadcast_div": "true_divide",
+        "broadcast_maximum": "maximum",
+        "broadcast_minimum": "minimum",
+        "broadcast_power": "power",
+    }
+    np_mod = _lazy_np()
+    if name in legacy:
+        return getattr(np_mod, legacy[name])
+    if hasattr(np_mod, name):
+        return getattr(np_mod, name)
+    raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute {name!r}")
+
+
+def save(fname, data):
+    from ..utils.serialization import save_ndarrays
+    save_ndarrays(fname, data)
+
+
+def load(fname, ctx=None):
+    from ..utils.serialization import load_ndarrays
+    return load_ndarrays(fname, ctx=ctx)

@@ -1,0 +1,541 @@
+"""The user-facing tensor.
+
+Reference: `include/mxnet/ndarray.h:82` (``NDArray`` over a ref-counted
+``Chunk`` holding a ``Storage::Handle`` + engine var) and the python mirror
+`python/mxnet/numpy/multiarray.py`.
+
+TPU-native design: the Chunk is a ``jax.Array`` (a PjRt buffer).  The engine
+"variable" that orders reads/writes in the reference is the buffer's XLA
+definition event — PjRt already sequences compute per device and exposes
+``block_until_ready`` (== ``WaitToRead``).  Mutation (`a += b`, sliced
+assignment, optimizer updates) re-binds this wrapper to a fresh buffer and
+bumps ``_version`` — the reference's var/version pair (`ndarray.h:401-410`).
+Inside a ``jax.jit`` trace ``_data`` is a tracer, which is how ``hybridize()``
+traces Gluon blocks without a separate deferred-compute mode
+(`src/imperative/imperative.cc:40` in the reference).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError, integer_types, numeric_types
+from ..context import Context, current_context
+from ..ops import invoke as _iv
+from ..ops.invoke import invoke
+
+__all__ = ["NDArray", "array", "empty", "from_jax", "waitall"]
+
+
+class NDArray:
+    _slots = (
+        "_data",
+        "_ctx",
+        "_grad",
+        "_grad_req",
+        "_node",
+        "_node_idx",
+        "_version",
+    )
+
+    def __init__(self, data, ctx=None, dtype=None):
+        if isinstance(data, NDArray):
+            ctx = ctx or data._ctx
+            data = data._data
+        if dtype is not None:
+            dtype = onp.dtype(dtype) if not isinstance(data, jax.core.Tracer) else dtype
+        if isinstance(data, jax.core.Tracer):
+            self._data = data if dtype is None else data.astype(dtype)
+            self._ctx = Context(ctx) if ctx is not None else current_context()
+        else:
+            if ctx is None:
+                ctx = current_context()
+            else:
+                ctx = Context(ctx)
+            if isinstance(data, jax.Array):
+                self._data = data if dtype is None else data.astype(dtype)
+            else:
+                with jax.default_device(ctx.jax_device()):
+                    self._data = jnp.asarray(data, dtype=dtype)
+            self._ctx = ctx
+        self._grad = None
+        self._grad_req = "null"
+        self._node = None
+        self._node_idx = 0
+        self._version = 0
+
+    # ------------------------------------------------------------------
+    # chunk / engine surface
+    # ------------------------------------------------------------------
+    @property
+    def data(self):
+        """The underlying jax.Array (or tracer during hybridize tracing)."""
+        return self._data
+
+    def _rebind(self, new_data, node=None, node_idx=0):
+        """Mutate in place: point this NDArray at a new buffer.
+
+        The reference performs true in-place writes through engine write-vars;
+        on XLA the buffer is immutable so mutation is re-binding + version
+        bump (safe for the tape, see `ops/invoke.py`)."""
+        if isinstance(new_data, NDArray):
+            node = new_data._node
+            node_idx = new_data._node_idx
+            new_data = new_data._data
+        self._data = new_data
+        self._node = node
+        self._node_idx = node_idx
+        self._version += 1
+        return self
+
+    def wait_to_read(self):
+        """Block until the buffer is defined (reference ``WaitToRead``);
+        asynchronous execution errors are raised here, matching the
+        reference's contract (`src/engine/threaded_engine.h:461-498`)."""
+        if isinstance(self._data, jax.Array):
+            self._data.block_until_ready()
+        return self
+
+    wait_to_write = wait_to_read
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        s = 1
+        for d in self.shape:
+            s *= d
+        return s
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def ctx(self):
+        return self._ctx
+
+    @property
+    def context(self):
+        return self._ctx
+
+    @property
+    def device(self):
+        return self._ctx
+
+    @property
+    def T(self):
+        return invoke(jnp.transpose, (self,), name="transpose")
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of an array with more than one element is ambiguous."
+            )
+        return bool(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        try:
+            return f"{onp.asarray(self._data)!s}\n<NDArray {self.shape} @{self._ctx}>"
+        except Exception:
+            return f"<NDArray {self.shape} {self.dtype} @{self._ctx} (traced)>"
+
+    # ------------------------------------------------------------------
+    # host transfer / placement
+    # ------------------------------------------------------------------
+    def asnumpy(self):
+        return onp.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return onp.asarray(self._data).tolist()
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self._data.reshape(()).item()
+
+    def astype(self, dtype, copy=True):
+        if not copy and onp.dtype(dtype) == self.dtype:
+            return self
+        return invoke(lambda x: x.astype(dtype), (self,), name="astype")
+
+    def copy(self):
+        return invoke(lambda x: x + 0, (self,), name="copy")
+
+    def copyto(self, other):
+        """Copy into ``other`` (NDArray → mutate; Context → new array there)."""
+        if isinstance(other, NDArray):
+            if other is self:
+                return other
+            data = self._data
+            if other._ctx != self._ctx:
+                data = jax.device_put(data, other._ctx.jax_device())
+            if tuple(other.shape) != self.shape:
+                raise ValueError(
+                    f"copyto shape mismatch {self.shape} vs {other.shape}"
+                )
+            if other.dtype != self.dtype:
+                data = data.astype(other.dtype)
+            other._rebind(data, node=self._node, node_idx=self._node_idx)
+            return other
+        ctx = Context(other)
+        return NDArray(jax.device_put(self._data, ctx.jax_device()), ctx=ctx)
+
+    def as_in_ctx(self, ctx):
+        ctx = Context(ctx)
+        if ctx == self._ctx:
+            return self
+        if isinstance(self._data, jax.core.Tracer):
+            out = NDArray(self._data, ctx=ctx)
+        else:
+            out = NDArray(jax.device_put(self._data, ctx.jax_device()), ctx=ctx)
+        out._node, out._node_idx = self._node, self._node_idx
+        return out
+
+    as_in_context = as_in_ctx
+    to_device = as_in_ctx
+
+    def as_np_ndarray(self):
+        return self
+
+    def as_nd_ndarray(self):
+        return self
+
+    # ------------------------------------------------------------------
+    # autograd surface (reference: ndarray.h autograd_entry_, python
+    # mxnet/numpy/multiarray.py attach_grad/backward)
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer; marks this array as a leaf variable
+        (reference: `python/mxnet/autograd.py:196` mark_variables)."""
+        if grad_req not in ("write", "add", "null"):
+            raise ValueError(f"invalid grad_req {grad_req!r}")
+        self._node = None  # leaves are detached from any previous graph
+        self._grad = NDArray(jnp.zeros(self.shape, self.dtype), ctx=self._ctx)
+        self._grad_req = grad_req
+        return self
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._rebind(jnp.zeros(self.shape, self.dtype))
+
+    def detach(self):
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True,
+                 create_graph=False):
+        _iv.backward([self], [out_grad], retain_graph=retain_graph,
+                     create_graph=create_graph)
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def _index_data(self, key):
+        if isinstance(key, tuple):
+            return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        if isinstance(key, NDArray):
+            return key._data
+        return key
+
+    def __getitem__(self, key):
+        k = self._index_data(key)
+        return invoke(lambda x: x[k], (self,), name="getitem")
+
+    def __setitem__(self, key, value):
+        k = self._index_data(key)
+        if isinstance(value, NDArray):
+            def setter(x, v):
+                return x.at[k].set(v.astype(x.dtype))
+            self._rebind(invoke(setter, (self, value), name="setitem"))
+        else:
+            def setter(x):
+                return x.at[k].set(value)
+            self._rebind(invoke(setter, (self,), name="setitem"))
+
+    # ------------------------------------------------------------------
+    # shape ops (delegate to jnp through the dispatcher)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        # reference allows 0 = copy-dim, -1 = infer (ndarray.cc reshape)
+        shape = tuple(
+            self.shape[i] if s == 0 else s for i, s in enumerate(shape)
+        ) if 0 in shape else shape
+        return invoke(lambda x: jnp.reshape(x, shape), (self,), name="reshape")
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        axes = axes if axes else None
+        return invoke(lambda x: jnp.transpose(x, axes), (self,), name="transpose")
+
+    def flatten(self):
+        return self.reshape(-1)
+
+    def squeeze(self, axis=None):
+        return invoke(lambda x: jnp.squeeze(x, axis), (self,), name="squeeze")
+
+    def expand_dims(self, axis):
+        return invoke(lambda x: jnp.expand_dims(x, axis), (self,), name="expand_dims")
+
+    def swapaxes(self, a1, a2):
+        return invoke(lambda x: jnp.swapaxes(x, a1, a2), (self,), name="swapaxes")
+
+    def broadcast_to(self, shape):
+        return invoke(lambda x: jnp.broadcast_to(x, shape), (self,), name="broadcast_to")
+
+    def repeat(self, repeats, axis=None):
+        return invoke(lambda x: jnp.repeat(x, repeats, axis), (self,), name="repeat")
+
+    def clip(self, a_min=None, a_max=None):
+        return invoke(lambda x: jnp.clip(x, a_min, a_max), (self,), name="clip")
+
+    def abs(self):
+        return invoke(jnp.abs, (self,), name="abs")
+
+    def sum(self, axis=None, dtype=None, keepdims=False):
+        return invoke(lambda x: jnp.sum(x, axis=axis, dtype=dtype, keepdims=keepdims),
+                      (self,), name="sum")
+
+    def mean(self, axis=None, dtype=None, keepdims=False):
+        return invoke(lambda x: jnp.mean(x, axis=axis, dtype=dtype, keepdims=keepdims),
+                      (self,), name="mean")
+
+    def prod(self, axis=None, keepdims=False):
+        return invoke(lambda x: jnp.prod(x, axis=axis, keepdims=keepdims),
+                      (self,), name="prod")
+
+    def max(self, axis=None, keepdims=False):
+        return invoke(lambda x: jnp.max(x, axis=axis, keepdims=keepdims),
+                      (self,), name="max")
+
+    def min(self, axis=None, keepdims=False):
+        return invoke(lambda x: jnp.min(x, axis=axis, keepdims=keepdims),
+                      (self,), name="min")
+
+    def argmax(self, axis=None):
+        return invoke(lambda x: jnp.argmax(x, axis=axis), (self,),
+                      name="argmax", differentiable=False)
+
+    def argmin(self, axis=None):
+        return invoke(lambda x: jnp.argmin(x, axis=axis), (self,),
+                      name="argmin", differentiable=False)
+
+    def dot(self, other):
+        return invoke(jnp.dot, (self, other), name="dot")
+
+    def norm(self, ord=None, axis=None, keepdims=False):
+        return invoke(lambda x: jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims),
+                      (self,), name="norm")
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise NotImplementedError(
+                "sparse storage types are not implemented on TPU (XLA has no "
+                "sparse buffers); see SURVEY.md §7 'sparse row_sparse/csr'"
+            )
+        return self
+
+    @property
+    def stype(self):
+        return "default"
+
+    # ------------------------------------------------------------------
+    # arithmetic operators
+    # ------------------------------------------------------------------
+    def _binary(self, other, fun, name, reflect=False):
+        if isinstance(other, NDArray) or isinstance(other, numeric_types) or (
+            isinstance(other, (onp.ndarray, jax.Array))
+        ):
+            a, b = (other, self) if reflect else (self, other)
+            return invoke(fun, (a, b), name=name)
+        return NotImplemented
+
+    def __add__(self, other):
+        return self._binary(other, jnp.add, "add")
+
+    def __radd__(self, other):
+        return self._binary(other, jnp.add, "add", reflect=True)
+
+    def __sub__(self, other):
+        return self._binary(other, jnp.subtract, "subtract")
+
+    def __rsub__(self, other):
+        return self._binary(other, jnp.subtract, "subtract", reflect=True)
+
+    def __mul__(self, other):
+        return self._binary(other, jnp.multiply, "multiply")
+
+    def __rmul__(self, other):
+        return self._binary(other, jnp.multiply, "multiply", reflect=True)
+
+    def __truediv__(self, other):
+        return self._binary(other, jnp.true_divide, "true_divide")
+
+    def __rtruediv__(self, other):
+        return self._binary(other, jnp.true_divide, "true_divide", reflect=True)
+
+    def __floordiv__(self, other):
+        return self._binary(other, jnp.floor_divide, "floor_divide")
+
+    def __rfloordiv__(self, other):
+        return self._binary(other, jnp.floor_divide, "floor_divide", reflect=True)
+
+    def __mod__(self, other):
+        return self._binary(other, jnp.mod, "mod")
+
+    def __rmod__(self, other):
+        return self._binary(other, jnp.mod, "mod", reflect=True)
+
+    def __pow__(self, other):
+        return self._binary(other, jnp.power, "power")
+
+    def __rpow__(self, other):
+        return self._binary(other, jnp.power, "power", reflect=True)
+
+    def __matmul__(self, other):
+        return self._binary(other, jnp.matmul, "matmul")
+
+    def __rmatmul__(self, other):
+        return self._binary(other, jnp.matmul, "matmul", reflect=True)
+
+    def __neg__(self):
+        return invoke(jnp.negative, (self,), name="negative")
+
+    def __pos__(self):
+        return self
+
+    def __abs__(self):
+        return invoke(jnp.abs, (self,), name="abs")
+
+    def __invert__(self):
+        return invoke(jnp.invert, (self,), name="invert", differentiable=False)
+
+    # in-place: re-bind (tape-safe, see module docstring)
+    def __iadd__(self, other):
+        return self._rebind(self._binary(other, jnp.add, "add"))
+
+    def __isub__(self, other):
+        return self._rebind(self._binary(other, jnp.subtract, "subtract"))
+
+    def __imul__(self, other):
+        return self._rebind(self._binary(other, jnp.multiply, "multiply"))
+
+    def __itruediv__(self, other):
+        return self._rebind(self._binary(other, jnp.true_divide, "true_divide"))
+
+    def __imod__(self, other):
+        return self._rebind(self._binary(other, jnp.mod, "mod"))
+
+    def __ipow__(self, other):
+        return self._rebind(self._binary(other, jnp.power, "power"))
+
+    # comparisons (non-differentiable)
+    def _compare(self, other, fun, name):
+        return invoke(fun, (self, other), name=name, differentiable=False)
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return self._compare(other, jnp.equal, "equal")
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return self._compare(other, jnp.not_equal, "not_equal")
+
+    def __lt__(self, other):
+        return self._compare(other, jnp.less, "less")
+
+    def __le__(self, other):
+        return self._compare(other, jnp.less_equal, "less_equal")
+
+    def __gt__(self, other):
+        return self._compare(other, jnp.greater, "greater")
+
+    def __ge__(self, other):
+        return self._compare(other, jnp.greater_equal, "greater_equal")
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        arr = onp.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __dlpack__(self, *a, **kw):
+        return self._data.__dlpack__(*a, **kw)
+
+
+_iv.set_ndarray_class(NDArray)
+
+
+# ---------------------------------------------------------------------------
+# creation helpers (reference: mx.nd.array / ndarray.cc)
+# ---------------------------------------------------------------------------
+def array(source, ctx=None, dtype=None, device=None):
+    ctx = ctx or device
+    return NDArray(source if not isinstance(source, NDArray) else source._data,
+                   ctx=ctx, dtype=dtype)
+
+
+def empty(shape, ctx=None, dtype=None, device=None):
+    ctx = ctx or device
+    return NDArray(jnp.zeros(shape, dtype or onp.float32), ctx=ctx)
+
+
+def from_jax(x, ctx=None):
+    return NDArray(x, ctx=ctx)
+
+
+def waitall():
+    """Drain all pending device work (reference `mx.nd.waitall`,
+    `python/mxnet/ndarray/ndarray.py:231`).
+
+    PjRt executes per-device work in submission order, so blocking on a
+    freshly enqueued no-op computation per device drains that device's queue.
+    """
+    for d in jax.devices():
+        try:
+            jax.device_put(0, d).block_until_ready()
+            (jnp.zeros((), onp.float32) + 0).block_until_ready()
+        except Exception:  # pragma: no cover - backend without alloc
+            pass

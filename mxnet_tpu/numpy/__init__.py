@@ -1,0 +1,310 @@
+"""``mx.np`` — the NumPy-compatible imperative op surface.
+
+Reference: `python/mxnet/numpy/multiarray.py` (12k LoC of generated wrappers
+over the `_npi.*` C++ ops, `src/operator/numpy/`).  TPU-native design: every
+op is a jax.numpy lowering dispatched through `ops/invoke.py`, which gives
+async execution, autograd recording, and jit-traceability in one place.  The
+554-op C++ registry collapses to this table because XLA owns kernel codegen.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import numeric_types
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, waitall
+from ..ops.invoke import invoke
+
+ndarray = NDArray
+
+# dtype aliases (mx.np.float32 etc.)
+float16 = onp.float16
+float32 = onp.float32
+float64 = onp.float64
+bfloat16 = jnp.bfloat16
+int8 = onp.int8
+int16 = onp.int16
+int32 = onp.int32
+int64 = onp.int64
+uint8 = onp.uint8
+uint16 = onp.uint16
+uint32 = onp.uint32
+uint64 = onp.uint64
+bool_ = onp.bool_
+pi = onp.pi
+e = onp.e
+euler_gamma = onp.euler_gamma
+inf = onp.inf
+nan = onp.nan
+newaxis = None
+_np_version = onp.__version__
+
+
+def _apply_out(res, out):
+    if out is None:
+        return res
+    out._rebind(res)
+    return out
+
+
+def _make_op(jfun, name, differentiable=True):
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        res = invoke(jfun, args, kwargs, name=name, differentiable=differentiable)
+        return _apply_out(res, out)
+
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = f"TPU lowering of np.{name} (see jax.numpy.{name})."
+    return fn
+
+
+# ops whose outputs are integer/boolean — skip vjp recording
+_NON_DIFF = {
+    "argmax", "argmin", "argsort", "argwhere", "nonzero", "flatnonzero",
+    "searchsorted", "digitize", "bincount", "count_nonzero", "unique",
+    "equal", "not_equal", "less", "less_equal", "greater", "greater_equal",
+    "isclose", "isfinite", "isinf", "isnan", "isneginf", "isposinf",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "signbit",
+    "floor_divide", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "invert", "left_shift", "right_shift", "rint", "fix", "trunc",
+    "floor", "ceil", "around", "round", "sign", "allclose", "array_equal",
+    "may_share_memory", "shares_memory", "result_type", "unravel_index",
+}
+
+_JNP_FUNCS = [
+    # elementwise math
+    "abs", "absolute", "add", "subtract", "multiply", "divide", "true_divide",
+    "floor_divide", "mod", "remainder", "fmod", "power", "float_power",
+    "negative", "positive", "reciprocal", "sqrt", "cbrt", "square", "exp",
+    "expm1", "exp2", "log", "log2", "log10", "log1p", "sign", "fabs",
+    "rint", "fix", "trunc", "floor", "ceil", "around", "round", "clip",
+    "maximum", "minimum", "fmax", "fmin", "copysign", "nextafter", "ldexp",
+    "gcd", "lcm", "heaviside", "nan_to_num", "real", "imag", "conj",
+    "conjugate", "angle", "hypot", "logaddexp", "logaddexp2", "sinc",
+    "signbit", "frexp", "modf", "divmod", "trunc",
+    # trig / hyperbolic
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan", "arctan2",
+    "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    "deg2rad", "rad2deg", "degrees", "radians",
+    # comparisons / logic
+    "equal", "not_equal", "less", "less_equal", "greater", "greater_equal",
+    "isclose", "allclose", "array_equal", "isfinite", "isinf", "isnan",
+    "isneginf", "isposinf", "logical_and", "logical_or", "logical_xor",
+    "logical_not",
+    # bitwise
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "invert",
+    "left_shift", "right_shift",
+    # reductions
+    "sum", "prod", "mean", "std", "var", "max", "min", "amax", "amin",
+    "ptp", "median", "percentile", "quantile", "average", "cumsum",
+    "cumprod", "nansum", "nanprod", "nanmean", "nanstd", "nanvar", "nanmax",
+    "nanmin", "nanmedian", "nanpercentile", "nanquantile", "all", "any",
+    "count_nonzero", "trace",
+    # index / search / sort
+    "argmax", "argmin", "argsort", "sort", "argwhere", "nonzero",
+    "flatnonzero", "searchsorted", "digitize", "bincount", "unique",
+    "take", "take_along_axis", "compress", "extract", "unravel_index",
+    "diag_indices_from", "tril_indices", "triu_indices",
+    # shape manipulation
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "expand_dims", "squeeze", "broadcast_to", "broadcast_arrays",
+    "atleast_1d", "atleast_2d", "atleast_3d", "concatenate", "stack",
+    "vstack", "hstack", "dstack", "column_stack", "row_stack", "split",
+    "array_split", "hsplit", "vsplit", "dsplit", "tile", "repeat", "flip",
+    "fliplr", "flipud", "roll", "rot90", "pad", "insert", "delete",
+    "append", "resize", "trim_zeros", "flatten" if hasattr(jnp, "flatten") else "ravel",
+    # linear algebra (top-level)
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum",
+    "kron", "cross", "diag", "diagflat", "diagonal", "tril", "triu",
+    "trace", "convolve", "correlate",
+    # misc
+    "where", "interp", "diff", "ediff1d", "gradient", "histogram",
+    "histogram2d", "histogram_bin_edges", "meshgrid", "polyval", "polyfit",
+    "apply_along_axis", "may_share_memory", "shares_memory", "result_type",
+    "isscalar", "ndim", "shape", "size",
+]
+
+_g = globals()
+for _name in _JNP_FUNCS:
+    if _name in _g:
+        continue
+    if _name == "fix":  # deprecated alias in jnp; identical semantics
+        _g["fix"] = _make_op(jnp.trunc, "fix", differentiable=False)
+        continue
+    _jf = getattr(jnp, _name, None)
+    if _jf is None:
+        continue
+    _g[_name] = _make_op(_jf, _name, differentiable=_name not in _NON_DIFF)
+
+
+# ---------------------------------------------------------------------------
+# creation ops — honor ctx/device kwarg (reference: `mx.np.zeros(ctx=...)`)
+# ---------------------------------------------------------------------------
+def _creation(jfun, name):
+    def fn(*args, ctx=None, device=None, out=None, **kwargs):
+        c = Context(ctx or device) if (ctx or device) is not None else current_context()
+        with jax.default_device(c.jax_device()):
+            res = invoke(jfun, args, kwargs, name=name)
+        if isinstance(res, NDArray):
+            res._ctx = c
+        return _apply_out(res, out)
+
+    fn.__name__ = name
+    return fn
+
+
+def array(object, dtype=None, ctx=None, device=None):
+    if dtype is None and not hasattr(object, "dtype"):
+        # reference defaults python lists/scalars to float32
+        probe = onp.asarray(object)
+        if probe.dtype.kind == "f":
+            dtype = onp.float32
+        elif probe.dtype == onp.int64 and not jax.config.jax_enable_x64:
+            dtype = onp.int32
+        else:
+            dtype = probe.dtype
+    return NDArray(object._data if isinstance(object, NDArray) else object,
+                   ctx=ctx or device, dtype=dtype)
+
+
+def asarray(a, dtype=None):
+    if isinstance(a, NDArray) and dtype is None:
+        return a
+    return array(a, dtype=dtype)
+
+
+def _default_float(dtype):
+    return onp.float32 if dtype is None else dtype
+
+
+def zeros(shape, dtype=None, order="C", ctx=None, device=None):
+    return _creation(lambda: jnp.zeros(shape, _default_float(dtype)), "zeros")(
+        ctx=ctx, device=device)
+
+
+def ones(shape, dtype=None, order="C", ctx=None, device=None):
+    return _creation(lambda: jnp.ones(shape, _default_float(dtype)), "ones")(
+        ctx=ctx, device=device)
+
+
+def full(shape, fill_value, dtype=None, order="C", ctx=None, device=None, out=None):
+    def f(fv):
+        return jnp.full(shape, fv, dtype)
+    c = Context(ctx or device) if (ctx or device) is not None else current_context()
+    with jax.default_device(c.jax_device()):
+        res = invoke(f, (fill_value,), name="full")
+    return _apply_out(res, out)
+
+
+def empty(shape, dtype=None, order="C", ctx=None, device=None):
+    return zeros(shape, dtype=dtype, ctx=ctx, device=device)
+
+
+def zeros_like(a, dtype=None, order="C", ctx=None, device=None):
+    return invoke(lambda x: jnp.zeros_like(x, dtype=dtype), (a,), name="zeros_like")
+
+
+def ones_like(a, dtype=None, order="C", ctx=None, device=None):
+    return invoke(lambda x: jnp.ones_like(x, dtype=dtype), (a,), name="ones_like")
+
+
+def full_like(a, fill_value, dtype=None, ctx=None, device=None):
+    return invoke(lambda x: jnp.full_like(x, fill_value, dtype=dtype), (a,),
+                  name="full_like")
+
+
+def empty_like(a, dtype=None):
+    return zeros_like(a, dtype=dtype)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    def f():
+        d = dtype
+        if d is None:
+            # reference arange defaults to float32 unless ints given
+            if builtins.all(isinstance(v, (int, type(None)))
+                            for v in (start, stop)) and isinstance(step, int):
+                d = onp.float32
+        return jnp.arange(start, stop, step, d)
+    return _creation(f, "arange")(ctx=ctx, device=device)
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None, device=None):
+    return _creation(
+        lambda: jnp.linspace(start, stop, num, endpoint=endpoint,
+                             retstep=retstep, dtype=dtype, axis=axis),
+        "linspace")(ctx=ctx, device=device)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None, device=None):
+    return _creation(
+        lambda: jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
+                             dtype=dtype, axis=axis),
+        "logspace")(ctx=ctx, device=device)
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None, device=None):
+    return _creation(lambda: jnp.eye(N, M, k, _default_float(dtype)), "eye")(
+        ctx=ctx, device=device)
+
+
+def identity(n, dtype=None, ctx=None, device=None):
+    return eye(n, dtype=dtype, ctx=ctx, device=device)
+
+
+def tri(N, M=None, k=0, dtype=None, ctx=None, device=None):
+    return _creation(lambda: jnp.tri(N, M, k, _default_float(dtype)), "tri")(
+        ctx=ctx, device=device)
+
+
+def indices(dimensions, dtype=None, ctx=None, device=None):
+    return _creation(lambda: jnp.indices(dimensions, dtype or onp.int32),
+                     "indices")(ctx=ctx, device=device)
+
+
+def copy(a):
+    return a.copy() if isinstance(a, NDArray) else array(a)
+
+
+def may_share_memory(a, b, max_work=None):  # noqa: ARG001
+    return False
+
+
+def shares_memory(a, b, max_work=None):  # noqa: ARG001
+    return False
+
+
+def expm1_(*a, **k):  # compat no-op guard
+    raise NotImplementedError
+
+
+def dtype(d):
+    return onp.dtype(d)
+
+
+def concatenate(seq, axis=0, out=None):
+    res = invoke(lambda *xs: jnp.concatenate(xs, axis=axis), tuple(seq),
+                 name="concatenate")
+    return _apply_out(res, out)
+
+
+def stack(seq, axis=0, out=None):
+    res = invoke(lambda *xs: jnp.stack(xs, axis=axis), tuple(seq), name="stack")
+    return _apply_out(res, out)
+
+
+def isnat(*_a, **_k):
+    raise NotImplementedError("datetime dtypes are not supported on TPU")
+
+
+from . import linalg  # noqa: E402
+from . import random  # noqa: E402
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,29 @@
+"""``mx.np.linalg`` — XLA lowerings of the reference's linalg ops
+(`src/operator/numpy/linalg/`, `src/operator/tensor/la_op.cc`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.invoke import invoke
+
+_FUNCS = [
+    "norm", "svd", "qr", "cholesky", "inv", "pinv", "det", "slogdet",
+    "eig", "eigh", "eigvals", "eigvalsh", "solve", "lstsq", "matrix_rank",
+    "matrix_power", "multi_dot", "tensorinv", "tensorsolve", "cond",
+]
+
+_g = globals()
+for _name in _FUNCS:
+    _jf = getattr(jnp.linalg, _name, None)
+    if _jf is None:
+        continue
+
+    def _make(jf, name):
+        def fn(*args, **kwargs):
+            return invoke(jf, args, kwargs, name=f"linalg.{name}")
+        fn.__name__ = name
+        return fn
+
+    _g[_name] = _make(_jf, _name)
+
+__all__ = [n for n in _FUNCS if n in _g]

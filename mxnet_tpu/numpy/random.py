@@ -1,0 +1,353 @@
+"""``mx.np.random`` — sampling ops.
+
+Reference: `src/operator/numpy/random/` + `src/operator/random/sample_op.cc`,
+driven by engine PRNG resources (`src/resource.cc:93`).  TPU-native design:
+XLA threefry keys from the stateful stream in `mxnet_tpu.random` (fresh key
+per draw; traced key stream under hybridize so compiled programs stay random).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray
+from ..ops.invoke import invoke
+from .. import random as _rng
+
+__all__ = [
+    "seed", "uniform", "normal", "randn", "rand", "randint", "choice",
+    "shuffle", "permutation", "gamma", "beta", "exponential", "poisson",
+    "bernoulli", "binomial", "multinomial", "laplace", "gumbel", "logistic",
+    "lognormal", "chisquare", "rayleigh", "pareto", "power", "weibull",
+    "multivariate_normal", "f", "standard_normal", "standard_exponential",
+    "standard_gamma",
+]
+
+seed = _rng.seed
+
+
+def _size(size, *broadcast_args):
+    if size is not None:
+        return (size,) if isinstance(size, int) else tuple(size)
+    shp = ()
+    for a in broadcast_args:
+        if hasattr(a, "shape"):
+            shp = onp.broadcast_shapes(shp, tuple(a.shape))
+    return shp
+
+
+def _sample(fun, args, size=None, dtype=None, ctx=None, device=None, out=None,
+            name="sample"):
+    key = _rng.new_key()
+    c = Context(ctx or device) if (ctx or device) is not None else None
+
+    def f(*arrs):
+        return fun(key, *arrs)
+
+    if c is not None:
+        with jax.default_device(c.jax_device()):
+            res = invoke(f, args, name=name)
+        res._ctx = c
+    else:
+        res = invoke(f, args, name=name)
+    if out is not None:
+        out._rebind(res)
+        return out
+    return res
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, device=None,
+            out=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, low, high)
+
+    def fun(key, lo, hi):
+        lo = jnp.asarray(lo, dtype)
+        hi = jnp.asarray(hi, dtype)
+        return jax.random.uniform(key, shp, dtype) * (hi - lo) + lo
+
+    return _sample(fun, (low, high), size, dtype, ctx, device, out, "uniform")
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None,
+           out=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, loc, scale)
+
+    def fun(key, mu, sigma):
+        return jax.random.normal(key, shp, dtype) * jnp.asarray(sigma, dtype) \
+            + jnp.asarray(mu, dtype)
+
+    return _sample(fun, (loc, scale), size, dtype, ctx, device, out, "normal")
+
+
+def standard_normal(size=None, dtype=None, ctx=None, device=None):
+    return normal(0.0, 1.0, size=size, dtype=dtype, ctx=ctx, device=device)
+
+
+def randn(*shape, dtype=None, ctx=None, device=None):
+    return normal(0.0, 1.0, size=shape or None, dtype=dtype, ctx=ctx, device=device)
+
+
+def rand(*shape, dtype=None, ctx=None, device=None):
+    return uniform(0.0, 1.0, size=shape or None, dtype=dtype, ctx=ctx, device=device)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, device=None,
+            out=None):
+    if high is None:
+        low, high = 0, low
+    dtype = dtype or onp.int32
+    shp = _size(size)
+
+    def fun(key):
+        return jax.random.randint(key, shp, low, high, dtype)
+
+    return _sample(fun, (), size, dtype, ctx, device, out, "randint")
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, device=None, out=None):
+    shp = _size(size)
+
+    def fun(key, *arrs):
+        arr = arrs[0] if isinstance(a, NDArray) else jnp.arange(a)
+        probs = arrs[-1] if p is not None else None
+        return jax.random.choice(key, arr, shp, replace, probs)
+
+    args = tuple(x for x in (a if isinstance(a, NDArray) else None, p)
+                 if x is not None)
+    return _sample(fun, args, size, None, ctx, device, out, "choice")
+
+
+def permutation(x, ctx=None, device=None):
+    def fun(key, *arrs):
+        arr = arrs[0] if arrs else jnp.arange(x)
+        return jax.random.permutation(key, arr)
+
+    args = (x,) if isinstance(x, NDArray) else ()
+    return _sample(fun, args, None, None, ctx, device, None, "permutation")
+
+
+def shuffle(x):
+    """In-place shuffle along axis 0 (reference `_npi_shuffle`)."""
+    x._rebind(permutation(x))
+    return None
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, device=None,
+          out=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, shape, scale)
+
+    def fun(key, k, theta):
+        return jax.random.gamma(key, jnp.asarray(k, dtype), shp, dtype) * \
+            jnp.asarray(theta, dtype)
+
+    return _sample(fun, (shape, scale), size, dtype, ctx, device, out, "gamma")
+
+
+def standard_gamma(shape, size=None, dtype=None, ctx=None, device=None):
+    return gamma(shape, 1.0, size=size, dtype=dtype, ctx=ctx, device=device)
+
+
+def beta(a, b, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, a, b)
+
+    def fun(key, aa, bb):
+        return jax.random.beta(key, jnp.asarray(aa, dtype),
+                               jnp.asarray(bb, dtype), shp, dtype)
+
+    return _sample(fun, (a, b), size, dtype, ctx, device, None, "beta")
+
+
+def exponential(scale=1.0, size=None, dtype=None, ctx=None, device=None,
+                out=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, scale)
+
+    def fun(key, s):
+        return jax.random.exponential(key, shp, dtype) * jnp.asarray(s, dtype)
+
+    return _sample(fun, (scale,), size, dtype, ctx, device, out, "exponential")
+
+
+def standard_exponential(size=None, dtype=None, ctx=None, device=None):
+    return exponential(1.0, size=size, dtype=dtype, ctx=ctx, device=device)
+
+
+def poisson(lam=1.0, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or onp.int32
+    shp = _size(size, lam)
+
+    def fun(key, l):
+        return jax.random.poisson(key, jnp.asarray(l), shp).astype(dtype)
+
+    return _sample(fun, (lam,), size, dtype, ctx, device, None, "poisson")
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None,
+              device=None):
+    dtype = dtype or onp.float32
+    assert (prob is None) != (logit is None), "pass exactly one of prob/logit"
+    arg = prob if prob is not None else logit
+    shp = _size(size, arg)
+
+    def fun(key, p):
+        pp = jax.nn.sigmoid(jnp.asarray(p)) if logit is not None else jnp.asarray(p)
+        return jax.random.bernoulli(key, pp, shp or None).astype(dtype)
+
+    return _sample(fun, (arg,), size, dtype, ctx, device, None, "bernoulli")
+
+
+def binomial(n, p, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or onp.int32
+    shp = _size(size, n, p)
+
+    def fun(key, nn, pp):
+        return jax.random.binomial(key, jnp.asarray(nn, onp.float32),
+                                   jnp.asarray(pp, onp.float32),
+                                   shp or None).astype(dtype)
+
+    return _sample(fun, (n, p), size, dtype, ctx, device, None, "binomial")
+
+
+def multinomial(n, pvals, size=None, ctx=None, device=None):
+    shp = _size(size)
+
+    def fun(key, pv):
+        counts = jax.random.multinomial(
+            key, jnp.asarray(n, onp.float32),
+            jnp.asarray(pv, onp.float32),
+            shape=(shp + (jnp.asarray(pv).shape[-1],)) if shp else None)
+        return counts.astype(onp.int64)
+
+    return _sample(fun, (pvals,), size, None, ctx, device, None, "multinomial")
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None,
+            out=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, loc, scale)
+
+    def fun(key, mu, b):
+        return jax.random.laplace(key, shp, dtype) * jnp.asarray(b, dtype) + \
+            jnp.asarray(mu, dtype)
+
+    return _sample(fun, (loc, scale), size, dtype, ctx, device, out, "laplace")
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, loc, scale)
+
+    def fun(key, mu, b):
+        return jax.random.gumbel(key, shp, dtype) * jnp.asarray(b, dtype) + \
+            jnp.asarray(mu, dtype)
+
+    return _sample(fun, (loc, scale), size, dtype, ctx, device, None, "gumbel")
+
+
+def logistic(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, loc, scale)
+
+    def fun(key, mu, s):
+        return jax.random.logistic(key, shp, dtype) * jnp.asarray(s, dtype) + \
+            jnp.asarray(mu, dtype)
+
+    return _sample(fun, (loc, scale), size, dtype, ctx, device, None, "logistic")
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, mean, sigma)
+
+    def fun(key, mu, s):
+        return jnp.exp(jax.random.normal(key, shp, dtype) *
+                       jnp.asarray(s, dtype) + jnp.asarray(mu, dtype))
+
+    return _sample(fun, (mean, sigma), size, dtype, ctx, device, None, "lognormal")
+
+
+def chisquare(df, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, df)
+
+    def fun(key, d):
+        return jax.random.chisquare(key, jnp.asarray(d, dtype), shape=shp or None,
+                                    dtype=dtype)
+
+    return _sample(fun, (df,), size, dtype, ctx, device, None, "chisquare")
+
+
+def rayleigh(scale=1.0, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, scale)
+
+    def fun(key, s):
+        return jax.random.rayleigh(key, shape=shp or None, dtype=dtype) * \
+            jnp.asarray(s, dtype)
+
+    return _sample(fun, (scale,), size, dtype, ctx, device, None, "rayleigh")
+
+
+def pareto(a, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, a)
+
+    def fun(key, aa):
+        return jax.random.pareto(key, jnp.asarray(aa, dtype), shape=shp or None,
+                                 dtype=dtype) - 1.0
+
+    return _sample(fun, (a,), size, dtype, ctx, device, None, "pareto")
+
+
+def power(a, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, a)
+
+    def fun(key, aa):
+        u = jax.random.uniform(key, shp, dtype)
+        return u ** (1.0 / jnp.asarray(aa, dtype))
+
+    return _sample(fun, (a,), size, dtype, ctx, device, None, "power")
+
+
+def weibull(a, size=None, dtype=None, ctx=None, device=None):
+    dtype = dtype or onp.float32
+    shp = _size(size, a)
+
+    def fun(key, aa):
+        return jax.random.weibull_min(key, 1.0, jnp.asarray(aa, dtype),
+                                      shape=shp or None, dtype=dtype)
+
+    return _sample(fun, (a,), size, dtype, ctx, device, None, "weibull")
+
+
+def multivariate_normal(mean, cov, size=None, ctx=None, device=None):
+    shp = _size(size)
+
+    def fun(key, mu, sigma):
+        return jax.random.multivariate_normal(key, mu, sigma,
+                                              shape=shp or None)
+
+    return _sample(fun, (mean, cov), size, None, ctx, device, None,
+                   "multivariate_normal")
+
+
+def f(dfnum, dfden, size=None, ctx=None, device=None):
+    dtype = onp.float32
+    shp = _size(size, dfnum, dfden)
+
+    def fun(key, d1, d2):
+        k1, k2 = jax.random.split(key)
+        x1 = jax.random.chisquare(key=k1, df=jnp.asarray(d1, dtype),
+                                  shape=shp or None, dtype=dtype)
+        x2 = jax.random.chisquare(key=k2, df=jnp.asarray(d2, dtype),
+                                  shape=shp or None, dtype=dtype)
+        return (x1 / jnp.asarray(d1, dtype)) / (x2 / jnp.asarray(d2, dtype))
+
+    return _sample(fun, (dfnum, dfden), size, dtype, ctx, device, None, "f")

@@ -1,0 +1,122 @@
+"""``mx.npx`` — NumPy-extension namespace (NN primitives + utilities).
+
+Reference: `python/mxnet/numpy_extension/` + the `_npx.*` generated ops.
+These are the ops Gluon layers call; each delegates to the pure-XLA
+lowerings in `ops/nn.py` through the dispatcher.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from ..ndarray.ndarray import NDArray, waitall
+from ..ops import nn as _nn
+from ..ops.invoke import invoke, is_recording, is_training
+from ..ops.aux_scope import apply_aux_update
+from .. import random as _rng
+from ..util import set_np, reset_np, is_np_array, use_np  # noqa: F401
+
+__all__ = [
+    "activation", "batch_norm", "convolution", "deconvolution", "dropout",
+    "embedding", "fully_connected", "layer_norm", "group_norm", "instance_norm",
+    "leaky_relu", "log_softmax", "masked_softmax", "masked_log_softmax",
+    "one_hot", "pick", "pooling", "relu", "sigmoid", "smooth_l1", "softmax",
+    "topk", "batch_dot", "sequence_mask", "sequence_last", "sequence_reverse",
+    "reshape_like", "arange_like", "gamma", "gammaln", "erf", "erfinv",
+    "adaptive_avg_pool2d", "l2_normalization", "waitall", "cpu", "gpu", "tpu",
+    "num_gpus", "num_tpus", "current_context", "save", "load", "seed",
+]
+
+seed = _rng.seed
+
+
+def _op(fun, name, differentiable=True):
+    def fn(*args, **kwargs):
+        return invoke(fun, args, kwargs, name=name, differentiable=differentiable)
+    fn.__name__ = name
+    return fn
+
+
+activation = _op(_nn.activation, "activation")
+convolution = _op(_nn.convolution, "convolution")
+deconvolution = _op(_nn.deconvolution, "deconvolution")
+fully_connected = _op(_nn.fully_connected, "fully_connected")
+pooling = _op(_nn.pooling, "pooling")
+adaptive_avg_pool2d = _op(_nn.adaptive_avg_pool2d, "adaptive_avg_pool2d")
+layer_norm = _op(_nn.layer_norm, "layer_norm")
+group_norm = _op(_nn.group_norm, "group_norm")
+instance_norm = _op(_nn.instance_norm, "instance_norm")
+l2_normalization = _op(_nn.l2_normalization, "l2_normalization")
+softmax = _op(_nn.softmax, "softmax")
+log_softmax = _op(_nn.log_softmax, "log_softmax")
+masked_softmax = _op(_nn.masked_softmax, "masked_softmax")
+masked_log_softmax = _op(_nn.masked_log_softmax, "masked_log_softmax")
+leaky_relu = _op(_nn.leaky_relu, "leaky_relu")
+embedding = _op(_nn.embedding, "embedding")
+one_hot = _op(_nn.one_hot, "one_hot", differentiable=False)
+pick = _op(_nn.pick, "pick")
+topk = _op(_nn.topk, "topk", differentiable=False)
+batch_dot = _op(_nn.batch_dot, "batch_dot")
+sequence_mask = _op(_nn.sequence_mask, "sequence_mask")
+sequence_last = _op(_nn.sequence_last, "sequence_last")
+sequence_reverse = _op(_nn.sequence_reverse, "sequence_reverse")
+smooth_l1 = _op(_nn.smooth_l1, "smooth_l1")
+reshape_like = _op(_nn.reshape_like, "reshape_like")
+arange_like = _op(_nn.arange_like, "arange_like", differentiable=False)
+gamma = _op(_nn.gamma_fn, "gamma")
+gammaln = _op(_nn.gammaln, "gammaln")
+erf = _op(_nn.erf, "erf")
+erfinv = _op(_nn.erfinv, "erfinv")
+relu = _op(_nn.relu, "relu")
+sigmoid = _op(_nn.sigmoid, "sigmoid")
+
+
+def dropout(data, p=0.5, axes=None, mode=None):
+    """Reference: `src/operator/nn/dropout.cc`.  Active only in train mode
+    (autograd train_mode flag), like the reference's `mode='training'`."""
+    training = is_training() if mode is None else (mode == "always")
+    if not training or p == 0.0:
+        return data
+    key = _rng.new_key()
+    return invoke(lambda x: _nn.dropout(x, key, p=p, axes=axes), (data,),
+                  name="dropout")
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1):
+    """Reference: `src/operator/nn/batch_norm.cc`.  Mutates the moving stats
+    in train mode (deferred under a hybridize trace, see `ops/aux_scope.py`)."""
+    if fix_gamma:
+        gamma = gamma * 0 + 1  # reference sets gamma to 1 and zeroes its grad
+    training = is_training() and not use_global_stats
+    if training:
+        out, new_mean, new_var = invoke(
+            _nn.batch_norm_train,
+            (x, gamma, beta, momentum, eps, axis, running_mean, running_var),
+            name="batch_norm")
+        apply_aux_update(running_mean, new_mean)
+        apply_aux_update(running_var, new_var)
+        return out
+    return invoke(
+        _nn.batch_norm_inference,
+        (x, gamma, beta, running_mean, running_var, eps, axis),
+        name="batch_norm")
+
+
+# ---------------------------------------------------------------------------
+# parameter serialization (reference: mx.npx.save/load over the 0x112 NDArray
+# file format, `src/ndarray/ndarray.cc:1729`).  TPU build uses .npz — see
+# mxnet_tpu/utils/serialization.py for the format note.
+# ---------------------------------------------------------------------------
+def save(fname, data):
+    from ..utils.serialization import save_ndarrays
+    save_ndarrays(fname, data)
+
+
+def load(fname, ctx=None):
+    from ..utils.serialization import load_ndarrays
+    return load_ndarrays(fname, ctx=ctx)

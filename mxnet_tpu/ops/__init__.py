@@ -1,0 +1,2 @@
+from . import invoke  # noqa: F401
+from .invoke import invoke as _invoke  # noqa: F401

@@ -1,0 +1,46 @@
+"""Deferred auxiliary-state updates.
+
+The reference's BatchNorm mutates its aux states (moving_mean/moving_var)
+inside the op (`src/operator/nn/batch_norm.cc`), which works because engine
+write-vars order the mutation.  Under a `jax.jit` trace (hybridize) we cannot
+mutate a real parameter with a tracer; instead the update is *deferred*: the
+traced new value is collected here, returned as an extra output of the
+compiled program, and written back by the caller after execution
+(`gluon/block.py` opens this scope around its compiled forward).
+Eagerly (no active scope) the update is applied immediately via rebind.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class _ScopeState(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_state = _ScopeState()
+
+
+class aux_update_scope:
+    def __init__(self):
+        self.updates = []  # list[(NDArray, new_value NDArray)]
+
+    def __enter__(self):
+        _state.stack.append(self)
+        return self
+
+    def __exit__(self, *_exc):
+        _state.stack.pop()
+
+
+def apply_aux_update(arr, new_value):
+    """Mutate ``arr`` to ``new_value`` now, or defer if a trace scope is open."""
+    if _state.stack:
+        _state.stack[-1].updates.append((arr, new_value))
+    else:
+        arr._rebind(new_value._data if hasattr(new_value, "_data") else new_value)
+
+
+def in_scope():
+    return bool(_state.stack)

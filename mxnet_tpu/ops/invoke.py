@@ -1,0 +1,441 @@
+"""Imperative op dispatch + autograd tape — the engine of the framework.
+
+Reference analogue: `src/imperative/imperative.cc` (``Imperative::Invoke`` at
+:98, ``InvokeOp`` :49, ``RecordOp``/``Backward`` :385) plus the ThreadedEngine
+(`src/engine/threaded_engine.h`).  TPU-native design:
+
+* **Scheduling**: the reference builds its own dataflow engine (read/write vars,
+  per-device worker threads).  PjRt already gives async dispatch with ordered
+  per-device streams and buffer-definition events, so an op here is simply a
+  traced JAX call — python returns immediately, XLA executes asynchronously,
+  and ``wait_to_read`` blocks on the buffer (the reference's ``WaitForVar``).
+  Async errors surface at the block point, matching the reference's
+  throw-at-WaitToRead contract (`src/engine/threaded_engine.h:461-498`).
+
+* **Gradients**: the reference keeps a per-op ``FGradient`` registry and builds
+  a backward nnvm graph (`src/nnvm/gradient.cc:699`).  Here the tape records a
+  ``jax.vjp`` closure per invoked op — one generic rule covers the whole op
+  surface, and under ``hybridize()`` an entire compiled program becomes a
+  single tape node.
+
+* **Mutation**: reference NDArrays are mutable through engine write-vars.  XLA
+  buffers are immutable, so mutation is re-binding the NDArray to a new buffer
+  (with a version bump).  The tape stores ``(array, node_at_use_time)`` pairs,
+  so mutating an array never corrupts previously recorded history (residuals
+  were captured by value) — in-place updates inside ``autograd.record()`` are
+  legal, unlike torch.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+__all__ = [
+    "invoke",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "backward",
+    "grad",
+    "Node",
+]
+
+# The NDArray class registers itself here to break the import cycle
+# (analogue of `_set_ndarray_class` in `python/mxnet/ndarray/register.py`).
+_ndarray_cls = None
+
+
+def set_ndarray_class(cls):
+    global _ndarray_cls
+    _ndarray_cls = cls
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_state = _TapeState()
+
+
+def is_recording():
+    return _state.recording
+
+
+def is_training():
+    return _state.training
+
+
+def set_recording(flag):
+    prev = _state.recording
+    _state.recording = bool(flag)
+    return prev
+
+
+def set_training(flag):
+    prev = _state.training
+    _state.training = bool(flag)
+    return prev
+
+
+class Node:
+    """One recorded op on the tape.
+
+    Reference analogue: an nnvm node created by ``Imperative::RecordOp``
+    (`src/imperative/imperative.cc:134` region).  ``parents`` capture the input
+    arrays *and the tape node each had at use time*, which is what makes
+    mutation safe (see module docstring).
+    """
+
+    __slots__ = (
+        "name",
+        "vjp_fn",
+        "parents",
+        "out_structs",
+        "out_treedef",
+        "fun",
+        "flat_const",
+        "treedef",
+        "diff_idx",
+        "n_outs",
+    )
+
+    def __init__(self, name, vjp_fn, parents, out_structs, out_treedef=None,
+                 fun=None, flat_const=None, treedef=None, diff_idx=None):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.parents = parents  # list[(NDArray, Node|None, out_idx_in_that_node)]
+        self.out_structs = out_structs  # list[jax.ShapeDtypeStruct] (flat)
+        self.out_treedef = out_treedef  # pytree structure of the op's output
+        self.n_outs = len(out_structs)
+        # Retained only to support create_graph=True (higher-order):
+        self.fun = fun
+        self.flat_const = flat_const
+        self.treedef = treedef
+        self.diff_idx = diff_idx
+
+
+def _is_nd(x):
+    return _ndarray_cls is not None and isinstance(x, _ndarray_cls)
+
+
+def _is_float(data):
+    return jnp.issubdtype(data.dtype, jnp.floating) or jnp.issubdtype(
+        data.dtype, jnp.complexfloating
+    )
+
+
+def _attached(arr):
+    """Does gradient need to flow into this array? (tape node, or grad leaf)"""
+    return arr._node is not None or (arr._grad is not None and arr._grad_req != "null")
+
+
+def invoke(fun, args, kwargs=None, name=None, differentiable=True, wrap=True):
+    """Dispatch ``fun`` (a pure function over jax arrays) imperatively.
+
+    ``args``/``kwargs`` may contain NDArrays anywhere in their pytree
+    structure.  When the tape is recording and any float NDArray input is
+    attached, the call is executed under ``jax.vjp`` and a Node is recorded.
+    """
+    kwargs = kwargs or {}
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_nd)
+    nd_idx = [i for i, leaf in enumerate(leaves) if _is_nd(leaf)]
+    datas = list(leaves)
+    ctx = None
+    for i in nd_idx:
+        arr = leaves[i]
+        datas[i] = arr._data
+        if ctx is None:
+            ctx = arr._ctx
+
+    record = (
+        differentiable
+        and _state.recording
+        and any(_attached(leaves[i]) and _is_float(datas[i]) for i in nd_idx)
+    )
+
+    if not record:
+        a, kw = jax.tree_util.tree_unflatten(treedef, datas)
+        out = fun(*a, **kw)
+        return _wrap_out(out, ctx, None, name) if wrap else out
+
+    diff_idx = [i for i in nd_idx if _attached(leaves[i]) and _is_float(datas[i])]
+    flat_const = list(datas)
+
+    def flat_fun(*diff_datas):
+        full = list(flat_const)
+        for i, d in zip(diff_idx, diff_datas):
+            full[i] = d
+        a, kw = jax.tree_util.tree_unflatten(treedef, full)
+        return fun(*a, **kw)
+
+    out, vjp_fn = jax.vjp(flat_fun, *[datas[i] for i in diff_idx])
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    parents = [
+        (leaves[i], leaves[i]._node, getattr(leaves[i], "_node_idx", 0))
+        for i in diff_idx
+    ]
+    structs = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]
+    node = Node(
+        name or getattr(fun, "__name__", "op"),
+        vjp_fn,
+        parents,
+        structs,
+        out_treedef=out_treedef,
+        fun=fun,
+        flat_const=flat_const,
+        treedef=treedef,
+        diff_idx=diff_idx,
+    )
+    return _wrap_out(out, ctx, node, name) if wrap else out
+
+
+def _wrap_out(out, ctx, node, name):
+    from ..context import current_context
+
+    cls = _ndarray_cls
+    if ctx is None:
+        ctx = current_context()
+
+    counter = [0]
+
+    def wrap_leaf(x):
+        idx = counter[0]
+        counter[0] += 1
+        if not _is_jax_array(x):
+            return x
+        arr = cls(x, ctx=ctx)
+        if node is not None:
+            arr._node = node
+            arr._node_idx = idx
+        return arr
+
+    if isinstance(out, (jax.Array, onp.ndarray)) or not isinstance(
+        out, (tuple, list, dict)
+    ):
+        return wrap_leaf(out) if _is_jax_array(out) else out
+    return jax.tree_util.tree_map(wrap_leaf, out)
+
+
+def _is_jax_array(x):
+    return isinstance(x, (jax.Array, onp.ndarray)) or (
+        hasattr(x, "shape") and hasattr(x, "dtype") and not isinstance(x, Node)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Backward pass (reference: `Imperative::Backward`, imperative.cc:385)
+# ---------------------------------------------------------------------------
+
+
+def _collect_graph(head_nodes):
+    """Reachable nodes + consumer counts (edges node -> parent node)."""
+    nodes = set()
+    consumers = defaultdict(int)
+    stack = list(head_nodes)
+    while stack:
+        n = stack.pop()
+        if n in nodes:
+            continue
+        nodes.add(n)
+        for _arr, pnode, _idx in n.parents:
+            if pnode is not None:
+                consumers[pnode] += 1
+                stack.append(pnode)
+    return nodes, consumers
+
+
+def backward(heads, head_grads=None, retain_graph=False, create_graph=False):
+    """Run reverse-mode from ``heads``, writing into leaf ``.grad`` buffers.
+
+    Matches `python/mxnet/autograd.py:245` semantics: ``grad_req='write'``
+    overwrites, ``'add'`` accumulates across backward calls; multiple
+    contributions within one backward always sum.
+    """
+    _accumulate_and_write(
+        heads, head_grads, retain_graph, create_graph, variables=None
+    )
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False):
+    """Gradients w.r.t. ``variables`` returned (not written to ``.grad``).
+
+    Reference: `python/mxnet/autograd.py:272`.
+    """
+    if retain_graph is None:
+        retain_graph = create_graph
+    return _accumulate_and_write(
+        heads, head_grads, retain_graph, create_graph, variables=variables
+    )
+
+
+def _accumulate_and_write(heads, head_grads, retain_graph, create_graph,
+                          variables):
+    cls = _ndarray_cls
+    if isinstance(heads, cls):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, cls):
+        head_grads = [head_grads]
+    assert len(heads) == len(head_grads)
+
+    # cotangents per node, indexed by output slot
+    node_cts = {}
+    leaf_grads = {}  # id(arr) -> (arr, accumulated cotangent)
+
+    def add_leaf(arr, ct):
+        key = id(arr)
+        if key in leaf_grads:
+            prev = leaf_grads[key][1]
+            leaf_grads[key] = (arr, _add_ct(prev, ct))
+        else:
+            leaf_grads[key] = (arr, ct)
+
+    def add_node_ct(node, idx, ct):
+        cts = node_cts.setdefault(node, [None] * node.n_outs)
+        cts[idx] = ct if cts[idx] is None else _add_ct(cts[idx], ct)
+
+    head_nodes = []
+    for h, hg in zip(heads, head_grads):
+        if hg is None:
+            hg_data = jnp.ones(h.shape, h.dtype)
+        else:
+            hg_data = hg._data if isinstance(hg, cls) else jnp.asarray(hg)
+        if h._node is not None:
+            add_node_ct(h._node, h._node_idx, hg_data)
+            head_nodes.append(h._node)
+        elif _attached(h):
+            add_leaf(h, hg_data)
+
+    if not head_nodes and variables is None and not leaf_grads:
+        raise ValueError(
+            "cannot differentiate: none of the heads is in a recorded graph "
+            "(did you forget autograd.record()?)"
+        )
+
+    nodes, consumers = _collect_graph(set(head_nodes))
+    # Kahn order: a node is ready when all its consumers have propagated.
+    ready = [n for n in set(head_nodes)]
+    pending = {n: c for n, c in consumers.items()}
+    processed = set()
+    while ready:
+        node = ready.pop()
+        if node in processed:
+            continue
+        processed.add(node)
+        cts = node_cts.pop(node, None)
+        if cts is None:
+            cts = [None] * node.n_outs
+        full_cts = [
+            ct if ct is not None else jnp.zeros(s.shape, s.dtype)
+            for ct, s in zip(cts, node.out_structs)
+        ]
+        in_grads = _node_vjp(node, full_cts, create_graph)
+        for (arr, pnode, pidx), g in zip(node.parents, in_grads):
+            if pnode is not None:
+                add_node_ct(pnode, pidx, g)
+                pending[pnode] -= 1
+                if pending[pnode] == 0:
+                    ready.append(pnode)
+            else:
+                add_leaf(arr, g)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.fun = None
+            node.flat_const = None
+
+    if variables is not None:
+        out = []
+        for v in variables:
+            entry = leaf_grads.get(id(v))
+            g = entry[1] if entry is not None else jnp.zeros(v.shape, v.dtype)
+            out.append(_as_nd(g, v._ctx, create_graph))
+        return out
+
+    # write into .grad honoring grad_req
+    for arr, g in leaf_grads.values():
+        if arr._grad is None or arr._grad_req == "null":
+            continue
+        g_nd = _as_nd(g, arr._ctx, create_graph)
+        if arr._grad_req == "add":
+            arr._grad._rebind((arr._grad._data + _raw(g_nd)))
+        else:
+            arr._grad._rebind(_raw(g_nd))
+    return None
+
+
+def _raw(x):
+    return x._data if _is_nd(x) else x
+
+
+def _as_nd(g, ctx, keep_node=False):
+    if _is_nd(g):
+        return g
+    arr = _ndarray_cls(g, ctx=ctx)
+    return arr
+
+
+def _add_ct(a, b):
+    if _is_nd(a) or _is_nd(b):
+        return invoke(jnp.add, (a, b), name="_backward_add")
+    return a + b
+
+
+def _node_vjp(node, cotangents, create_graph):
+    """Apply the node's vjp.  With create_graph, re-derive it through invoke
+    so the backward computation is itself recorded (higher-order grads;
+    reference: `create_graph` in `python/mxnet/autograd.py:272`)."""
+    if node.out_treedef is not None:
+        ct = jax.tree_util.tree_unflatten(node.out_treedef, list(cotangents))
+    else:
+        ct = tuple(cotangents)
+        if len(node.out_structs) == 1:
+            ct = ct[0]
+    if not create_graph:
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "graph has been freed; pass retain_graph=True to backward() "
+                "to call it twice"
+            )
+        return node.vjp_fn(ct)
+
+    # Recompute vjp under the tape: inputs are the parent arrays (possibly
+    # themselves recorded), so second-order chains connect.
+    fun, flat_const, treedef, diff_idx = (
+        node.fun, node.flat_const, node.treedef, node.diff_idx,
+    )
+    if fun is None:
+        raise RuntimeError("graph has been freed; use retain_graph=True")
+
+    def bwd(*xs_and_ct):
+        xs = xs_and_ct[: len(diff_idx)]
+        ct_in = xs_and_ct[len(diff_idx):]
+        if node.out_treedef is not None:
+            ct_val = jax.tree_util.tree_unflatten(node.out_treedef, list(ct_in))
+        else:
+            ct_val = ct_in[0] if len(node.out_structs) == 1 else tuple(ct_in)
+
+        def flat_fun(*diff_datas):
+            full = list(flat_const)
+            for i, d in zip(diff_idx, diff_datas):
+                full[i] = d
+            a, kw = jax.tree_util.tree_unflatten(treedef, full)
+            return fun(*a, **kw)
+
+        _out, vjp_fn = jax.vjp(flat_fun, *xs)
+        return vjp_fn(ct_val)
+
+    inputs = [arr for arr, _pn, _pi in node.parents]
+    ct_list = list(cotangents)
+    res = invoke(bwd, tuple(inputs) + tuple(ct_list), name=f"_backward_{node.name}")
+    if not isinstance(res, (tuple, list)):
+        res = (res,)
+    return res

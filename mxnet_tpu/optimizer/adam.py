@@ -1,0 +1,217 @@
+"""Adam-family optimizers.
+
+Reference: `python/mxnet/optimizer/adam.py` (+ adamax, nadam, lamb, lans)
+backed by `adam_update` / `lamb_update_phase1/2` kernels in
+`src/operator/optimizer_op.cc`.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer, register
+from ..numpy import zeros_like
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.correct_bias = correct_bias
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight, dtype="float32"),
+                zeros_like(weight, dtype="float32"))
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        mean, var = states
+        if self.correct_bias:
+            # jnp (not math) so t may be a traced scalar in the fused path
+            coef1 = 1.0 - self.beta1 ** t
+            coef2 = 1.0 - self.beta2 ** t
+            lr = lr * jnp.sqrt(coef2) / coef1
+        g = grad + wd * w32
+        new_mean = self.beta1 * mean + (1 - self.beta1) * g
+        new_var = self.beta2 * var + (1 - self.beta2) * jnp.square(g)
+        new_w = w32 - lr * new_mean / (jnp.sqrt(new_var) + self.epsilon)
+        return new_w.astype(weight.dtype), (new_mean, new_var)
+
+
+@register
+class AdamW(Optimizer):
+    """Decoupled weight decay (reference contrib adamw_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.correct_bias = correct_bias
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight, dtype="float32"),
+                zeros_like(weight, dtype="float32"))
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        mean, var = states
+        new_mean = self.beta1 * mean + (1 - self.beta1) * grad
+        new_var = self.beta2 * var + (1 - self.beta2) * jnp.square(grad)
+        m_hat, v_hat = new_mean, new_var
+        if self.correct_bias:
+            m_hat = new_mean / (1 - self.beta1 ** t)
+            v_hat = new_var / (1 - self.beta2 ** t)
+        new_w = w32 - lr * (m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * w32)
+        return new_w.astype(weight.dtype), (new_mean, new_var)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight, dtype="float32"),
+                zeros_like(weight, dtype="float32"))
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        mean, inf_norm = states
+        lr = lr / (1 - self.beta1 ** t)
+        g = grad + wd * w32
+        new_mean = self.beta1 * mean + (1 - self.beta1) * g
+        new_inf = jnp.maximum(self.beta2 * inf_norm, jnp.abs(g))
+        new_w = w32 - lr * new_mean / (new_inf + 1e-8)
+        return new_w.astype(weight.dtype), (new_mean, new_inf)
+
+
+@register
+class Nadam(Optimizer):
+    supports_fused = False  # mutates host-side m_schedule per step
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight, dtype="float32"),
+                zeros_like(weight, dtype="float32"))
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        mean, var = states
+        g = grad + wd * w32
+        momentum_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1 - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        g_prime = g / (1 - self.m_schedule)
+        new_mean = self.beta1 * mean + (1 - self.beta1) * g
+        new_var = self.beta2 * var + (1 - self.beta2) * jnp.square(g)
+        m_prime = new_mean / (1 - m_schedule_next)
+        v_prime = new_var / (1 - self.beta2 ** t)
+        m_bar = (1 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        new_w = w32 - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon)
+        return new_w.astype(weight.dtype), (new_mean, new_var)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments (reference `lamb.py`,
+    `lamb_update_phase1/2` in optimizer_op.cc) — the BERT-pretraining
+    optimizer from BASELINE.json config 4."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight, dtype="float32"),
+                zeros_like(weight, dtype="float32"))
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        mean, var = states
+        new_mean = self.beta1 * mean + (1 - self.beta1) * grad
+        new_var = self.beta2 * var + (1 - self.beta2) * jnp.square(grad)
+        if self.bias_correction:
+            m_hat = new_mean / (1 - self.beta1 ** t)
+            v_hat = new_var / (1 - self.beta2 ** t)
+        else:
+            m_hat, v_hat = new_mean, new_var
+        g = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * w32
+        r1 = jnp.linalg.norm(w32)
+        if self.lower_bound is not None:
+            r1 = jnp.maximum(r1, self.lower_bound)
+        if self.upper_bound is not None:
+            r1 = jnp.minimum(r1, self.upper_bound)
+        r2 = jnp.linalg.norm(g)
+        ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+        new_w = w32 - lr * ratio * g
+        return new_w.astype(weight.dtype), (new_mean, new_var)
+
+
+@register
+class LANS(Optimizer):
+    """LAMB with normalized gradients (reference `lans.py`)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight, dtype="float32"),
+                zeros_like(weight, dtype="float32"))
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        mean, var = states
+        g_norm = jnp.linalg.norm(grad)
+        grad_n = jnp.where(g_norm > 0, grad / g_norm, grad)
+        new_mean = self.beta1 * mean + (1 - self.beta1) * grad_n
+        new_var = self.beta2 * var + (1 - self.beta2) * jnp.square(grad_n)
+        m_hat = new_mean / (1 - self.beta1 ** t)
+        v_hat = new_var / (1 - self.beta2 ** t)
+        r1 = jnp.linalg.norm(w32)
+        # phase 1: momentum direction
+        d1 = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * w32
+        ratio1 = jnp.where((r1 > 0) & (jnp.linalg.norm(d1) > 0),
+                           r1 / jnp.linalg.norm(d1), 1.0)
+        # phase 2: gradient direction
+        d2 = grad_n / (jnp.sqrt(v_hat) + self.epsilon) + wd * w32
+        ratio2 = jnp.where((r1 > 0) & (jnp.linalg.norm(d2) > 0),
+                           r1 / jnp.linalg.norm(d2), 1.0)
+        new_w = w32 - lr * (self.beta1 * ratio1 * d1 +
+                            (1 - self.beta1) * ratio2 * d2)
+        return new_w.astype(weight.dtype), (new_mean, new_var)

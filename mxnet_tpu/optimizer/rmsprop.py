@@ -1,0 +1,120 @@
+"""RMSProp / AdaGrad / AdaDelta / Ftrl optimizers.
+
+Reference: `python/mxnet/optimizer/{rmsprop,adagrad,adadelta,ftrl}.py` over
+`rmsprop(alex)_update`, `ftrl_update` kernels (`src/operator/optimizer_op.cc`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer, register
+from ..numpy import zeros_like
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho = rho
+        self.momentum = momentum
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros_like(weight, dtype="float32"),
+                    zeros_like(weight, dtype="float32"),
+                    zeros_like(weight, dtype="float32"))
+        return (zeros_like(weight, dtype="float32"),)
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        g = grad + wd * w32
+        if not self.centered:
+            (n,) = states
+            new_n = (1 - self.rho) * jnp.square(g) + self.rho * n
+            new_w = w32 - lr * g / (jnp.sqrt(new_n) + self.epsilon)
+            new_states = (new_n,)
+        else:
+            n, mg, delta = states
+            new_n = (1 - self.rho) * jnp.square(g) + self.rho * n
+            new_mg = (1 - self.rho) * g + self.rho * mg
+            new_delta = self.momentum * delta - \
+                lr * g / jnp.sqrt(new_n - jnp.square(new_mg) + self.epsilon)
+            new_w = w32 + new_delta
+            new_states = (new_n, new_mg, new_delta)
+        if self.clip_weights:
+            new_w = jnp.clip(new_w, -self.clip_weights, self.clip_weights)
+        return new_w.astype(weight.dtype), new_states
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight, dtype="float32"),)
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        (history,) = states
+        g = grad + wd * w32
+        new_hist = history + jnp.square(g)
+        new_w = w32 - lr * g / (jnp.sqrt(new_hist) + self.epsilon)
+        return new_w.astype(weight.dtype), (new_hist,)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight, dtype="float32"),
+                zeros_like(weight, dtype="float32"))
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        acc_g, acc_delta = states
+        g = grad + wd * w32
+        new_acc_g = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta + self.epsilon) / \
+            jnp.sqrt(new_acc_g + self.epsilon) * g
+        new_acc_delta = self.rho * acc_delta + (1 - self.rho) * jnp.square(delta)
+        new_w = w32 - lr * delta
+        return new_w.astype(weight.dtype), (new_acc_g, new_acc_delta)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight, dtype="float32"),
+                zeros_like(weight, dtype="float32"))
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        z, n = states
+        new_n = n + jnp.square(grad)
+        sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+        new_z = z + grad - sigma * w32
+        new_w = jnp.where(
+            jnp.abs(new_z) > self.lamda1,
+            -(new_z - jnp.sign(new_z) * self.lamda1) /
+            ((self.beta + jnp.sqrt(new_n)) / lr + wd),
+            0.0)
+        return new_w.astype(weight.dtype), (new_z, new_n)

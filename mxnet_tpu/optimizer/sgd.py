@@ -1,0 +1,177 @@
+"""SGD-family optimizers.
+
+Reference: `python/mxnet/optimizer/sgd.py` (+ nag.py, signum.py, sgld.py,
+lars.py) backed by the fused kernels in `src/operator/optimizer_op.cc`
+(`sgd_update`, `sgd_mom_update`, `multi_sgd_*`).  The math below matches the
+reference kernels; XLA fuses the elementwise chains into single kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer, register
+from ..numpy import zeros_like
+from .. import random as _rng
+import jax
+
+
+@register
+class SGD(Optimizer):
+    """state = momentum buffer; update matches `sgd_mom_update`
+    (`src/operator/optimizer_op.cc`)::
+
+        mom = momentum*mom - lr*(grad + wd*weight)
+        weight += mom
+    """
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        if lazy_update:
+            # row_sparse lazy updates exist for CPU embedding workloads only;
+            # XLA has no sparse buffers (SURVEY.md §7) — dense is correct.
+            pass
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return (zeros_like(weight),)
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        if self.momentum == 0.0:
+            new_w = w32 - lr * (grad + wd * w32)
+            return new_w.astype(weight.dtype), ()
+        (mom,) = states
+        new_mom = self.momentum * mom - lr * (grad + wd * w32)
+        new_w = w32 + new_mom
+        return new_w.astype(weight.dtype), (new_mom,)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference `nag.py` / `nag_mom_update`)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return (zeros_like(weight),)
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        g = grad + wd * w32
+        if self.momentum == 0.0:
+            return (w32 - lr * g).astype(weight.dtype), ()
+        (mom,) = states
+        new_mom = self.momentum * mom + g
+        new_w = w32 - lr * (g + self.momentum * new_mom)
+        return new_w.astype(weight.dtype), (new_mom,)
+
+
+@register
+class Signum(Optimizer):
+    """SignSGD / Signum (reference `signum.py` / `signsgd_update`)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return (zeros_like(weight),)
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        if self.momentum == 0.0:
+            new_w = (1 - lr * self.wd_lh) * w32 - lr * jnp.sign(grad + wd * w32)
+            return new_w.astype(weight.dtype), ()
+        (mom,) = states
+        new_mom = self.momentum * mom - (1 - self.momentum) * (grad + wd * w32)
+        new_w = (1 - lr * self.wd_lh) * w32 + lr * jnp.sign(new_mom)
+        return new_w.astype(weight.dtype), (new_mom,)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference `sgld.py`)."""
+
+    supports_fused = False  # draws a fresh host-side PRNG key per update
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        key = _rng.new_key()
+        noise = jax.random.normal(key, weight.shape, jnp.float32) * \
+            jnp.sqrt(jnp.asarray(lr, jnp.float32))
+        new_w = w32 - lr / 2 * (grad + wd * w32) + noise
+        return new_w.astype(weight.dtype), ()
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference `lars.py`)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.0, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return (zeros_like(weight),)
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(w32)
+        g_norm = jnp.linalg.norm(grad)
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon),
+            1.0)
+        scaled_lr = lr * trust
+        g = grad + wd * w32
+        if self.momentum == 0.0:
+            return (w32 - scaled_lr * g).astype(weight.dtype), ()
+        (mom,) = states
+        new_mom = self.momentum * mom + scaled_lr * g
+        return (w32 - new_mom).astype(weight.dtype), (new_mom,)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference `dcasgd.py`)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight), weight.copy())
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        mom, prev_w = states
+        g = grad + wd * w32
+        comp = g + self.lamda * g * g * (w32 - prev_w)
+        new_mom = self.momentum * mom - lr * comp
+        new_w = w32 + new_mom
+        return new_w.astype(weight.dtype), (new_mom, new_w)

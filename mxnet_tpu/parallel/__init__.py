@@ -1,0 +1,18 @@
+"""Parallelism over device meshes.
+
+The reference's distributed story is kvstore-based data parallelism plus
+manual per-layer device placement (SURVEY.md §2.3).  The TPU-native build
+gets DP/TP/SP/PP from `jax.sharding` over a Mesh — XLA inserts the
+collectives (psum/all-gather/reduce-scatter) and schedules them over ICI.
+"""
+from .mesh import (
+    make_mesh, current_mesh, mesh_scope, data_sharding, replicated_sharding,
+    match_partition_rules, shard_parameters, constrain,
+)
+from .ring_attention import ring_attention
+
+__all__ = [
+    "make_mesh", "current_mesh", "mesh_scope", "data_sharding",
+    "replicated_sharding", "match_partition_rules", "shard_parameters",
+    "constrain", "ring_attention",
+]

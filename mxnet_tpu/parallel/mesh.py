@@ -1,0 +1,127 @@
+"""Device mesh + sharding helpers.
+
+The TPU-native replacement for the reference's device topology machinery
+(`src/kvstore/gpu_topology.h` builds reduction trees from PCIe/NVLink
+links).  On TPU the topology is the mesh: name the axes (`dp`, `tp`, `sp`,
+`pp`, ...), annotate shardings, and XLA routes collectives over ICI.
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "make_mesh", "current_mesh", "mesh_scope", "data_sharding",
+    "replicated_sharding", "match_partition_rules", "shard_parameters",
+    "constrain", "PartitionSpec",
+]
+
+_state = threading.local()
+
+
+def make_mesh(axes=None, devices=None):
+    """Create a Mesh.  ``axes`` maps axis name -> size; sizes may use -1 once
+    to absorb the remaining devices.  Default: 1-d data-parallel mesh over
+    all devices: ``make_mesh({'dp': -1})``."""
+    devices = devices if devices is not None else jax.devices()
+    if axes is None:
+        axes = {"dp": -1}
+    names = list(axes)
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        sizes[sizes.index(-1)] = n // known
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} "
+                         f"devices, have {n}")
+    dev_array = onp.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def current_mesh():
+    return getattr(_state, "mesh", None)
+
+
+class mesh_scope:
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        self._prev = getattr(_state, "mesh", None)
+        _state.mesh = self.mesh
+        return self.mesh
+
+    def __exit__(self, *_exc):
+        _state.mesh = self._prev
+
+
+def data_sharding(mesh, axis_name="dp"):
+    """Shard the leading (batch) axis over the given mesh axis."""
+    return NamedSharding(mesh, PartitionSpec(axis_name))
+
+
+def replicated_sharding(mesh):
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def match_partition_rules(rules, names_to_shapes):
+    """Map parameter names to PartitionSpecs by regex rules.
+
+    ``rules``: list of (pattern, PartitionSpec); first match wins; scalars
+    and unmatched params are replicated.
+    """
+    out = {}
+    for name, shape in names_to_shapes.items():
+        if len(shape) == 0 or int(onp.prod(shape)) == 1:
+            out[name] = PartitionSpec()
+            continue
+        spec = PartitionSpec()
+        for pattern, ps in rules:
+            if re.search(pattern, name):
+                spec = ps
+                break
+        out[name] = spec
+    return out
+
+
+def shard_parameters(params, mesh, rules=None):
+    """Place Gluon Parameters onto the mesh.
+
+    ``params``: dict name -> Parameter.  Each parameter's array is re-placed
+    with a NamedSharding; replicated unless a rule matches.  This is the
+    TPU analogue of `kvstore.broadcast` of initial params
+    (`python/mxnet/gluon/trainer.py:164-174`).
+    """
+    specs = match_partition_rules(
+        rules or [], {k: p.shape for k, p in params.items()})
+    for name, p in params.items():
+        sharding = NamedSharding(mesh, specs[name])
+        arr = p.data()
+        arr._rebind(jax.device_put(arr._data, sharding))
+    return specs
+
+
+def constrain(x, mesh, spec):
+    """`with_sharding_constraint` over NDArrays (usable inside hybridized
+    forwards to steer XLA's sharding propagation)."""
+    from ..ndarray.ndarray import NDArray
+    from ..ops.invoke import invoke
+
+    sharding = NamedSharding(mesh, spec) if not isinstance(
+        spec, NamedSharding) else spec
+
+    def f(d):
+        return jax.lax.with_sharding_constraint(d, sharding)
+
+    return invoke(f, (x,), name="sharding_constraint")

@@ -1,0 +1,96 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+The reference has **no** sequence parallelism (SURVEY.md §5.7: long sequences
+are handled only by the cuDNN RNN op and bucketing).  The TPU build makes
+long-context first-class: the sequence axis is sharded over a mesh axis
+(``sp``), each device holds a Q/K/V block, and K/V blocks rotate around the
+ring via ``ppermute`` while a blockwise (online-softmax) accumulator keeps
+the attention numerically exact — compute on the current block overlaps the
+ICI transfer of the next (Liu et al., "Ring Attention with Blockwise
+Transformers", 2023; see PAPERS.md).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+__all__ = ["ring_attention", "ring_attention_local"]
+
+
+def ring_attention_local(q, k, v, axis_name, causal=False, scale=None):
+    """Per-shard body (runs under shard_map).
+
+    q/k/v: (B, H, T_local, D) — the local sequence block.  Returns the exact
+    attention output for the local queries against the *global* key/value
+    sequence.
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, t_q, d = q.shape
+    t_k = k.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+
+    q32 = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        # block that currently lives here started at ring position my_idx - i
+        src_idx = (my_idx - i) % axis_size
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_cur.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = my_idx * t_q + jnp.arange(t_q)
+            k_pos = src_idx * t_k + jnp.arange(t_k)
+            mask = k_pos[None, :] > q_pos[:, None]
+            s = jnp.where(mask[None, None], -jnp.inf, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # guard fully-masked rows (all -inf) against NaN
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        correction = jnp.exp(jnp.where(jnp.isneginf(m), m_safe, m) - m_safe)
+        correction = jnp.where(jnp.isneginf(m), 0.0, correction)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        acc_new = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_next, v_next), None
+
+    m0 = jnp.full((b, h, t_q), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t_q), jnp.float32)
+    acc0 = jnp.zeros((b, h, t_q, d), jnp.float32)
+    (m, l, acc, _k, _v), _ = lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(axis_size))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
+                   batch_axis=None):
+    """Sharded entry point: q/k/v are global (B, H, T, D) arrays whose T axis
+    is (to be) sharded over ``axis_name``; returns attention output with the
+    same sharding.  Accepts NDArrays or jax arrays."""
+    from ..ndarray.ndarray import NDArray
+    from ..ops.invoke import invoke
+
+    spec = P(batch_axis, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    if isinstance(q, NDArray):
+        return invoke(fn, (q, k, v), name="ring_attention")
+    return fn(q, k, v)

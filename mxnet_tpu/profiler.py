@@ -1,0 +1,207 @@
+"""Profiler.
+
+Reference: `src/profiler/profiler.h:251` + `python/mxnet/profiler.py` —
+chrome://tracing JSON dumps, aggregate stat tables, user Domains/Tasks/
+Frames/Events/Counters wired into every engine OprBlock.
+
+TPU-native design: compiled-program timing comes from the XLA/jax profiler
+(TensorBoard-compatible traces, `jax.profiler.start_trace`); this module
+keeps the reference's python API surface and additionally records host-side
+scopes into a chrome-trace JSON so `dump()` behaves as before.  The two can
+be combined: `set_config(profile_all=True, xla_trace_dir=...)`.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import jax
+
+__all__ = [
+    "set_config", "set_state", "state", "dump", "dumps", "pause", "resume",
+    "Domain", "Task", "Frame", "Event", "Counter", "Marker", "scope",
+]
+
+_lock = threading.Lock()
+_config = {"filename": "profile.json", "xla_trace_dir": None}
+_running = False
+_events = []
+_t0 = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _t0) * 1e6
+
+
+def set_config(**kwargs):
+    _config.update(kwargs)
+
+
+def set_state(state_name="stop", profile_process="worker"):
+    global _running
+    if state_name == "run":
+        _running = True
+        if _config.get("xla_trace_dir"):
+            jax.profiler.start_trace(_config["xla_trace_dir"])
+    elif state_name == "stop":
+        if _running and _config.get("xla_trace_dir"):
+            jax.profiler.stop_trace()
+        _running = False
+    else:
+        raise ValueError("state must be 'run' or 'stop'")
+
+
+def state():
+    return "run" if _running else "stop"
+
+
+def pause(profile_process="worker"):
+    global _running
+    _running = False
+
+
+def resume(profile_process="worker"):
+    global _running
+    _running = True
+
+
+def _emit(name, cat, ph, ts, args=None, dur=None):
+    ev = {"name": name, "cat": cat, "ph": ph, "ts": ts, "pid": 0,
+          "tid": threading.get_ident() % 100000}
+    if args:
+        ev["args"] = args
+    if dur is not None:
+        ev["dur"] = dur
+    with _lock:
+        _events.append(ev)
+
+
+def dumps(reset=False, format="table"):
+    payload = json.dumps({"traceEvents": list(_events)}, indent=1)
+    if reset:
+        _events.clear()
+    return payload
+
+
+def dump(finished=True, profile_process="worker"):
+    with open(_config["filename"], "w") as f:
+        f.write(dumps())
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_event(self, name):
+        return Event(name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Span:
+    _ph_cat = "task"
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._start = None
+
+    def start(self):
+        self._start = _now_us()
+
+    def stop(self):
+        if self._start is not None and _running:
+            _emit(self.name, self._ph_cat, "X", self._start,
+                  dur=_now_us() - self._start)
+        self._start = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *_exc):
+        self.stop()
+
+
+class Task(_Span):
+    _ph_cat = "task"
+
+
+class Frame(_Span):
+    _ph_cat = "frame"
+
+
+class Event(_Span):
+    def __init__(self, name):
+        super().__init__(None, name)
+    _ph_cat = "event"
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        if _running:
+            _emit(self.name, "counter", "C", _now_us(),
+                  args={self.name: value})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        if _running:
+            _emit(self.name, "marker", "i", _now_us())
+
+
+class scope:
+    """Context manager timing a host-side region (also forwards to the jax
+    profiler's TraceAnnotation so regions show in XLA traces)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._span = Task(None, name)
+        self._jax_ctx = None
+
+    def __enter__(self):
+        self._span.start()
+        self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+        self._jax_ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._jax_ctx.__exit__(*exc)
+        self._span.stop()

@@ -1,0 +1,89 @@
+"""Random state.
+
+Reference: `python/mxnet/random.py` (global + per-context seeding over the
+engine's mshadow PRNG resources, `src/resource.cc:93`).
+
+TPU-native design: JAX randomness is functional (explicit keys).  To keep the
+reference's *stateful* API (`mx.random.seed`, samplers that just work), the
+module keeps a key stream: a root key advanced per draw.  Under ``hybridize``
+tracing, a traced per-call key is pushed onto the stream stack so compiled
+programs get fresh randomness every call instead of a baked-in constant (the
+trace-time analogue of the reference handing each op an engine RNG resource).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["seed", "new_key", "key_stream_scope", "uniform", "normal", "randint"]
+
+
+class _KeyState(threading.local):
+    def __init__(self):
+        self.root = jax.random.key(0)
+        self.counter = 0
+        self.stack = []  # traced KeyStream scopes
+
+
+_state = _KeyState()
+
+
+class KeyStream:
+    """Deterministic stream of subkeys split from a base key."""
+
+    def __init__(self, base_key):
+        self.base = base_key
+        self.n = 0
+
+    def next(self):
+        self.n += 1
+        return jax.random.fold_in(self.base, self.n)
+
+
+def seed(seed_state, ctx="all"):
+    """Reference: `python/mxnet/random.py` `seed()`; ctx kept for API compat
+    (XLA PRNG is device-independent so per-context seeding is a no-op)."""
+    _state.root = jax.random.key(int(seed_state))
+    _state.counter = 0
+
+
+def new_key():
+    """Next PRNG key: from the innermost traced stream if one is active
+    (hybridize), else by advancing the global stateful stream."""
+    if _state.stack:
+        return _state.stack[-1].next()
+    _state.counter += 1
+    return jax.random.fold_in(_state.root, _state.counter)
+
+
+class key_stream_scope:
+    """Push a traced base key for the duration of a trace (used by
+    HybridBlock's compiled path)."""
+
+    def __init__(self, base_key):
+        self.stream = KeyStream(base_key)
+
+    def __enter__(self):
+        _state.stack.append(self.stream)
+        return self.stream
+
+    def __exit__(self, *_exc):
+        _state.stack.pop()
+
+
+# Stateful sampler shims (the full zoo lives in mxnet_tpu.numpy.random).
+def uniform(low=0, high=1, shape=(), dtype=None, ctx=None, out=None, device=None):
+    from .numpy import random as nprandom
+    return nprandom.uniform(low, high, size=shape, dtype=dtype, ctx=ctx or device, out=out)
+
+
+def normal(loc=0, scale=1, shape=(), dtype=None, ctx=None, out=None, device=None):
+    from .numpy import random as nprandom
+    return nprandom.normal(loc, scale, size=shape, dtype=dtype, ctx=ctx or device, out=out)
+
+
+def randint(low, high=None, shape=(), dtype=None, ctx=None, out=None, device=None):
+    from .numpy import random as nprandom
+    return nprandom.randint(low, high, size=shape, dtype=dtype, ctx=ctx or device, out=out)

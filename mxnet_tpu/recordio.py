@@ -1,0 +1,175 @@
+"""RecordIO file format.
+
+Reference: `python/mxnet/recordio.py` over dmlc-core's recordio streams.
+Binary-compatible with the reference format: records framed as
+``[kMagic:u32][(cflag<<29|len):u32][payload][pad to 4B]`` with
+``kMagic = 0xced7230a`` (dmlc/recordio.h), and the `IRHeader` image header
+(`pack_img`-style) packed as ``[flag:u32][label:f32][id:u64][id2:u64]``.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as onp
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "unpack_img", "pack_img"]
+
+_kMagic = 0xCED7230A
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.fp = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fp = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fp = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+
+    def close(self):
+        if self.fp is not None:
+            self.fp.close()
+            self.fp = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["fp"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def _check_pid(self):
+        # reopen after fork, as the reference does
+        if self.pid != os.getpid():
+            self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.fp.tell()
+
+    def write(self, buf):
+        assert self.writable
+        self._check_pid()
+        length = len(buf)
+        self.fp.write(struct.pack("<II", _kMagic, length))
+        self.fp.write(buf)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.fp.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        self._check_pid()
+        header = self.fp.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        assert magic == _kMagic, "invalid record magic"
+        length = lrec & ((1 << 29) - 1)
+        buf = self.fp.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.fp.read(pad)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.fp is not None:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid()
+        self.fp.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header, s):
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        packed = struct.pack(_IR_FORMAT, 0, header.label, header.id, header.id2)
+    else:
+        label = onp.asarray(header.label, dtype=onp.float32)
+        packed = struct.pack(_IR_FORMAT, label.size, 0.0, header.id,
+                             header.id2) + label.tobytes()
+    return packed + s
+
+
+def unpack(s):
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = onp.frombuffer(s[:header.flag * 4], dtype=onp.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=1):
+    header, s = unpack(s)
+    from .image import imdecode
+    img = imdecode(s, flag=iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    from .image import imencode
+    return pack(header, imencode(img, img_fmt, quality))

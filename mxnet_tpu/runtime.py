@@ -1,0 +1,58 @@
+"""Runtime feature detection.
+
+Reference: `python/mxnet/runtime.py` backed by `src/libinfo.cc` (build-flag
+introspection).  The TPU build's features reflect the JAX backend state at
+runtime instead of compile-time CMake flags.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"✔ {self.name}" if self.enabled else f"✖ {self.name}"
+
+
+def _detect():
+    platforms = {d.platform for d in jax.devices()}
+    feats = {
+        "TPU": "tpu" in platforms,
+        "CUDA": "gpu" in platforms,
+        "CUDNN": False,
+        "NCCL": False,
+        "TPU_ICI": "tpu" in platforms,
+        "XLA": True,
+        "PALLAS": True,
+        "BLAS_OPEN": True,
+        "MKLDNN": False,
+        "OPENMP": False,
+        "DIST_KVSTORE": jax.process_count() > 1,
+        "INT64_TENSOR_SIZE": True,
+        "SIGNAL_HANDLER": False,
+        "PROFILER": True,
+        "BF16": True,
+    }
+    return {k: Feature(k, v) for k, v in feats.items()}
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__(_detect())
+
+    def is_enabled(self, name):
+        feat = self.get(name.upper())
+        return bool(feat and feat.enabled)
+
+    def __repr__(self):
+        return "[" + ", ".join(map(repr, self.values())) + "]"
+
+
+def feature_list():
+    return list(Features().values())

@@ -1,0 +1,93 @@
+"""Utility scopes and decorators.
+
+Reference: `python/mxnet/util.py` (np-shape / np-array thread-local scopes).
+The TPU rebuild is natively NumPy-semantics (there is no legacy 1.x shape
+system to toggle away from), so these are compatibility shims that keep user
+code importable: `set_np()`/`use_np` are no-ops that record the flag.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+_state = threading.local()
+
+
+def _flags():
+    if not hasattr(_state, "np_shape"):
+        _state.np_shape = True
+        _state.np_array = True
+        _state.np_default_dtype = False
+    return _state
+
+
+def set_np(shape=True, array=True, dtype=False):
+    f = _flags()
+    f.np_shape, f.np_array, f.np_default_dtype = shape, array, dtype
+
+
+def reset_np():
+    set_np(True, True, False)
+
+
+def is_np_shape():
+    return _flags().np_shape
+
+
+def is_np_array():
+    return _flags().np_array
+
+
+def is_np_default_dtype():
+    return _flags().np_default_dtype
+
+
+def set_np_shape(active=True):
+    _flags().np_shape = active
+    return True
+
+
+def use_np(func):
+    """Decorator kept for API compat; numpy semantics are always on."""
+    if isinstance(func, type):
+        return func
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        return func(*args, **kwargs)
+
+    return wrapper
+
+
+use_np_shape = use_np
+use_np_array = use_np
+
+
+def np_shape(active=True):
+    class _Scope:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *_):
+            return False
+
+    return _Scope()
+
+
+np_array = np_shape
+
+
+def get_gpu_count():
+    from .context import num_gpus
+    return num_gpus()
+
+
+def getenv(name):
+    import os
+    v = os.environ.get(name)
+    return v
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    from .numpy import array
+    return array(source_array, ctx=ctx, dtype=dtype)

@@ -1,0 +1,1 @@
+from .serialization import save_ndarrays, load_ndarrays, NDARRAY_MAGIC  # noqa: F401

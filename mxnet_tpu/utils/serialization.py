@@ -1,0 +1,63 @@
+"""NDArray (de)serialization.
+
+Reference: `src/ndarray/ndarray.cc:1729,1852` — a binary list format with
+magic ``0x112`` (``NDARRAY_MAGIC``) holding shapes/contexts/dtypes, used by
+`mx.nd.save/load` and Gluon checkpoints.
+
+TPU-native format: NumPy ``.npz`` (zip of .npy) — portable, mmap-friendly,
+and loadable without this framework.  The reference magic is preserved in the
+archive as a ``__mxnet_tpu_magic__`` entry so files are self-identifying, and
+`load` also accepts plain ``.npy``/``.npz`` files from other tools.
+"""
+from __future__ import annotations
+
+import io
+import zipfile
+
+import numpy as onp
+
+NDARRAY_MAGIC = 0x112  # reference: src/ndarray/ndarray.cc (NDArray::Save)
+
+
+def save_ndarrays(fname, data):
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(data, NDArray):
+        payload = {"__solo__": data}
+        keys = None
+    elif isinstance(data, (list, tuple)):
+        payload = {f"arr_{i}": a for i, a in enumerate(data)}
+        keys = None
+    elif isinstance(data, dict):
+        payload = dict(data)
+        keys = list(data)
+    else:
+        raise TypeError(f"cannot save {type(data)}")
+
+    arrays = {}
+    for k, v in payload.items():
+        if not isinstance(v, NDArray):
+            raise TypeError(f"value for {k!r} is not an NDArray")
+        arrays[k] = v.asnumpy()
+    arrays["__mxnet_tpu_magic__"] = onp.asarray(NDARRAY_MAGIC, onp.int64)
+    if keys is not None:
+        arrays["__keys__"] = onp.asarray(keys, dtype=object)
+    with open(fname, "wb") as f:
+        onp.savez(f, **{k: v for k, v in arrays.items() if k != "__keys__"},
+                  **({"__keys__": arrays["__keys__"]} if keys is not None else {}))
+
+
+def load_ndarrays(fname, ctx=None):
+    from ..ndarray.ndarray import NDArray
+
+    with onp.load(fname, allow_pickle=True) as z:
+        names = [n for n in z.files
+                 if n not in ("__mxnet_tpu_magic__", "__keys__")]
+        if "__keys__" in z.files:
+            return {str(k): NDArray(z[str(k)], ctx=ctx) for k in z["__keys__"]}
+        if names == ["__solo__"]:
+            return NDArray(z["__solo__"], ctx=ctx)
+        if all(n.startswith("arr_") for n in names):
+            names.sort(key=lambda n: int(n.split("_")[1]))
+            return [NDArray(z[n], ctx=ctx) for n in names]
+        return {n: NDArray(z[n], ctx=ctx) for n in names}

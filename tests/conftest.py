@@ -1,0 +1,36 @@
+"""Test configuration.
+
+Mirrors the reference's conftest strategy (`conftest.py:61-119`): seeded RNG
+per test for reproducibility and a drain between modules to localize async
+failures.  Tests run on a virtual 8-device CPU mesh so multi-chip sharding
+paths execute without TPU hardware (the driver separately dry-runs the
+multichip path; see `__graft_entry__.py`).
+"""
+import os
+
+# must be set before jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as onp  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng(request):
+    seed = onp.random.randint(0, 2 ** 31)
+    module_seed = int(os.environ.get("MXNET_TPU_TEST_SEED", seed))
+    onp.random.seed(module_seed)
+    import mxnet_tpu as mx
+    mx.random.seed(module_seed)
+    yield
+    # drain async work so failures localize to the test that caused them
+    # (reference: conftest.py waitall between modules)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "seed: fixed-seed test")
+    config.addinivalue_line("markers", "serial: serial-only test")

@@ -1,0 +1,168 @@
+"""NDArray basics (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    x = mx.np.ones((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == onp.float32
+    assert x.size == 6
+    assert x.ndim == 2
+    y = mx.np.array([[1, 2], [3, 4]], dtype="int32")
+    assert y.dtype == onp.int32
+    z = mx.np.array([1.0, 2.0])
+    assert z.dtype == onp.float32  # python lists default to f32
+
+
+def test_creation_ops():
+    assert mx.np.zeros((3,)).asnumpy().tolist() == [0, 0, 0]
+    assert mx.np.full((2,), 7.0).asnumpy().tolist() == [7, 7]
+    assert mx.np.arange(3).asnumpy().tolist() == [0, 1, 2]
+    assert mx.np.eye(2).asnumpy().tolist() == [[1, 0], [0, 1]]
+    assert mx.np.linspace(0, 1, 3).asnumpy().tolist() == [0, 0.5, 1]
+
+
+def test_arithmetic():
+    a = mx.np.array([1.0, 2.0, 3.0])
+    b = mx.np.array([4.0, 5.0, 6.0])
+    assert_almost_equal(a + b, onp.array([5, 7, 9]))
+    assert_almost_equal(a - b, onp.array([-3, -3, -3]))
+    assert_almost_equal(a * b, onp.array([4, 10, 18]))
+    assert_almost_equal(b / a, onp.array([4, 2.5, 2]))
+    assert_almost_equal(a ** 2, onp.array([1, 4, 9]))
+    assert_almost_equal(2 + a, onp.array([3, 4, 5]))
+    assert_almost_equal(2 - a, onp.array([1, 0, -1]))
+    assert_almost_equal(-a, onp.array([-1, -2, -3]))
+    assert_almost_equal(abs(-a), onp.array([1, 2, 3]))
+
+
+def test_inplace_ops():
+    a = mx.np.array([1.0, 2.0])
+    aid = id(a)
+    a += 1
+    assert id(a) == aid
+    assert a.asnumpy().tolist() == [2, 3]
+    a *= 2
+    assert a.asnumpy().tolist() == [4, 6]
+    a -= 1
+    a /= 2
+    assert a.asnumpy().tolist() == [1.5, 2.5]
+
+
+def test_comparison():
+    a = mx.np.array([1.0, 2.0, 3.0])
+    b = mx.np.array([2.0, 2.0, 2.0])
+    assert (a < b).asnumpy().tolist() == [True, False, False]
+    assert (a == b).asnumpy().tolist() == [False, True, False]
+    assert (a >= b).asnumpy().tolist() == [False, True, True]
+
+
+def test_indexing():
+    x = mx.np.arange(12).reshape(3, 4)
+    assert x[1, 2].item() == 6
+    assert x[1].asnumpy().tolist() == [4, 5, 6, 7]
+    assert x[:, 1].asnumpy().tolist() == [1, 5, 9]
+    assert x[1:, :2].shape == (2, 2)
+    # boolean mask (eager only, dynamic shape)
+    m = x > 5
+    assert x[m].asnumpy().tolist() == [6, 7, 8, 9, 10, 11]
+    # advanced integer indexing
+    idx = mx.np.array([0, 2], dtype="int32")
+    assert x[idx].shape == (2, 4)
+
+
+def test_setitem():
+    x = mx.np.zeros((3, 3))
+    x[1, 1] = 5.0
+    assert x[1, 1].item() == 5.0
+    x[0] = mx.np.ones((3,))
+    assert x[0].asnumpy().tolist() == [1, 1, 1]
+    x[:, 2] = 7
+    assert x[1, 2].item() == 7
+
+
+def test_shape_ops():
+    x = mx.np.arange(6)
+    assert x.reshape(2, 3).shape == (2, 3)
+    assert x.reshape((3, -1)).shape == (3, 2)
+    assert x.reshape(2, 3).T.shape == (3, 2)
+    assert x.reshape(1, 6).squeeze(0).shape == (6,)
+    assert x.expand_dims(0).shape == (1, 6)
+    assert mx.np.concatenate([x, x]).shape == (12,)
+    assert mx.np.stack([x, x]).shape == (2, 6)
+
+
+def test_reductions():
+    x = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert x.sum().item() == 10
+    assert x.mean().item() == 2.5
+    assert x.max().item() == 4
+    assert x.min(axis=0).asnumpy().tolist() == [1, 2]
+    assert x.argmax(axis=1).asnumpy().tolist() == [1, 1]
+    assert x.prod().item() == 24
+
+
+def test_astype_copy():
+    x = mx.np.array([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == onp.int32
+    z = x.copy()
+    z += 1
+    assert x.asnumpy().tolist() == [1.5, 2.5]
+
+
+def test_context_placement():
+    x = mx.np.ones((2,), ctx=mx.cpu())
+    assert x.ctx == mx.cpu()
+    y = x.as_in_ctx(mx.cpu(1))
+    assert y.ctx == mx.cpu(1)
+    # copyto mutates target
+    z = mx.np.zeros((2,))
+    x.copyto(z)
+    assert z.asnumpy().tolist() == [1, 1]
+
+
+def test_wait_and_version():
+    x = mx.np.ones((2,))
+    v0 = x._version
+    x += 1
+    assert x._version == v0 + 1
+    x.wait_to_read()
+    mx.waitall()
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.npz")
+    a = mx.np.array([1.0, 2.0])
+    b = mx.np.arange(4).reshape(2, 2)
+    mx.npx.save(fname, {"a": a, "b": b})
+    loaded = mx.npx.load(fname)
+    assert set(loaded) == {"a", "b"}
+    assert_almost_equal(loaded["a"], a)
+    mx.npx.save(fname, [a, b])
+    la = mx.npx.load(fname)
+    assert isinstance(la, list) and len(la) == 2
+
+
+def test_numpy_interop():
+    x = mx.np.array([1.0, 2.0])
+    n = onp.asarray(x)
+    assert n.tolist() == [1, 2]
+    assert float(x.sum()) == 3.0
+    assert len(x) == 2
+    assert [float(v) for v in x] == [1.0, 2.0]
+
+
+def test_einsum_and_linalg():
+    a = mx.np.random.normal(0, 1, (3, 4))
+    b = mx.np.random.normal(0, 1, (4, 5))
+    out = mx.np.einsum("ij,jk->ik", a, b)
+    assert_almost_equal(out, a.asnumpy() @ b.asnumpy(), rtol=1e-4, atol=1e-4)
+    sq = mx.np.random.normal(0, 1, (3, 3))
+    inv = mx.np.linalg.inv(sq)
+    assert_almost_equal(mx.np.matmul(sq, inv), onp.eye(3), rtol=1e-3,
+                        atol=1e-3)
