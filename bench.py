@@ -1,0 +1,69 @@
+"""Headline benchmark: ResNet-50 training throughput (img/s) on one chip.
+
+Baseline (BASELINE.md / reference `docs/.../faq/perf.md:254`): MXNet-CUDA
+ResNet-50 fp32 training on V100 at batch 64 ≈ 360 img/s (interpolated from batch-32/128 rows).  This script
+drives the framework's *user-facing* path — Gluon model zoo + hybridize +
+SoftmaxCrossEntropyLoss + Trainer(sgd) — on synthetic ImageNet-shaped data,
+and prints ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as onp
+
+BASELINE_IMG_PER_S = 363.69  # V100 fp32 train (batch-128 row; ~flat in batch)
+BATCH = 64
+WARMUP = 5
+ITERS = 20
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet50_v1()
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize(static_alloc=True)
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+
+    x = mx.np.array(onp.random.uniform(-1, 1, (BATCH, 3, 224, 224)),
+                    dtype="float32")
+    y = mx.np.array(onp.random.randint(0, 1000, (BATCH,)), dtype="int32")
+
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1, "momentum": 0.9},
+                               kvstore="device")
+
+    def step():
+        with mx.autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(BATCH)
+        return loss
+
+    for _ in range(WARMUP):
+        loss = step()
+    loss.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = step()
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    img_per_s = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_fp32_img_per_s",
+        "value": round(img_per_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_per_s / BASELINE_IMG_PER_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,7 @@ from . import lr_scheduler
 from . import kvstore as kv
 from . import kvstore
 from . import gluon
+from . import models
 from . import parallel
 from . import amp
 from . import profiler
@@ -49,7 +50,7 @@ from . import test_utils
 
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "NDArray", "nd", "np",
-    "npx", "autograd", "random", "gluon", "optimizer", "kvstore", "kv",
+    "npx", "autograd", "random", "gluon", "models", "optimizer", "kvstore", "kv",
     "initializer", "init", "lr_scheduler", "parallel", "amp", "profiler",
     "waitall", "current_context", "num_gpus", "num_tpus", "test_utils",
 ]

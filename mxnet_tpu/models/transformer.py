@@ -1,0 +1,244 @@
+"""Transformer / BERT model family (flagship for BASELINE.json config 4).
+
+The reference delegates transformers to GluonNLP built from MXNet primitives
+(`src/operator/nn/` FC/layer_norm/softmax + `np_einsum_op.cc`).  Here the
+same architecture is assembled from ``mxnet_tpu.gluon`` blocks, designed
+TPU-first:
+
+* attention math is einsum-form so XLA maps it onto the MXU as large batched
+  matmuls (no reshape/transpose chains that break fusion);
+* every parameter has a natural tensor-parallel axis; `bert_partition_rules`
+  gives Megatron-style column/row sharding over a mesh axis ``tp`` —
+  QKV/FFN-in kernels split on the output dim, proj/FFN-out on the input dim,
+  embeddings on the vocab dim.  With batch over ``dp`` and sequence over
+  ``sp``, XLA inserts the all-reduces over ICI (SURVEY.md §5.8);
+* dropout draws keys from the functional RNG stream, so the whole forward
+  jits into one program under ``hybridize()``.
+
+True ring/context parallelism for very long sequences lives in
+`mxnet_tpu.parallel.ring_attention` and can replace the attention core.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as onp
+
+from .. import initializer as init
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.parameter import Parameter
+from .. import numpy as np
+from .. import numpy_extension as npx
+from ..parallel.mesh import PartitionSpec
+
+__all__ = [
+    "MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderLayer",
+    "TransformerEncoder", "BertModel", "BertForPretraining",
+    "bert_partition_rules", "bert_base", "bert_large",
+]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Scaled dot-product multi-head attention.
+
+    Shapes are (batch, seq, units) throughout; heads are split with a single
+    reshape and contracted with einsum: ``BTHD,BSHD->BHTS`` then
+    ``BHTS,BSHD->BTHD`` — two MXU-shaped batched matmuls per layer.
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 dtype="float32"):
+        super().__init__()
+        assert units % num_heads == 0, "num_heads must divide units"
+        self._units = units
+        self._num_heads = num_heads
+        self._head_dim = units // num_heads
+        init_std = init.Normal(0.02)
+        self.query = nn.Dense(units, flatten=False, use_bias=use_bias,
+                              weight_initializer=init_std, dtype=dtype)
+        self.key = nn.Dense(units, flatten=False, use_bias=use_bias,
+                            weight_initializer=init_std, dtype=dtype)
+        self.value = nn.Dense(units, flatten=False, use_bias=use_bias,
+                              weight_initializer=init_std, dtype=dtype)
+        self.proj = nn.Dense(units, flatten=False, use_bias=use_bias,
+                             weight_initializer=init_std, dtype=dtype)
+        self.attn_dropout = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        b, t, _ = x.shape
+        h, d = self._num_heads, self._head_dim
+        q = self.query(x).reshape(b, t, h, d)
+        k = self.key(x).reshape(b, t, h, d)
+        v = self.value(x).reshape(b, t, h, d)
+        scores = np.einsum("bthd,bshd->bhts", q, k) / math.sqrt(d)
+        if mask is not None:
+            # mask: (b, s) valid-token mask or (b, t, s) attention mask
+            if mask.ndim == 2:
+                mask = mask.reshape(b, 1, 1, t)
+            elif mask.ndim == 3:
+                mask = mask.reshape(b, 1, t, t)
+            scores = np.where(mask.astype("bool"), scores,
+                              np.full_like(scores, -1e9))
+        attn = npx.softmax(scores, axis=-1)
+        attn = self.attn_dropout(attn)
+        out = np.einsum("bhts,bshd->bthd", attn, v).reshape(b, t, h * d)
+        return self.proj(out)
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, activation="gelu", dropout=0.0,
+                 dtype="float32"):
+        super().__init__()
+        init_std = init.Normal(0.02)
+        self.ffn_1 = nn.Dense(hidden_size, flatten=False,
+                              weight_initializer=init_std, dtype=dtype)
+        self.act = nn.GELU() if activation == "gelu" else nn.Activation(activation)
+        self.ffn_2 = nn.Dense(units, flatten=False,
+                              weight_initializer=init_std, dtype=dtype)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        return self.dropout(self.ffn_2(self.act(self.ffn_1(x))))
+
+
+class TransformerEncoderLayer(HybridBlock):
+    """Post-norm (BERT-style) encoder layer."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 layer_norm_eps=1e-12, dtype="float32"):
+        super().__init__()
+        self.attention = MultiHeadAttention(units, num_heads, dropout=dropout,
+                                            dtype=dtype)
+        self.attn_ln = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
+                                   dtype=dtype)
+        self.ffn_ln = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        x = self.attn_ln(x + self.dropout(self.attention(x, mask)))
+        x = self.ffn_ln(x + self.ffn(x))
+        return x
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.0, layer_norm_eps=1e-12, dtype="float32"):
+        super().__init__()
+        self._num_layers = num_layers
+        for i in range(num_layers):
+            setattr(self, f"layer{i}",
+                    TransformerEncoderLayer(units, hidden_size, num_heads,
+                                            dropout=dropout,
+                                            layer_norm_eps=layer_norm_eps,
+                                            dtype=dtype))
+
+    def forward(self, x, mask=None):
+        for i in range(self._num_layers):
+            x = getattr(self, f"layer{i}")(x, mask)
+        return x
+
+
+class BertModel(HybridBlock):
+    """BERT encoder: token + segment + position embeddings -> encoder ->
+    (sequence output, pooled output)."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 num_segments=2, dropout=0.1, layer_norm_eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self._units = units
+        init_std = init.Normal(0.02)
+        self.word_embed = nn.Embedding(vocab_size, units,
+                                       weight_initializer=init_std, dtype=dtype)
+        self.segment_embed = nn.Embedding(num_segments, units,
+                                          weight_initializer=init_std,
+                                          dtype=dtype)
+        self.position_embed = Parameter("position_embed",
+                                        shape=(max_length, units),
+                                        init=init_std, dtype=dtype)
+        self.embed_ln = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.embed_dropout = nn.Dropout(dropout)
+        self.encoder = TransformerEncoder(num_layers, units, hidden_size,
+                                          num_heads, dropout=dropout,
+                                          layer_norm_eps=layer_norm_eps,
+                                          dtype=dtype)
+        self.pooler = nn.Dense(units, flatten=False, activation="tanh",
+                               weight_initializer=init_std, dtype=dtype)
+
+    def forward(self, tokens, segments=None, valid_mask=None):
+        b, t = tokens.shape
+        x = self.word_embed(tokens)
+        if segments is not None:
+            x = x + self.segment_embed(segments)
+        x = x + self.position_embed.data()[:t]
+        x = self.embed_dropout(self.embed_ln(x))
+        seq = self.encoder(x, valid_mask)
+        pooled = self.pooler(seq[:, 0, :])
+        return seq, pooled
+
+
+class BertForPretraining(HybridBlock):
+    """MLM + next-sentence heads over BertModel (the pretraining step of
+    BASELINE.json config 4)."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self.bert = BertModel(**kwargs)
+        units = self.bert._units
+        init_std = init.Normal(0.02)
+        self.mlm_transform = nn.Dense(units, flatten=False, activation=None,
+                                      weight_initializer=init_std)
+        self.mlm_act = nn.GELU()
+        self.mlm_ln = nn.LayerNorm(in_channels=units)
+        # decoder bias; the kernel is tied to the word embedding
+        self.mlm_bias = Parameter("mlm_bias",
+                                  shape=(self.bert.word_embed._input_dim,),
+                                  init=init.Zero())
+        self.nsp = nn.Dense(2, flatten=False, weight_initializer=init_std)
+
+    def forward(self, tokens, segments=None, valid_mask=None):
+        seq, pooled = self.bert(tokens, segments, valid_mask)
+        h = self.mlm_ln(self.mlm_act(self.mlm_transform(seq)))
+        embed_w = self.bert.word_embed.weight.data()  # (vocab, units)
+        mlm_logits = np.matmul(h, embed_w.T) + self.mlm_bias.data()
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+def bert_partition_rules(tp_axis="tp"):
+    """Megatron-style tensor-parallel rules for `parallel.shard_parameters`.
+
+    Dense weights are stored (out, in) — see `gluon.nn.Dense`.  Column-split
+    layers (QKV, FFN-in) shard dim 0; row-split layers (attention proj,
+    FFN-out) shard dim 1; embeddings shard the vocab/hidden dim so the MLM
+    matmul contracts locally and all-reduces once.
+    """
+    col = PartitionSpec(tp_axis, None)
+    row = PartitionSpec(None, tp_axis)
+    return [
+        (r"attention\.(query|key|value)\.weight", col),
+        (r"attention\.(query|key|value)\.bias", PartitionSpec(tp_axis)),
+        (r"attention\.proj\.weight", row),
+        (r"ffn\.ffn_1\.weight", col),
+        (r"ffn\.ffn_1\.bias", PartitionSpec(tp_axis)),
+        (r"ffn\.ffn_2\.weight", row),
+        (r"word_embed\.weight", col),
+        (r"mlm_bias", PartitionSpec(tp_axis)),
+    ]
+
+
+def bert_base(**kwargs):
+    cfg = dict(vocab_size=30522, units=768, hidden_size=3072, num_layers=12,
+               num_heads=12)
+    cfg.update(kwargs)
+    return BertModel(**cfg)
+
+
+def bert_large(**kwargs):
+    cfg = dict(vocab_size=30522, units=1024, hidden_size=4096, num_layers=24,
+               num_heads=16)
+    cfg.update(kwargs)
+    return BertModel(**cfg)

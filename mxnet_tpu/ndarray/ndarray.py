@@ -349,6 +349,14 @@ class NDArray:
         return invoke(lambda x: jnp.min(x, axis=axis, keepdims=keepdims),
                       (self,), name="min")
 
+    def all(self, axis=None, keepdims=False):
+        return invoke(lambda x: jnp.all(x, axis=axis, keepdims=keepdims),
+                      (self,), name="all", differentiable=False)
+
+    def any(self, axis=None, keepdims=False):
+        return invoke(lambda x: jnp.any(x, axis=axis, keepdims=keepdims),
+                      (self,), name="any", differentiable=False)
+
     def argmax(self, axis=None):
         return invoke(lambda x: jnp.argmax(x, axis=axis), (self,),
                       name="argmax", differentiable=False)

@@ -119,6 +119,8 @@ def check_numeric_gradient(f, inputs, eps=1e-3, rtol=1e-2, atol=1e-3,
     are NDArrays.  Compares d(sum(f))/dx computed by the tape against central
     differences.
     """
+    from jax import enable_x64
+
     inputs = list(inputs)
     for x in inputs:
         x.attach_grad()
@@ -128,24 +130,36 @@ def check_numeric_gradient(f, inputs, eps=1e-3, rtol=1e-2, atol=1e-3,
     loss.backward()
     analytic = [x.grad.asnumpy().astype(onp.float64) for x in inputs]
 
-    for i, x in enumerate(inputs):
-        if grad_nodes is not None and i not in grad_nodes:
-            continue
-        base = onp.ascontiguousarray(x.asnumpy().astype(onp.float64))
-        num = onp.zeros_like(base)
-        for idx in onp.ndindex(base.shape):
-            orig = base[idx]
-            base[idx] = orig + eps
-            x._rebind(mxnp.array(base.astype(x.dtype))._data)
-            fp = f(*inputs).sum().asnumpy().astype(onp.float64)
-            base[idx] = orig - eps
-            x._rebind(mxnp.array(base.astype(x.dtype))._data)
-            fm = f(*inputs).sum().asnumpy().astype(onp.float64)
-            base[idx] = orig
-            x._rebind(mxnp.array(base.astype(x.dtype))._data)
-            num[idx] = (fp - fm) / (2 * eps)
-        assert_almost_equal(analytic[i], num, rtol=rtol, atol=atol,
-                            names=(f"autograd[{i}]", f"numeric[{i}]"))
+    # The numeric oracle runs in float64 (enable_x64 scope): float32 XLA
+    # kernels have pointwise error ~4e-5 which the 1/(2*eps) division would
+    # amplify past any reasonable tolerance.
+    originals = [x._data for x in inputs]
+    try:
+        with enable_x64():
+            for x in inputs:
+                x._rebind(mxnp.array(
+                    x.asnumpy().astype(onp.float64))._data)
+            for i, x in enumerate(inputs):
+                if grad_nodes is not None and i not in grad_nodes:
+                    continue
+                base = onp.ascontiguousarray(x.asnumpy().astype(onp.float64))
+                num = onp.zeros_like(base)
+                for idx in onp.ndindex(base.shape):
+                    orig = base[idx]
+                    base[idx] = orig + eps
+                    x._rebind(mxnp.array(base)._data)
+                    fp = f(*inputs).sum().asnumpy().astype(onp.float64)
+                    base[idx] = orig - eps
+                    x._rebind(mxnp.array(base)._data)
+                    fm = f(*inputs).sum().asnumpy().astype(onp.float64)
+                    base[idx] = orig
+                    x._rebind(mxnp.array(base)._data)
+                    num[idx] = (fp - fm) / (2 * eps)
+                assert_almost_equal(analytic[i], num, rtol=rtol, atol=atol,
+                                    names=(f"autograd[{i}]", f"numeric[{i}]"))
+    finally:
+        for x, d in zip(inputs, originals):
+            x._rebind(d)
 
 
 def check_consistency(f, inputs, ctx_list=None, rtol=1e-4, atol=1e-5):

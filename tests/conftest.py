@@ -1,19 +1,31 @@
 """Test configuration.
 
 Mirrors the reference's conftest strategy (`conftest.py:61-119`): seeded RNG
-per test for reproducibility and a drain between modules to localize async
-failures.  Tests run on a virtual 8-device CPU mesh so multi-chip sharding
-paths execute without TPU hardware (the driver separately dry-runs the
-multichip path; see `__graft_entry__.py`).
+per test with the seed logged for repro, and a drain between tests to
+localize async failures.  Tests run on a virtual 8-device CPU mesh so
+multi-chip sharding paths execute without TPU hardware (the driver
+separately dry-runs the multichip path; see `__graft_entry__.py`).
 """
 import os
 
-# must be set before jax import
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The axon site hook pre-imports jax and registers the TPU plugin at
+# interpreter startup, so env vars alone are read too late; steer the
+# platform through jax.config instead.  XLA_FLAGS must still land before
+# the first CPU backend is created (it is: no backend exists yet at
+# conftest import time).
+os.environ["JAX_PLATFORMS"] = "cpu"          # for subprocesses we spawn
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8, (
+    "tests must run on the virtual 8-device CPU mesh, got "
+    f"{jax.devices()}")
 
 import numpy as onp  # noqa: E402
 import pytest  # noqa: E402
@@ -23,12 +35,16 @@ import pytest  # noqa: E402
 def _seed_rng(request):
     seed = onp.random.randint(0, 2 ** 31)
     module_seed = int(os.environ.get("MXNET_TPU_TEST_SEED", seed))
+    # log the seed so a flaky failure is reproducible with
+    # MXNET_TPU_TEST_SEED=<seed> (reference conftest.py:75-119 prints seeds)
+    print(f"[seed {module_seed}]", end=" ", flush=True)
     onp.random.seed(module_seed)
     import mxnet_tpu as mx
     mx.random.seed(module_seed)
     yield
     # drain async work so failures localize to the test that caused them
     # (reference: conftest.py waitall between modules)
+    mx.waitall()
 
 
 def pytest_configure(config):
