@@ -2,15 +2,36 @@
 from .resnet import *  # noqa: F401,F403
 from .alexnet import *  # noqa: F401,F403
 from .vgg import *  # noqa: F401,F403
+from .squeezenet import *  # noqa: F401,F403
+from .mobilenet import *  # noqa: F401,F403
+from .densenet import *  # noqa: F401,F403
+from .inception import *  # noqa: F401,F403
 from .resnet import __all__ as _resnet_all
 from .alexnet import __all__ as _alexnet_all
 from .vgg import __all__ as _vgg_all
+from .squeezenet import __all__ as _squeezenet_all
+from .mobilenet import __all__ as _mobilenet_all
+from .densenet import __all__ as _densenet_all
+from .inception import __all__ as _inception_all
 
 _models = {}
-for _name in _resnet_all + _alexnet_all + _vgg_all:
+for _name in (_resnet_all + _alexnet_all + _vgg_all + _squeezenet_all
+              + _mobilenet_all + _densenet_all + _inception_all):
     _obj = globals()[_name]
     if callable(_obj) and _name[0].islower() and not _name.startswith("get_"):
         _models[_name] = _obj
+
+# reference get_model aliases (vision/__init__.py:135-141 maps dotted names)
+_models["mobilenetv2_1.0"] = globals()["mobilenet_v2_1_0"]
+_models["mobilenetv2_0.75"] = globals()["mobilenet_v2_0_75"]
+_models["mobilenetv2_0.5"] = globals()["mobilenet_v2_0_5"]
+_models["mobilenetv2_0.25"] = globals()["mobilenet_v2_0_25"]
+_models["squeezenet1.0"] = globals()["squeezenet1_0"]
+_models["squeezenet1.1"] = globals()["squeezenet1_1"]
+_models["mobilenet1.0"] = globals()["mobilenet1_0"]
+_models["mobilenet0.75"] = globals()["mobilenet0_75"]
+_models["mobilenet0.5"] = globals()["mobilenet0_5"]
+_models["mobilenet0.25"] = globals()["mobilenet0_25"]
 
 
 def get_model(name, **kwargs):
@@ -22,4 +43,4 @@ def get_model(name, **kwargs):
     return _models[name](**kwargs)
 
 
-__all__ = list(_models) + ["get_model"]
+__all__ = [n for n in _models if not ("." in n)] + ["get_model"]

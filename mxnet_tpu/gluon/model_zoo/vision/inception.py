@@ -1,0 +1,168 @@
+"""Inception v3 (reference: `python/mxnet/gluon/model_zoo/vision/inception.py`).
+
+Mixed blocks of parallel conv towers concatenated on channels; 299x299 input.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+from .... import numpy as np
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _make_basic_conv(**kwargs):
+    out = nn.HybridSequential()
+    out.add(nn.Conv2D(use_bias=False, **kwargs))
+    out.add(nn.BatchNorm(epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+def _make_branch(use_pool, *conv_settings):
+    out = nn.HybridSequential()
+    if use_pool == "avg":
+        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+    elif use_pool == "max":
+        out.add(nn.MaxPool2D(pool_size=3, strides=2))
+    setting_names = ["channels", "kernel_size", "strides", "padding"]
+    for setting in conv_settings:
+        kwargs = {}
+        for i, value in enumerate(setting):
+            if value is not None:
+                kwargs[setting_names[i]] = value
+        out.add(_make_basic_conv(**kwargs))
+    return out
+
+
+class _Concurrent(HybridBlock):
+    """Parallel branches concatenated on the channel axis (reference
+    `gluon/contrib/nn/basic_layers.py` HybridConcurrent)."""
+
+    def __init__(self):
+        super().__init__()
+        self._branches = []
+
+    def add(self, block):
+        self._branches.append(block)
+        setattr(self, f"branch{len(self._branches) - 1}", block)
+
+    def forward(self, x):
+        return np.concatenate([b(x) for b in self._branches], axis=1)
+
+
+def _make_A(pool_features):
+    out = _Concurrent()
+    out.add(_make_branch(None, (64, 1, None, None)))
+    out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
+    out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
+                         (96, 3, None, 1)))
+    out.add(_make_branch("avg", (pool_features, 1, None, None)))
+    return out
+
+
+def _make_B():
+    out = _Concurrent()
+    out.add(_make_branch(None, (384, 3, 2, None)))
+    out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
+                         (96, 3, 2, None)))
+    out.add(_make_branch("max"))
+    return out
+
+
+def _make_C(channels_7x7):
+    out = _Concurrent()
+    out.add(_make_branch(None, (192, 1, None, None)))
+    out.add(_make_branch(None, (channels_7x7, 1, None, None),
+                         (channels_7x7, (1, 7), None, (0, 3)),
+                         (192, (7, 1), None, (3, 0))))
+    out.add(_make_branch(None, (channels_7x7, 1, None, None),
+                         (channels_7x7, (7, 1), None, (3, 0)),
+                         (channels_7x7, (1, 7), None, (0, 3)),
+                         (channels_7x7, (7, 1), None, (3, 0)),
+                         (192, (1, 7), None, (0, 3))))
+    out.add(_make_branch("avg", (192, 1, None, None)))
+    return out
+
+
+def _make_D():
+    out = _Concurrent()
+    out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
+    out.add(_make_branch(None, (192, 1, None, None),
+                         (192, (1, 7), None, (0, 3)),
+                         (192, (7, 1), None, (3, 0)),
+                         (192, 3, 2, None)))
+    out.add(_make_branch("max"))
+    return out
+
+
+class _SplitConcat(HybridBlock):
+    """One conv followed by two parallel convs whose outputs concat."""
+
+    def __init__(self, stem, left_setting, right_setting):
+        super().__init__()
+        self.stem = stem
+        self.left = _make_branch(None, left_setting)
+        self.right = _make_branch(None, right_setting)
+
+    def forward(self, x):
+        x = self.stem(x) if self.stem is not None else x
+        return np.concatenate([self.left(x), self.right(x)], axis=1)
+
+
+def _make_E():
+    out = _Concurrent()
+    out.add(_make_branch(None, (320, 1, None, None)))
+    out.add(_SplitConcat(_make_branch(None, (384, 1, None, None)),
+                         (384, (1, 3), None, (0, 1)),
+                         (384, (3, 1), None, (1, 0))))
+    out.add(_SplitConcat(_make_branch(None, (448, 1, None, None),
+                                      (384, 3, None, 1)),
+                         (384, (1, 3), None, (0, 1)),
+                         (384, (3, 1), None, (1, 0))))
+    out.add(_make_branch("avg", (192, 1, None, None)))
+    return out
+
+
+class Inception3(HybridBlock):
+    def __init__(self, classes=1000):
+        super().__init__()
+        self.features = nn.HybridSequential()
+        self.features.add(_make_basic_conv(channels=32, kernel_size=3,
+                                           strides=2))
+        self.features.add(_make_basic_conv(channels=32, kernel_size=3))
+        self.features.add(_make_basic_conv(channels=64, kernel_size=3,
+                                           padding=1))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_make_basic_conv(channels=80, kernel_size=1))
+        self.features.add(_make_basic_conv(channels=192, kernel_size=3))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+        self.features.add(_make_A(32))
+        self.features.add(_make_A(64))
+        self.features.add(_make_A(64))
+        self.features.add(_make_B())
+        self.features.add(_make_C(128))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(160))
+        self.features.add(_make_C(192))
+        self.features.add(_make_D())
+        self.features.add(_make_E())
+        self.features.add(_make_E())
+        self.features.add(nn.AvgPool2D(pool_size=8))
+        self.features.add(nn.Dropout(0.5))
+
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = self.output(x)
+        return x
+
+
+def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    net = Inception3(**kwargs)
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network egress; load local params "
+            "with net.load_parameters()")
+    return net

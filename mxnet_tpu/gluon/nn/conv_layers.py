@@ -166,7 +166,7 @@ class Conv3DTranspose(_Conv):
 
 class _Pool(HybridBlock):
     def __init__(self, pool_size, strides, padding, global_pool, pool_type,
-                 layout, count_include_pad=True, ndim=2):
+                 layout, count_include_pad=True, ndim=2, ceil_mode=False):
         super().__init__()
         self._kernel = _pair(pool_size, ndim)
         self._strides = _pair(strides if strides is not None else pool_size,
@@ -176,13 +176,15 @@ class _Pool(HybridBlock):
         self._pool_type = pool_type
         self._layout = layout
         self._count_include_pad = count_include_pad
+        self._ceil_mode = ceil_mode
 
     def forward(self, x):
         return npx.pooling(
             x, kernel=self._kernel, pool_type=self._pool_type,
             stride=self._strides, pad=self._padding,
             global_pool=self._global,
-            count_include_pad=self._count_include_pad, layout=self._layout)
+            count_include_pad=self._count_include_pad, layout=self._layout,
+            pooling_convention="full" if self._ceil_mode else "valid")
 
     def __repr__(self):
         return (f"{type(self).__name__}(size={self._kernel}, "
@@ -190,44 +192,45 @@ class _Pool(HybridBlock):
 
 
 class MaxPool1D(_Pool):
-    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW"):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False):
         super().__init__(pool_size, strides, padding, False, "max", layout,
-                         ndim=1)
+                         ndim=1, ceil_mode=ceil_mode)
 
 
 class MaxPool2D(_Pool):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW"):
+                 layout="NCHW", ceil_mode=False):
         super().__init__(pool_size, strides, padding, False, "max", layout,
-                         ndim=2)
+                         ndim=2, ceil_mode=ceil_mode)
 
 
 class MaxPool3D(_Pool):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW"):
+                 layout="NCDHW", ceil_mode=False):
         super().__init__(pool_size, strides, padding, False, "max", layout,
-                         ndim=3)
+                         ndim=3, ceil_mode=ceil_mode)
 
 
 class AvgPool1D(_Pool):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
-                 count_include_pad=True):
+                 count_include_pad=True, ceil_mode=False):
         super().__init__(pool_size, strides, padding, False, "avg", layout,
-                         count_include_pad, ndim=1)
+                         count_include_pad, ndim=1, ceil_mode=ceil_mode)
 
 
 class AvgPool2D(_Pool):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
-                 layout="NCHW", count_include_pad=True):
+                 layout="NCHW", count_include_pad=True, ceil_mode=False):
         super().__init__(pool_size, strides, padding, False, "avg", layout,
-                         count_include_pad, ndim=2)
+                         count_include_pad, ndim=2, ceil_mode=ceil_mode)
 
 
 class AvgPool3D(_Pool):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
-                 layout="NCDHW", count_include_pad=True):
+                 layout="NCDHW", count_include_pad=True, ceil_mode=False):
         super().__init__(pool_size, strides, padding, False, "avg", layout,
-                         count_include_pad, ndim=3)
+                         count_include_pad, ndim=3, ceil_mode=ceil_mode)
 
 
 class GlobalMaxPool1D(_Pool):

@@ -118,7 +118,8 @@ def _acc_type(dtype):
 # pooling (reference: src/operator/nn/pooling.cc)
 # ---------------------------------------------------------------------------
 def pooling(data, kernel=None, pool_type="max", stride=None, pad=None,
-            global_pool=False, count_include_pad=True, layout="NCHW"):
+            global_pool=False, count_include_pad=True, layout="NCHW",
+            pooling_convention="valid"):
     nsp = len(layout) - 2
     sp_axes = tuple(i for i, c in enumerate(layout) if c not in "NC")
     if global_pool:
@@ -135,7 +136,14 @@ def pooling(data, kernel=None, pool_type="max", stride=None, pad=None,
     for ax, k, s, p in zip(sp_axes, kernel, stride, pad):
         window[ax] = k
         strides[ax] = s
-        pads[ax] = (p, p)
+        hi = p
+        if pooling_convention == "full":
+            # ceil-mode (reference pooling.cc `pooling_convention=full`):
+            # widen the high-side pad so the last partial window is kept
+            size = data.shape[ax]
+            out_ceil = -(-(size + 2 * p - k) // s) + 1
+            hi = max(p, (out_ceil - 1) * s + k - size - p)
+        pads[ax] = (p, hi)
 
     # init values MUST be python scalars: an array init selects the generic
     # reduce_window primitive, which has no linearization rule under jit
@@ -149,11 +157,25 @@ def pooling(data, kernel=None, pool_type="max", stride=None, pad=None,
             data.dtype, jnp.floating) else 0, lax.add, window, strides, pads)
         if pool_type == "sum":
             return summed
-        if count_include_pad:
+        has_extra = any(pads[a][1] > pads[a][0] for a in sp_axes)
+        if count_include_pad and not has_extra:
             denom = float(onp.prod(kernel))
             return summed / jnp.asarray(denom, data.dtype)
+        # Denominator = valid window elements.  count_include_pad counts the
+        # user's padding but NEVER the ceil-mode widening (reference
+        # `src/operator/nn/pool.h:468-473` clips the denominator to
+        # size+2*pad): pre-pad a ones-mask with the base padding, then let
+        # reduce_window's own (zero-contributing) padding cover the extra.
         ones = jnp.ones(data.shape, data.dtype)
-        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        cpads = list(pads)
+        if count_include_pad:
+            opads = [(0, 0)] * data.ndim
+            for ax, p in zip(sp_axes, pad):
+                opads[ax] = (p, p)
+            ones = jnp.pad(ones, opads, constant_values=1)
+            cpads = [(lo - o_lo, hi - o_hi)
+                     for (lo, hi), (o_lo, o_hi) in zip(pads, opads)]
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, cpads)
         return summed / counts
     if pool_type == "lp":
         p = 2.0

