@@ -1,0 +1,11 @@
+"""Estimator training-loop abstraction.
+
+Reference: `python/mxnet/gluon/contrib/estimator/` (`estimator.py:42`,
+`event_handler.py:160,226,336,614`).
+"""
+from .estimator import Estimator, BatchProcessor  # noqa: F401
+from .event_handler import (  # noqa: F401
+    TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin, BatchEnd,
+    StoppingHandler, MetricHandler, ValidationHandler, LoggingHandler,
+    CheckpointHandler, EarlyStoppingHandler,
+)

@@ -1,0 +1,189 @@
+"""Estimator: the reference's high-level train loop.
+
+Reference: `python/mxnet/gluon/contrib/estimator/estimator.py:42` and
+`batch_processor.py`.  The loop drives forward/backward through autograd +
+Trainer exactly like hand-written Gluon training; hybridize the net before
+fitting for the compiled fast path.
+"""
+from __future__ import annotations
+
+import logging
+
+from .... import autograd
+from ... import metric as metric_mod
+from ...loss import Loss as GluonLoss
+from ...trainer import Trainer
+from .event_handler import (
+    BatchBegin, BatchEnd, EpochBegin, EpochEnd, TrainBegin, TrainEnd,
+    LoggingHandler, MetricHandler, StoppingHandler, ValidationHandler,
+)
+
+__all__ = ["Estimator", "BatchProcessor"]
+
+
+class BatchProcessor:
+    """One train/eval step (reference `batch_processor.py`): override for
+    custom batch layouts."""
+
+    @staticmethod
+    def _get_data_and_label(batch, ctx):
+        data, label = batch[0], batch[1]
+        return data, label
+
+    def evaluate_batch(self, estimator, val_batch, axis=-1):
+        data, label = self._get_data_and_label(val_batch, None)
+        pred = estimator.eval_net(data)
+        loss = estimator.val_loss(pred, label)
+        return data, label, pred, loss
+
+    def fit_batch(self, estimator, train_batch, axis=-1):
+        data, label = self._get_data_and_label(train_batch, None)
+        batch_size = data.shape[0]
+        with autograd.record():
+            pred = estimator.net(data)
+            loss = estimator.loss(pred, label)
+        loss.backward()
+        estimator.trainer.step(batch_size)
+        return data, label, pred, loss
+
+
+class Estimator:
+    """Reference `estimator.py:42`."""
+
+    logger = None
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None, val_net=None, val_loss=None,
+                 batch_processor=None):
+        self.net = net
+        self.eval_net = val_net if val_net is not None else net
+        if not isinstance(loss, GluonLoss):
+            raise ValueError("loss must be a gluon Loss instance")
+        self.loss = loss
+        self.val_loss = val_loss if val_loss is not None else loss
+        self.train_metrics = _as_list(train_metrics)
+        self.val_metrics = _as_list(val_metrics)
+        self.batch_processor = batch_processor or BatchProcessor()
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+        self.logger.setLevel(logging.INFO)
+        self.max_epoch = None
+        self.max_batch = None
+        self.resumed_epoch = 0
+
+        if trainer is None:
+            trainer = Trainer(net.collect_params(), "adam",
+                              {"learning_rate": 1e-3})
+        if not isinstance(trainer, Trainer):
+            raise ValueError("trainer must be a gluon Trainer instance")
+        self.trainer = trainer
+
+        # loss metric tracked automatically (reference estimator.py logic)
+        self.train_loss_metric = metric_mod.Loss(
+            name=f"train {type(loss).__name__.lower()}")
+        self.val_loss_metric = metric_mod.Loss(
+            name=f"validation {type(loss).__name__.lower()}")
+
+    def evaluate(self, val_data, axis=-1, event_handlers=None):
+        event_handlers = list(event_handlers or [])
+        batch_begin = [h for h in event_handlers if isinstance(h, BatchBegin)]
+        batch_end = [h for h in event_handlers if isinstance(h, BatchEnd)]
+        for metric in self.val_metrics:
+            metric.reset()
+        self.val_loss_metric.reset()
+        for batch in val_data:
+            for handler in batch_begin:
+                handler.batch_begin(self, batch=batch)
+            _data, label, pred, loss = \
+                self.batch_processor.evaluate_batch(self, batch, axis)
+            for metric in self.val_metrics:
+                metric.update(label, pred)
+            self.val_loss_metric.update(0, loss)
+            for handler in batch_end:
+                handler.batch_end(self, batch=batch, pred=pred, label=label,
+                                  loss=loss)
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None):
+        if epochs is None and batches is None:
+            raise ValueError("pass epochs and/or batches")
+        self.max_epoch = epochs
+        self.max_batch = batches
+
+        event_handlers = self._prepare_default_handlers(
+            val_data, event_handlers)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize_handlers(event_handlers)
+
+        for handler in train_begin:
+            handler.train_begin(self)
+
+        stop = False
+        while not stop:
+            for handler in epoch_begin:
+                handler.epoch_begin(self)
+            for batch in train_data:
+                for handler in batch_begin:
+                    handler.batch_begin(self, batch=batch)
+                data, label, pred, loss = \
+                    self.batch_processor.fit_batch(self, batch)
+                self.train_loss_metric.update(0, loss)
+                bs = data.shape[0] if hasattr(data, "shape") else 0
+                for handler in batch_end:
+                    if handler.batch_end(self, batch=batch, pred=pred,
+                                         label=label, loss=loss,
+                                         batch_size=bs):
+                        stop = True
+                if stop:
+                    break
+            if stop:
+                break
+            for handler in epoch_end:
+                if handler.epoch_end(self):
+                    stop = True
+
+        for handler in train_end:
+            handler.train_end(self)
+
+    # ------------------------------------------------------------------
+    def _prepare_default_handlers(self, val_data, event_handlers):
+        event_handlers = list(event_handlers or [])
+        added = []
+        if not any(isinstance(h, StoppingHandler) for h in event_handlers):
+            h = StoppingHandler(self.max_epoch, self.max_batch)
+            event_handlers.append(h)
+            added.append(h)
+        if not any(isinstance(h, MetricHandler) for h in event_handlers):
+            h = MetricHandler(self.train_metrics + [self.train_loss_metric])
+            event_handlers.append(h)
+            added.append(h)
+        if val_data is not None and not any(
+                isinstance(h, ValidationHandler) for h in event_handlers):
+            h = ValidationHandler(val_data, self.evaluate)
+            event_handlers.append(h)
+            added.append(h)
+        if not any(isinstance(h, LoggingHandler) for h in event_handlers):
+            h = LoggingHandler(
+                metrics=self.train_metrics + [self.train_loss_metric])
+            event_handlers.append(h)
+            added.append(h)
+        return event_handlers
+
+    @staticmethod
+    def _categorize_handlers(event_handlers):
+        sortable = sorted(
+            event_handlers,
+            key=lambda h: getattr(h, "priority", 0))
+        return ([h for h in sortable if isinstance(h, TrainBegin)],
+                [h for h in sortable if isinstance(h, EpochBegin)],
+                [h for h in sortable if isinstance(h, BatchBegin)],
+                [h for h in sortable if isinstance(h, BatchEnd)],
+                [h for h in sortable if isinstance(h, EpochEnd)],
+                [h for h in sortable if isinstance(h, TrainEnd)])
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]

@@ -1,0 +1,345 @@
+"""Event handlers for the Estimator.
+
+Reference: `python/mxnet/gluon/contrib/estimator/event_handler.py`
+(ValidationHandler :160, LoggingHandler :226, CheckpointHandler :336,
+EarlyStoppingHandler :614).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+import warnings
+
+import numpy as onp
+
+__all__ = [
+    "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+    "BatchEnd", "StoppingHandler", "MetricHandler", "ValidationHandler",
+    "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler",
+]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after max_epoch / max_batch (reference event_handler.py:60)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.max_epoch = self.max_epoch or estimator.max_epoch
+        self.max_batch = self.max_batch or estimator.max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+        return self.stop_training
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+        return self.stop_training
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Update training metrics per batch (reference event_handler.py:104)."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics or []
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for metric in self.metrics:
+            metric.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for metric in self.metrics:
+            from ...metric import Loss as LossMetric
+            if isinstance(metric, LossMetric):
+                metric.update(0, loss)
+            else:
+                metric.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation on an interval (reference event_handler.py:160)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                     BatchEnd):
+    """Log training progress (reference event_handler.py:226)."""
+
+    LOG_PER_EPOCH = 1
+    LOG_PER_BATCH = 2
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=onp.inf):
+        self.metrics = metrics or []
+        self.priority = priority
+        if log_interval != "epoch" and not isinstance(log_interval, int):
+            raise ValueError("log_interval must be 'epoch' or an int")
+        self.log_interval = log_interval
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.processed_samples = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        estimator.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        train_time = time.time() - self.train_start
+        msg = f"Train finished using total {train_time:.0f}s at epoch " \
+              f"{self.current_epoch}. "
+        for metric in self.metrics:
+            name, value = metric.get()
+            msg += f"{name}: {_fmt(value)}, "
+        estimator.logger.info(msg.rstrip(", "))
+
+    def batch_begin(self, estimator, *args, **kwargs):
+        if self.log_interval != "epoch":
+            self.batch_start = time.time()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if self.log_interval != "epoch":
+            batch_time = time.time() - self.batch_start
+            msg = f"[Epoch {self.current_epoch}][Batch {self.batch_index}]"
+            self.processed_samples += kwargs.get("batch_size", 0)
+            msg += f"[Samples {self.processed_samples}] "
+            if self.batch_index % self.log_interval == 0:
+                msg += f"time/batch: {batch_time:.3f}s "
+                for metric in self.metrics:
+                    name, value = metric.get()
+                    msg += f"{name}: {_fmt(value)}, "
+                estimator.logger.info(msg.rstrip(", "))
+        self.batch_index += 1
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        epoch_time = time.time() - self.epoch_start
+        msg = f"[Epoch {self.current_epoch}] finished in {epoch_time:.3f}s: "
+        for metric in self.metrics:
+            name, value = metric.get()
+            msg += f"{name}: {_fmt(value)}, "
+        estimator.logger.info(msg.rstrip(", "))
+        self.current_epoch += 1
+        self.batch_index = 0
+
+
+def _fmt(v):
+    return f"{v:.4f}" if isinstance(v, (int, float)) else str(v)
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save params (+ trainer state) periodically; keeps best by monitored
+    metric (reference event_handler.py:336)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5, resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.verbose = verbose
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.saved_checkpoints = []
+        self.current_batch = 0
+        self.current_epoch = 0
+        if self.save_best and self.monitor is None:
+            raise ValueError("save_best requires a monitor metric")
+        if mode not in ("auto", "min", "max"):
+            warnings.warn(f"unknown mode {mode}; falling back to auto")
+            mode = "auto"
+        if mode == "min":
+            self.monitor_op = onp.less
+        elif mode == "max":
+            self.monitor_op = onp.greater
+        else:
+            self.monitor_op = onp.less  # loss-like metrics by default
+            if monitor is not None and "acc" in monitor.get()[0].lower():
+                self.monitor_op = onp.greater
+        self.best = onp.inf if self.monitor_op == onp.less else -onp.inf
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+        self.current_batch = 0
+        self.current_epoch = 0
+        if self.resume_from_checkpoint:
+            prefix = os.path.join(self.model_dir, self.model_prefix)
+            epochs = []
+            for f in os.listdir(self.model_dir):
+                if f.startswith(self.model_prefix) and f.endswith(".params") \
+                        and "-epoch" in f:
+                    try:
+                        epochs.append(int(f.split("-epoch")[1].split(".")[0]))
+                    except ValueError:
+                        continue
+            if epochs:
+                last = max(epochs)
+                estimator.net.load_parameters(
+                    f"{prefix}-epoch{last}.params")
+                self.current_epoch = last + 1
+                estimator.resumed_epoch = self.current_epoch
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save_checkpoint(estimator)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        if self.epoch_period and \
+                (self.current_epoch + 1) % self.epoch_period == 0:
+            self._save_checkpoint(estimator)
+        self.current_epoch += 1
+
+    def _save_checkpoint(self, estimator):
+        prefix = os.path.join(self.model_dir, self.model_prefix)
+        fname = f"{prefix}-epoch{self.current_epoch}.params"
+        estimator.net.save_parameters(fname)
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(
+                f"{prefix}-epoch{self.current_epoch}.states")
+        self.saved_checkpoints.append(fname)
+        while len(self.saved_checkpoints) > self.max_checkpoints:
+            old = self.saved_checkpoints.pop(0)
+            for path in (old, old.replace(".params", ".states")):
+                if os.path.exists(path):
+                    os.remove(path)
+        if self.save_best:
+            _name, value = self.monitor.get()
+            if self.monitor_op(value, self.best):
+                self.best = value
+                estimator.net.save_parameters(f"{prefix}-best.params")
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when a monitored metric stops improving
+    (reference event_handler.py:614)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        if mode not in ("auto", "min", "max"):
+            warnings.warn(f"unknown mode {mode}; falling back to auto")
+            mode = "auto"
+        if mode == "min":
+            self.monitor_op = onp.less
+        elif mode == "max":
+            self.monitor_op = onp.greater
+        else:
+            self.monitor_op = onp.greater if \
+                "acc" in monitor.get()[0].lower() else onp.less
+        if self.monitor_op == onp.greater:
+            self.min_delta *= 1
+        else:
+            self.min_delta *= -1
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        if self.baseline is not None:
+            self.best = self.baseline
+        else:
+            self.best = onp.inf if self.monitor_op == onp.less else -onp.inf
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _name, value = self.monitor.get()
+        if value is None or (isinstance(value, float) and onp.isnan(value)):
+            self.current_epoch += 1
+            return self.stop_training
+        if self.monitor_op(value - self.min_delta, self.best):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                self.stop_training = True
+        self.current_epoch += 1
+        return self.stop_training
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch > 0:
+            estimator.logger.info(
+                f"[Epoch {self.stopped_epoch}] early stopping: "
+                f"{self.monitor.get()[0]} did not improve for "
+                f"{self.patience} epochs")

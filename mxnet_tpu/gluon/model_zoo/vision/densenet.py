@@ -7,36 +7,34 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
-from .... import numpy as np
+
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201"]
 
 
-class _DenseLayer(HybridBlock):
-    def __init__(self, growth_rate, bn_size, dropout):
-        super().__init__()
-        self.body = nn.HybridSequential()
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1,
-                                use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1,
-                                use_bias=False))
-        if dropout:
-            self.body.add(nn.Dropout(dropout))
-
-    def forward(self, x):
-        out = self.body(x)
-        return np.concatenate([x, out], axis=1)
+def _make_dense_layer(growth_rate, bn_size, dropout):
+    # identity ∥ BN-relu-conv body, concatenated on channels — the same
+    # shape the reference builds with HybridConcurrent + Identity
+    body = nn.HybridSequential()
+    body.add(nn.BatchNorm())
+    body.add(nn.Activation("relu"))
+    body.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1, use_bias=False))
+    body.add(nn.BatchNorm())
+    body.add(nn.Activation("relu"))
+    body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1, use_bias=False))
+    if dropout:
+        body.add(nn.Dropout(dropout))
+    out = nn.HybridConcatenate(axis=1)
+    out.add(nn.Identity())
+    out.add(body)
+    return out
 
 
 def _make_dense_block(num_layers, bn_size, growth_rate, dropout):
     out = nn.HybridSequential()
     for _ in range(num_layers):
-        out.add(_DenseLayer(growth_rate, bn_size, dropout))
+        out.add(_make_dense_layer(growth_rate, bn_size, dropout))
     return out
 
 

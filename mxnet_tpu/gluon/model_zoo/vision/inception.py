@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
-from .... import numpy as np
+
 
 __all__ = ["Inception3", "inception_v3"]
 
@@ -35,20 +35,10 @@ def _make_branch(use_pool, *conv_settings):
     return out
 
 
-class _Concurrent(HybridBlock):
-    """Parallel branches concatenated on the channel axis (reference
-    `gluon/contrib/nn/basic_layers.py` HybridConcurrent)."""
-
-    def __init__(self):
-        super().__init__()
-        self._branches = []
-
-    def add(self, block):
-        self._branches.append(block)
-        setattr(self, f"branch{len(self._branches) - 1}", block)
-
-    def forward(self, x):
-        return np.concatenate([b(x) for b in self._branches], axis=1)
+def _Concurrent():
+    """Parallel branches concatenated on channels (the reference's
+    HybridConcurrent — here the shared nn.HybridConcatenate)."""
+    return nn.HybridConcatenate(axis=1)
 
 
 def _make_A(pool_features):
@@ -96,18 +86,16 @@ def _make_D():
     return out
 
 
-class _SplitConcat(HybridBlock):
-    """One conv followed by two parallel convs whose outputs concat."""
-
-    def __init__(self, stem, left_setting, right_setting):
-        super().__init__()
-        self.stem = stem
-        self.left = _make_branch(None, left_setting)
-        self.right = _make_branch(None, right_setting)
-
-    def forward(self, x):
-        x = self.stem(x) if self.stem is not None else x
-        return np.concatenate([self.left(x), self.right(x)], axis=1)
+def _SplitConcat(stem, left_setting, right_setting):
+    """One conv stem followed by two parallel convs whose outputs concat."""
+    out = nn.HybridSequential()
+    if stem is not None:
+        out.add(stem)
+    split = nn.HybridConcatenate(axis=1)
+    split.add(_make_branch(None, left_setting))
+    split.add(_make_branch(None, right_setting))
+    out.add(split)
+    return out
 
 
 def _make_E():

@@ -40,14 +40,15 @@ def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
 
 
 class LinearBottleneck(HybridBlock):
-    """Inverted residual (MobileNetV2 paper §3.3)."""
+    """Inverted residual (MobileNetV2 paper §3.3).  The t=1 block keeps its
+    1x1 expansion conv like the reference (`mobilenet.py:86`) so checkpoints
+    round-trip."""
 
     def __init__(self, in_channels, channels, t, stride):
         super().__init__()
         self.use_shortcut = stride == 1 and in_channels == channels
         self.out = nn.HybridSequential()
-        if t != 1:
-            _add_conv(self.out, in_channels * t, relu6=True)
+        _add_conv(self.out, in_channels * t, relu6=True)
         _add_conv(self.out, in_channels * t, kernel=3, stride=stride, pad=1,
                   num_group=in_channels * t, relu6=True)
         _add_conv(self.out, channels, active=False, relu6=True)
@@ -96,7 +97,9 @@ class MobileNetV2(HybridBlock):
                           [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3
                           + [160] * 3 + [320]]
         ts = [1] + [6] * 16
-        strides = [1, 2] + [1] * 2 + [2] + [1] * 2 + [2] + [1] * 6 + [2] + [1] * 2
+        # stride 2 lands on the FIRST block of each down-sampling group
+        # (reference mobilenet.py:160)
+        strides = [1, 2] * 2 + [1, 1, 2] + [1] * 6 + [2] + [1] * 3
 
         for in_c, c, t, s in zip(in_channels_group, channels_group, ts,
                                  strides):

@@ -7,7 +7,7 @@ from __future__ import annotations
 
 from ...block import HybridBlock
 from ... import nn
-from .... import numpy as np
+
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
@@ -15,7 +15,10 @@ __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
     out = nn.HybridSequential()
     out.add(_make_fire_conv(squeeze_channels, 1))
-    out.add(_FireExpand(expand1x1_channels, expand3x3_channels))
+    expand = nn.HybridConcatenate(axis=1)
+    expand.add(_make_fire_conv(expand1x1_channels, 1))
+    expand.add(_make_fire_conv(expand3x3_channels, 3, 1))
+    out.add(expand)
     return out
 
 
@@ -24,18 +27,6 @@ def _make_fire_conv(channels, kernel_size, padding=0):
     out.add(nn.Conv2D(channels, kernel_size, padding=padding))
     out.add(nn.Activation("relu"))
     return out
-
-
-class _FireExpand(HybridBlock):
-    """Parallel 1x1 and 3x3 expand paths concatenated on channels."""
-
-    def __init__(self, expand1x1_channels, expand3x3_channels):
-        super().__init__()
-        self.e1 = _make_fire_conv(expand1x1_channels, 1)
-        self.e3 = _make_fire_conv(expand3x3_channels, 3, 1)
-
-    def forward(self, x):
-        return np.concatenate([self.e1(x), self.e3(x)], axis=1)
 
 
 class SqueezeNet(HybridBlock):

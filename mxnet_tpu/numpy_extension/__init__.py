@@ -14,6 +14,7 @@ from ..base import MXNetError
 from ..context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from ..ndarray.ndarray import NDArray, waitall
 from ..ops import nn as _nn
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
 from ..ops.invoke import invoke, is_recording, is_training
 from ..ops.aux_scope import apply_aux_update
 from .. import random as _rng
@@ -28,6 +29,7 @@ __all__ = [
     "reshape_like", "arange_like", "gamma", "gammaln", "erf", "erfinv",
     "adaptive_avg_pool2d", "l2_normalization", "waitall", "cpu", "gpu", "tpu",
     "num_gpus", "num_tpus", "current_context", "save", "load", "seed",
+    "foreach", "while_loop", "cond",
 ]
 
 seed = _rng.seed

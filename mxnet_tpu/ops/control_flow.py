@@ -1,0 +1,191 @@
+"""Control-flow ops: foreach / while_loop / cond.
+
+Reference: `src/operator/control_flow.cc:1096,1157,1218` (`_foreach`,
+`_while_loop`, `_cond` fused ops) and the imperative python fallbacks in
+`python/mxnet/ndarray/contrib.py`.
+
+TPU-native design — two dispatch modes, mirroring the reference's
+imperative/symbolic split:
+
+* **Eager** (concrete buffers): a python loop.  Every op inside the body
+  records on the autograd tape normally, so gradients flow to any parameter
+  the body closes over — exactly the reference's imperative `contrib.foreach`.
+* **Traced** (inside ``hybridize()``/jit, inputs are tracers): lowered to
+  ``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` so the whole loop compiles
+  to one XLA While/Conditional — the analogue of the fused control-flow ops.
+  ``while_loop`` outputs are padded to ``max_iterations`` (XLA requires
+  static shapes; the reference's symbolic while_loop does the same).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .invoke import invoke, set_recording
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _nd_cls():
+    from ..ndarray.ndarray import NDArray
+    return NDArray
+
+
+def _is_nd(x):
+    return isinstance(x, _nd_cls())
+
+
+def _raw(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._data if _is_nd(x) else x, tree, is_leaf=_is_nd)
+
+
+def _wrap(tree):
+    cls = _nd_cls()
+    return jax.tree_util.tree_map(
+        lambda x: cls(x) if isinstance(x, (jax.Array, jax.core.Tracer)) else x,
+        tree)
+
+
+def _is_traced(tree):
+    leaves = jax.tree_util.tree_leaves(_raw(tree))
+    return any(isinstance(leaf, jax.core.Tracer) for leaf in leaves)
+
+
+def _call_quiet(fn, *args):
+    """Run a user body without tape recording (ops inside a trace/loop body
+    must not create tape nodes holding tracers)."""
+    prev = set_recording(False)
+    try:
+        return fn(*args)
+    finally:
+        set_recording(prev)
+
+
+def foreach(body, data, init_states):
+    """``body(data_slice, states) -> (output, new_states)`` mapped over
+    axis 0 of ``data``; returns (stacked outputs, final states).
+
+    Reference: `_foreach` (`control_flow.cc:1096`), py fallback
+    `ndarray/contrib.py foreach`.
+    """
+    if not _is_traced((data, init_states)):
+        # eager: python loop, tape-visible (imperative reference path)
+        states = init_states
+        outputs = []
+        n = (data[0] if isinstance(data, (list, tuple)) else data).shape[0]
+        for i in range(n):
+            sl = jax.tree_util.tree_map(
+                lambda d: d[i], data, is_leaf=_is_nd) \
+                if isinstance(data, (list, tuple)) else data[i]
+            out, states = body(sl, states)
+            outputs.append(out)
+        from .. import numpy as mxnp
+        stacked = jax.tree_util.tree_map(
+            lambda *outs: mxnp.stack(list(outs), axis=0), *outputs,
+            is_leaf=_is_nd)
+        return stacked, states
+
+    def scan_fn(carry, x):
+        out, new_states = _call_quiet(body, _wrap(x), _wrap(carry))
+        return _raw(new_states), _raw(out)
+
+    def fn(data_raw, init_raw):
+        final, outs = jax.lax.scan(scan_fn, init_raw, data_raw)
+        return outs, final
+
+    return invoke(fn, (_raw(data), _raw(init_states)), name="foreach",
+                  wrap=True)
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    """``while cond_fn(*loop_vars): out, loop_vars = func(*loop_vars)``.
+
+    Returns (stacked step outputs, final loop_vars).  Reference:
+    `_while_loop` (`control_flow.cc:1157`).  Eagerly the output list has
+    exactly the executed steps; under a trace it is padded to
+    ``max_iterations`` (XLA static shapes), matching the reference's
+    symbolic-mode contract.
+    """
+    if not isinstance(loop_vars, (list, tuple)):
+        loop_vars = [loop_vars]
+    loop_vars = list(loop_vars)
+
+    def _concrete(pred):
+        return bool(pred.asnumpy()) if _is_nd(pred) else bool(pred)
+
+    if not _is_traced(loop_vars):
+        outputs = []
+        steps = 0
+        while _concrete(cond_fn(*loop_vars)):
+            out, loop_vars = func(*loop_vars)
+            if not isinstance(loop_vars, (list, tuple)):
+                loop_vars = [loop_vars]
+            loop_vars = list(loop_vars)
+            outputs.append(out)
+            steps += 1
+            if max_iterations is not None and steps >= max_iterations:
+                break
+        from .. import numpy as mxnp
+        if outputs:
+            stacked = jax.tree_util.tree_map(
+                lambda *outs: mxnp.stack(list(outs), axis=0), *outputs,
+                is_leaf=_is_nd)
+        else:
+            stacked = None
+        return stacked, list(loop_vars)
+
+    if max_iterations is None:
+        raise ValueError(
+            "while_loop requires max_iterations inside a compiled trace "
+            "(XLA needs a static output shape, like the reference's "
+            "symbolic while_loop)")
+
+    def step(carry, _):
+        done, vars_raw = carry
+        pred = _raw(_call_quiet(cond_fn, *_wrap(vars_raw)))
+        active = jnp.logical_and(jnp.logical_not(done), pred)
+
+        def do_step(v):
+            out, new_vars = _call_quiet(func, *_wrap(v))
+            if not isinstance(new_vars, (list, tuple)):
+                new_vars = [new_vars]
+            return _raw(out), _raw(list(new_vars))
+
+        def skip(v):
+            out, _ = do_step(v)  # shape probe only; masked below
+            zero = jax.tree_util.tree_map(jnp.zeros_like, out)
+            return zero, v
+
+        out, new_vars = jax.lax.cond(active, do_step, skip, vars_raw)
+        return (jnp.logical_or(done, jnp.logical_not(pred)), new_vars), out
+
+    def fn(vars_raw):
+        (done, final), outs = jax.lax.scan(
+            step, (jnp.asarray(False), vars_raw), None,
+            length=max_iterations)
+        return outs, final
+
+    outs, final = invoke(fn, (_raw(loop_vars),), name="while_loop")
+    return outs, list(final) if isinstance(final, (list, tuple)) else [final]
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """``then_func() if pred else else_func()`` with both branches compiled
+    (reference `_cond`, `control_flow.cc:1218`)."""
+    inputs = inputs or []
+    if not _is_traced([pred] + list(inputs)):
+        p = bool(pred.asnumpy()) if _is_nd(pred) else bool(pred)
+        return then_func(*inputs) if p else else_func(*inputs)
+
+    def fn(pred_raw, inputs_raw):
+        def t(v):
+            return _raw(_call_quiet(then_func, *_wrap(v)))
+
+        def f(v):
+            return _raw(_call_quiet(else_func, *_wrap(v)))
+
+        return jax.lax.cond(jnp.asarray(pred_raw).astype(bool).reshape(()),
+                            t, f, inputs_raw)
+
+    return invoke(fn, (_raw(pred), _raw(list(inputs))), name="cond")

@@ -137,7 +137,9 @@ def check_numeric_gradient(f, inputs, eps=1e-3, rtol=1e-2, atol=1e-3,
     try:
         with enable_x64():
             for x in inputs:
-                if x.dtype.kind == "f":  # int/bool inputs keep their dtype
+                # promote real-valued inputs (incl. bf16, numpy kind 'V');
+                # int/bool/unsigned index inputs keep their dtype
+                if x.dtype.kind not in "iub":
                     x._rebind(mxnp.array(
                         x.asnumpy().astype(onp.float64))._data)
             for i, x in enumerate(inputs):

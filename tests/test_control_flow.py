@@ -1,0 +1,113 @@
+"""Control-flow op tests (reference: `tests/python/unittest/test_contrib_control_flow.py`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import npx
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.block import HybridBlock
+
+
+def test_foreach_cumsum_eager():
+    data = mx.np.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    init = mx.np.zeros((3,))
+    outs, final = npx.foreach(lambda x, s: (x + s, x + s), data, init)
+    expect = onp.cumsum(onp.arange(12).reshape(4, 3), axis=0)
+    assert onp.allclose(outs.asnumpy(), expect)
+    assert onp.allclose(final.asnumpy(), expect[-1])
+
+
+def test_foreach_gradient_flows_to_closure_params():
+    w = mx.np.array(onp.ones((3,), "float32"))
+    w.attach_grad()
+    data = mx.np.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    init = mx.np.zeros((3,))
+    with mx.autograd.record():
+        outs, final = npx.foreach(lambda x, s: (x * w + s, x * w + s),
+                                  data, init)
+        loss = final.sum()
+    loss.backward()
+    # d(sum(x0*w + x1*w))/dw = x0 + x1
+    assert onp.allclose(w.grad.asnumpy(), [3.0, 5.0, 7.0])
+
+
+def test_foreach_in_hybridized_block():
+    class Scanner(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Dense(4, flatten=False)
+
+        def forward(self, seq, init):
+            return npx.foreach(
+                lambda x, s: ((lambda h: (h, h))(npx.relu(self.proj(x)) + s)),
+                seq, init)
+
+    net = Scanner()
+    net.initialize()
+    seq = mx.np.array(onp.random.uniform(-1, 1, (5, 2, 3)), dtype="float32")
+    init = mx.np.zeros((2, 4))
+    outs_e, final_e = net(seq, init)
+    net.hybridize()
+    outs_h, final_h = net(seq, init)
+    assert outs_h.shape == (5, 2, 4)
+    mx.test_utils.assert_almost_equal(outs_e, outs_h, rtol=1e-5, atol=1e-5)
+    mx.test_utils.assert_almost_equal(final_e, final_h, rtol=1e-5, atol=1e-5)
+
+
+def test_while_loop_eager():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return s + i, [i + 1, s + i]
+
+    outs, (i, s) = npx.while_loop(
+        cond_fn, func, [mx.np.array(0.0), mx.np.array(0.0)],
+        max_iterations=10)
+    assert float(i.asnumpy()) == 5.0
+    assert float(s.asnumpy()) == 10.0  # 0+1+2+3+4
+    assert outs.shape[0] == 5  # eager mode: exactly the executed steps
+
+
+def test_while_loop_traced_pads_to_max():
+    class Loop(HybridBlock):
+        def forward(self, i, s):
+            return npx.while_loop(
+                lambda i, s: i < 5,
+                lambda i, s: (s + i, [i + 1, s + i]),
+                [i, s], max_iterations=8)
+
+    net = Loop()
+    net.hybridize()
+    outs, final = net(mx.np.array(0.0), mx.np.array(0.0))
+    assert outs.shape[0] == 8  # padded, matching symbolic reference mode
+    assert float(final[0].asnumpy()) == 5.0
+    assert float(final[1].asnumpy()) == 10.0
+    # steps beyond the 5 executed are zero-padded
+    assert onp.allclose(outs.asnumpy()[5:], 0.0)
+
+
+def test_cond_eager_and_traced():
+    x = mx.np.array(3.0)
+    out = npx.cond(x > 1, lambda v: v * 2, lambda v: v * 10, [x])
+    assert float(out.asnumpy()) == 6.0
+
+    class C(HybridBlock):
+        def forward(self, x):
+            return npx.cond(x > 1, lambda v: v * 2, lambda v: v * 10, [x])
+
+    net = C()
+    net.hybridize()
+    assert float(net(mx.np.array(3.0)).asnumpy()) == 6.0
+    assert float(net(mx.np.array(0.5)).asnumpy()) == 5.0
+
+
+def test_while_loop_requires_max_iterations_in_trace():
+    class Loop(HybridBlock):
+        def forward(self, i):
+            return npx.while_loop(lambda i: i < 5, lambda i: (i, [i + 1]), [i])
+
+    net = Loop()
+    net.hybridize()
+    with pytest.raises(Exception, match="max_iterations"):
+        net(mx.np.array(0.0))

@@ -1,0 +1,105 @@
+"""Estimator tests (reference: `tests/python/unittest/test_gluon_estimator.py`,
+`test_gluon_event_handler.py`)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn, loss as gloss, metric as gmetric
+from mxnet_tpu.gluon.contrib.estimator import (
+    CheckpointHandler, EarlyStoppingHandler, Estimator, LoggingHandler,
+)
+
+
+def _toy_data(n=32, dim=4, classes=3, batch=8):
+    xs = onp.random.uniform(-1, 1, (n, dim)).astype("float32")
+    w = onp.random.uniform(-1, 1, (dim, classes))
+    ys = (xs @ w).argmax(axis=1).astype("int32")
+    batches = []
+    for i in range(0, n, batch):
+        batches.append((mx.np.array(xs[i:i + batch]),
+                        mx.np.array(ys[i:i + batch], dtype="int32")))
+    return batches
+
+
+def _toy_net(classes=3):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(classes))
+    net.initialize()
+    return net
+
+
+def test_estimator_fit_improves_loss():
+    net = _toy_net()
+    data = _toy_data()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=gmetric.Accuracy(),
+                    trainer=mx.gluon.Trainer(net.collect_params(), "adam",
+                                             {"learning_rate": 0.05},
+                                             kvstore=None))
+    est.fit(train_data=data, epochs=1)
+    first = est.train_loss_metric.get()[1]
+    est.fit(train_data=data, epochs=5)
+    assert est.train_loss_metric.get()[1] < first
+
+
+def test_estimator_validation():
+    net = _toy_net()
+    data = _toy_data()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(),
+                    val_metrics=gmetric.Accuracy())
+    est.fit(train_data=data, val_data=data, epochs=2)
+    name, acc = est.val_metrics[0].get()
+    assert 0.0 <= acc <= 1.0
+
+
+def test_estimator_max_batch_stops():
+    net = _toy_net()
+    data = _toy_data()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss())
+    counted = []
+
+    from mxnet_tpu.gluon.contrib.estimator.event_handler import BatchEnd
+
+    class Counter(BatchEnd):
+        def batch_end(self, estimator, *args, **kwargs):
+            counted.append(1)
+
+    est.fit(train_data=data, batches=3, event_handlers=[Counter()])
+    assert len(counted) == 3
+
+
+def test_checkpoint_handler(tmp_path):
+    net = _toy_net()
+    data = _toy_data()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss())
+    ckpt = CheckpointHandler(str(tmp_path), model_prefix="toy")
+    est.fit(train_data=data, epochs=2, event_handlers=[ckpt])
+    assert os.path.exists(tmp_path / "toy-epoch0.params")
+    assert os.path.exists(tmp_path / "toy-epoch1.params")
+    # resume picks up the newest epoch
+    net2 = _toy_net()
+    est2 = Estimator(net2, gloss.SoftmaxCrossEntropyLoss())
+    ckpt2 = CheckpointHandler(str(tmp_path), model_prefix="toy",
+                              resume_from_checkpoint=True)
+    est2.fit(train_data=data, epochs=3, event_handlers=[ckpt2])
+    assert est2.resumed_epoch == 2
+
+
+def test_early_stopping():
+    net = _toy_net()
+    data = _toy_data()
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss())
+    monitor = est.train_loss_metric
+
+    class _Frozen:
+        """Monitor that never improves."""
+        def get(self):
+            return ("loss", 1.0)
+
+    stopper = EarlyStoppingHandler(monitor=_Frozen(), patience=1)
+    est.fit(train_data=data, epochs=50, event_handlers=[stopper])
+    assert stopper.stop_training
+    assert stopper.current_epoch < 50
