@@ -1,0 +1,195 @@
+"""Native (C++) runtime components: build-on-first-use loader.
+
+The reference ships its runtime as `libmxnet.so` built by CMake; here the
+native pieces live in `mxnet_tpu/src/*.cc` and are compiled once into
+`libmxtpu.so` next to this package (g++ is in the image).  Pure-python
+fallbacks exist for every native path, so a missing toolchain degrades
+gracefully rather than breaking import.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(_HERE), "src")
+_SO = os.path.join(_HERE, "libmxtpu.so")
+
+
+def _build():
+    srcs = [os.path.join(_SRC, f) for f in sorted(os.listdir(_SRC))
+            if f.endswith(".cc")]
+    if not srcs:
+        return False
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= newest_src:
+        return True
+    # compile to a per-pid temp file and rename: concurrent importers
+    # (DataLoader workers, parallel jobs) must never load a half-written .so
+    tmp = "%s.tmp.%d" % (_SO, os.getpid())
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    return True
+
+
+def lib():
+    """The loaded native library, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            L = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        # recordio
+        L.rio_last_error.restype = ctypes.c_char_p
+        L.rio_open_reader.restype = ctypes.c_void_p
+        L.rio_open_reader.argtypes = [ctypes.c_char_p]
+        L.rio_close_reader.argtypes = [ctypes.c_void_p]
+        L.rio_num_records.restype = ctypes.c_int64
+        L.rio_num_records.argtypes = [ctypes.c_void_p]
+        L.rio_read_record.restype = ctypes.c_int
+        L.rio_read_record.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64)]
+        L.rio_read_at.restype = ctypes.c_int
+        L.rio_read_at.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64)]
+        L.rio_next_record.restype = ctypes.c_int
+        L.rio_next_record.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64)]
+        L.rio_reset.argtypes = [ctypes.c_void_p]
+        L.rio_record_offset.restype = ctypes.c_uint64
+        L.rio_record_offset.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        L.rio_seek.restype = ctypes.c_int
+        L.rio_seek.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        L.rio_reader_tell.restype = ctypes.c_uint64
+        L.rio_reader_tell.argtypes = [ctypes.c_void_p]
+        L.rio_open_writer.restype = ctypes.c_void_p
+        L.rio_open_writer.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        L.rio_writer_tell.restype = ctypes.c_int64
+        L.rio_writer_tell.argtypes = [ctypes.c_void_p]
+        L.rio_write_record.restype = ctypes.c_int
+        L.rio_write_record.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint64]
+        L.rio_close_writer.argtypes = [ctypes.c_void_p]
+        _lib = L
+        return _lib
+
+
+class NativeRecordReader:
+    """Indexed, zero-copy reader over the native mmap core."""
+
+    def __init__(self, path):
+        L = lib()
+        if L is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = L
+        self._h = L.rio_open_reader(path.encode())
+        if not self._h:
+            raise IOError(L.rio_last_error().decode())
+
+    def __len__(self):
+        return self._lib.rio_num_records(self._h)
+
+    def read(self, i):
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_uint64()
+        if self._lib.rio_read_record(self._h, i, ctypes.byref(data),
+                                     ctypes.byref(n)) != 0:
+            raise IOError(self._lib.rio_last_error().decode())
+        return ctypes.string_at(data, n.value)
+
+    def read_at(self, offset):
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_uint64()
+        if self._lib.rio_read_at(self._h, offset, ctypes.byref(data),
+                                 ctypes.byref(n)) != 0:
+            raise IOError(self._lib.rio_last_error().decode())
+        return ctypes.string_at(data, n.value)
+
+    def next(self):
+        data = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_uint64()
+        rc = self._lib.rio_next_record(self._h, ctypes.byref(data),
+                                       ctypes.byref(n))
+        if rc == -1:  # EOF (including a truncated trailing record)
+            return None
+        if rc < -1:
+            raise IOError(self._lib.rio_last_error().decode())
+        return ctypes.string_at(data, n.value)
+
+    def reset(self):
+        self._lib.rio_reset(self._h)
+
+    def seek_offset(self, offset):
+        """Position the sequential cursor at the record starting at byte
+        ``offset`` (as stored in .idx files)."""
+        if self._lib.rio_seek(self._h, offset) != 0:
+            raise IOError(self._lib.rio_last_error().decode())
+
+    def tell(self):
+        """Byte offset of the next sequential record (file size at EOF)."""
+        return self._lib.rio_reader_tell(self._h)
+
+    def offset(self, i):
+        return self._lib.rio_record_offset(self._h, i)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.rio_close_reader(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+
+class NativeRecordWriter:
+    def __init__(self, path, append=False):
+        L = lib()
+        if L is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = L
+        self._h = L.rio_open_writer(path.encode(), 1 if append else 0)
+        if not self._h:
+            raise IOError(L.rio_last_error().decode())
+
+    def tell(self):
+        return self._lib.rio_writer_tell(self._h)
+
+    def write(self, buf):
+        if self._lib.rio_write_record(self._h, bytes(buf), len(buf)) != 0:
+            raise IOError(self._lib.rio_last_error().decode())
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.rio_close_writer(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()

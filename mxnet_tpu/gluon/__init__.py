@@ -8,6 +8,7 @@ from . import loss
 from . import metric
 from . import data
 from . import model_zoo
+from . import probability
 from . import contrib
 from . import utils
 from .utils import split_and_load, clip_global_norm

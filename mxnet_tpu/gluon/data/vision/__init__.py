@@ -1,6 +1,6 @@
 from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
-                       ImageFolderDataset)
+                       ImageFolderDataset, ImageRecordDataset)
 from . import transforms
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
-           "ImageFolderDataset", "transforms"]
+           "ImageFolderDataset", "ImageRecordDataset", "transforms"]

@@ -189,3 +189,31 @@ class ImageFolderDataset(Dataset):
 
     def __len__(self):
         return len(self.items)
+
+
+class ImageRecordDataset(Dataset):
+    """Dataset over a packed ImageRecord file (reference
+    `gluon/data/vision/datasets.py` ImageRecordDataset over im2rec output):
+    each record is `pack_img` framed (IRHeader + encoded image), read through
+    the native recordio core when built."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ...data.dataset import RecordFileDataset
+        self._base = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._base)
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack_img
+        record = self._base[idx]
+        header, img = unpack_img(record, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+__all__.append("ImageRecordDataset")

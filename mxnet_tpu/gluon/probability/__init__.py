@@ -1,0 +1,18 @@
+"""gluon.probability — distributions, transformations, stochastic blocks.
+
+Reference: `python/mxnet/gluon/probability/` (30+ distributions over mx.np
+ops, StochasticBlock, transformations).  TPU-native design: densities are
+pure jnp math dispatched through `ops/invoke.py` (differentiable on the
+tape), sampling draws keys from the functional RNG stream so everything
+jits under `hybridize()`.
+"""
+from .distributions import *  # noqa: F401,F403
+from .distributions import __all__ as _dist_all
+from .transformation import *  # noqa: F401,F403
+from .transformation import __all__ as _trans_all
+from .stochastic_block import StochasticBlock, StochasticSequential  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+
+__all__ = list(_dist_all) + list(_trans_all) + [
+    "StochasticBlock", "StochasticSequential", "kl_divergence", "register_kl",
+]

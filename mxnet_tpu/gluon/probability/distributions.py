@@ -1,0 +1,1063 @@
+"""Distribution classes.
+
+Reference: `python/mxnet/gluon/probability/distributions/` — one module per
+distribution (normal.py, bernoulli.py, ...), each exposing log_prob /
+sample / sample_n / mean / variance / entropy over mx.np ops.  Collapsed
+here into one module: every density is a jnp lowering dispatched through
+``invoke`` (autograd-visible, jit-traceable), and sampling pulls keys from
+`mxnet_tpu.random`'s stream (hybridize-safe).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ...ndarray.ndarray import NDArray
+from ...ops.invoke import invoke
+from ... import random as _rng
+
+__all__ = [
+    "Distribution", "Normal", "LogNormal", "HalfNormal", "Laplace", "Cauchy",
+    "HalfCauchy", "Uniform", "Exponential", "Gamma", "Beta", "Chi2", "Pareto",
+    "Weibull", "Gumbel", "StudentT", "Bernoulli", "Binomial", "Geometric",
+    "Poisson", "Categorical", "OneHotCategorical", "Multinomial", "Dirichlet",
+    "MultivariateNormal", "Independent", "MixtureSameFamily",
+]
+
+
+def _raw(x):
+    return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _op(fun, *args, name):
+    return invoke(fun, args, name=name)
+
+
+def _sample_op(fun, *args, name):
+    key = _rng.new_key()
+    return invoke(lambda *a: fun(key, *a), args, name=name,
+                  differentiable=False)
+
+
+def _rsample_op(fun, *args, name):
+    """Reparameterized sample — differentiable w.r.t. the parameters."""
+    key = _rng.new_key()
+    return invoke(lambda *a: fun(key, *a), args, name=name)
+
+
+class Distribution:
+    """Base class (reference `distributions/distribution.py`)."""
+
+    has_grad = False
+    has_enumerate_support = False
+    arg_constraints = {}
+    event_dim = 0
+
+    def __init__(self, F=None, event_dim=None, validate_args=None):
+        if event_dim is not None:
+            self.event_dim = event_dim
+
+    # -- interface -----------------------------------------------------
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _op(jnp.exp, self.log_prob(value), name="prob")
+
+    def sample(self, size=None):
+        raise NotImplementedError
+
+    def sample_n(self, size=None):
+        n = (size,) if isinstance(size, int) else tuple(size or ())
+        return self.sample(n + self._batch_shape())
+
+    def rsample(self, size=None):
+        if not self.has_grad:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no reparameterized sampler")
+        return self.sample(size)
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def _batch_shape(self):
+        return ()
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape()
+
+    def broadcast_to(self, batch_shape):
+        return self
+
+
+def _bshape(*params):
+    shape = ()
+    for p in params:
+        shape = jnp.broadcast_shapes(shape, jnp.shape(_raw(p)))
+    return shape
+
+
+def _full_shape(size, batch):
+    if size is None:
+        return batch
+    if isinstance(size, int):
+        size = (size,)
+    return tuple(size)
+
+
+class Normal(Distribution):
+    """Reference `distributions/normal.py`."""
+
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc = loc
+        self.scale = scale
+
+    def _batch_shape(self):
+        return _bshape(self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            var = scale ** 2
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) \
+                - 0.5 * math.log(2 * math.pi)
+        return _op(f, value, self.loc, self.scale, name="normal_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, loc, scale):
+            return loc + scale * jax.random.normal(
+                key, size, dtype=jnp.result_type(float))
+        return _rsample_op(f, self.loc, self.scale, name="normal_sample")
+
+    rsample = sample
+
+    def cdf(self, value):
+        return _op(lambda v, l, s: 0.5 * (1 + jsp.erf((v - l) / (s * math.sqrt(2)))),
+                   value, self.loc, self.scale, name="normal_cdf")
+
+    def icdf(self, value):
+        return _op(lambda v, l, s: l + s * math.sqrt(2) * jsp.erfinv(2 * v - 1),
+                   value, self.loc, self.scale, name="normal_icdf")
+
+    @property
+    def mean(self):
+        return _op(lambda l, s: jnp.broadcast_to(l, _bshape(l, s)),
+                   self.loc, self.scale, name="mean")
+
+    @property
+    def variance(self):
+        return _op(lambda l, s: jnp.broadcast_to(s ** 2, _bshape(l, s)),
+                   self.loc, self.scale, name="variance")
+
+    @property
+    def stddev(self):
+        return _op(lambda l, s: jnp.broadcast_to(s, _bshape(l, s)),
+                   self.loc, self.scale, name="stddev")
+
+    def entropy(self):
+        return _op(lambda l, s: jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s), _bshape(l, s)),
+            self.loc, self.scale, name="entropy")
+
+
+class LogNormal(Distribution):
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc = loc
+        self.scale = scale
+        self._base = Normal(loc, scale)
+
+    def _batch_shape(self):
+        return _bshape(self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            logv = jnp.log(v)
+            var = scale ** 2
+            return -((logv - loc) ** 2) / (2 * var) - jnp.log(scale) \
+                - logv - 0.5 * math.log(2 * math.pi)
+        return _op(f, value, self.loc, self.scale, name="lognormal_log_prob")
+
+    def sample(self, size=None):
+        s = self._base.sample(size)
+        return _op(jnp.exp, s, name="lognormal_sample")
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        return _op(lambda l, s: jnp.exp(l + s ** 2 / 2), self.loc, self.scale,
+                   name="mean")
+
+    @property
+    def variance(self):
+        return _op(lambda l, s: (jnp.exp(s ** 2) - 1) * jnp.exp(2 * l + s ** 2),
+                   self.loc, self.scale, name="variance")
+
+
+class HalfNormal(Distribution):
+    has_grad = True
+
+    def __init__(self, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = scale
+
+    def _batch_shape(self):
+        return _bshape(self.scale)
+
+    def log_prob(self, value):
+        return _op(lambda v, s: 0.5 * math.log(2 / math.pi) - jnp.log(s)
+                   - v ** 2 / (2 * s ** 2)
+                   + jnp.where(v >= 0, 0.0, -jnp.inf),
+                   value, self.scale, name="halfnormal_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, s):
+            return jnp.abs(s * jax.random.normal(
+                key, size, dtype=jnp.result_type(float)))
+        return _rsample_op(f, self.scale, name="halfnormal_sample")
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        return _op(lambda s: s * math.sqrt(2 / math.pi), self.scale,
+                   name="mean")
+
+    @property
+    def variance(self):
+        return _op(lambda s: s ** 2 * (1 - 2 / math.pi), self.scale,
+                   name="variance")
+
+
+class Laplace(Distribution):
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc = loc
+        self.scale = scale
+
+    def _batch_shape(self):
+        return _bshape(self.loc, self.scale)
+
+    def log_prob(self, value):
+        return _op(lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+                   value, self.loc, self.scale, name="laplace_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, l, s):
+            return l + s * jax.random.laplace(
+                key, size, dtype=jnp.result_type(float))
+        return _rsample_op(f, self.loc, self.scale, name="laplace_sample")
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        return _op(lambda l, s: jnp.broadcast_to(l, _bshape(l, s)),
+                   self.loc, self.scale, name="mean")
+
+    @property
+    def variance(self):
+        return _op(lambda l, s: jnp.broadcast_to(2 * s ** 2, _bshape(l, s)),
+                   self.loc, self.scale, name="variance")
+
+    def entropy(self):
+        return _op(lambda l, s: jnp.broadcast_to(1 + jnp.log(2 * s),
+                                                 _bshape(l, s)),
+                   self.loc, self.scale, name="entropy")
+
+
+class Cauchy(Distribution):
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc = loc
+        self.scale = scale
+
+    def _batch_shape(self):
+        return _bshape(self.loc, self.scale)
+
+    def log_prob(self, value):
+        return _op(lambda v, l, s: -math.log(math.pi) - jnp.log(s)
+                   - jnp.log1p(((v - l) / s) ** 2),
+                   value, self.loc, self.scale, name="cauchy_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, l, s):
+            return l + s * jax.random.cauchy(
+                key, size, dtype=jnp.result_type(float))
+        return _rsample_op(f, self.loc, self.scale, name="cauchy_sample")
+
+    rsample = sample
+
+    def cdf(self, value):
+        return _op(lambda v, l, s: jnp.arctan((v - l) / s) / math.pi + 0.5,
+                   value, self.loc, self.scale, name="cauchy_cdf")
+
+
+class HalfCauchy(Distribution):
+    has_grad = True
+
+    def __init__(self, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = scale
+
+    def _batch_shape(self):
+        return _bshape(self.scale)
+
+    def log_prob(self, value):
+        return _op(lambda v, s: math.log(2 / math.pi) - jnp.log(s)
+                   - jnp.log1p((v / s) ** 2)
+                   + jnp.where(v >= 0, 0.0, -jnp.inf),
+                   value, self.scale, name="halfcauchy_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, s):
+            return jnp.abs(s * jax.random.cauchy(
+                key, size, dtype=jnp.result_type(float)))
+        return _rsample_op(f, self.scale, name="halfcauchy_sample")
+
+    rsample = sample
+
+
+class Uniform(Distribution):
+    has_grad = True
+
+    def __init__(self, low=0.0, high=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.low = low
+        self.high = high
+
+    def _batch_shape(self):
+        return _bshape(self.low, self.high)
+
+    def log_prob(self, value):
+        return _op(lambda v, lo, hi: jnp.where(
+            (v >= lo) & (v <= hi), -jnp.log(hi - lo), -jnp.inf),
+            value, self.low, self.high, name="uniform_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, lo, hi):
+            return lo + (hi - lo) * jax.random.uniform(
+                key, size, dtype=jnp.result_type(float))
+        return _rsample_op(f, self.low, self.high, name="uniform_sample")
+
+    rsample = sample
+
+    def cdf(self, value):
+        return _op(lambda v, lo, hi: jnp.clip((v - lo) / (hi - lo), 0, 1),
+                   value, self.low, self.high, name="uniform_cdf")
+
+    @property
+    def mean(self):
+        return _op(lambda lo, hi: (lo + hi) / 2, self.low, self.high,
+                   name="mean")
+
+    @property
+    def variance(self):
+        return _op(lambda lo, hi: (hi - lo) ** 2 / 12, self.low, self.high,
+                   name="variance")
+
+    def entropy(self):
+        return _op(lambda lo, hi: jnp.log(hi - lo), self.low, self.high,
+                   name="entropy")
+
+
+class Exponential(Distribution):
+    has_grad = True
+
+    def __init__(self, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.scale = scale  # reference parameterizes by scale = 1/rate
+
+    def _batch_shape(self):
+        return _bshape(self.scale)
+
+    def log_prob(self, value):
+        return _op(lambda v, s: -jnp.log(s) - v / s, value, self.scale,
+                   name="exponential_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, s):
+            return s * jax.random.exponential(
+                key, size, dtype=jnp.result_type(float))
+        return _rsample_op(f, self.scale, name="exponential_sample")
+
+    rsample = sample
+
+    def cdf(self, value):
+        return _op(lambda v, s: 1 - jnp.exp(-v / s), value, self.scale,
+                   name="exponential_cdf")
+
+    @property
+    def mean(self):
+        return _op(lambda s: s + 0.0, self.scale, name="mean")
+
+    @property
+    def variance(self):
+        return _op(lambda s: s ** 2, self.scale, name="variance")
+
+    def entropy(self):
+        return _op(lambda s: 1 + jnp.log(s), self.scale, name="entropy")
+
+
+class Gamma(Distribution):
+    has_grad = True  # jax.random.gamma has implicit-reparameterization grads
+
+    def __init__(self, shape=1.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.shape_param = shape
+        self.scale = scale
+
+    def _batch_shape(self):
+        return _bshape(self.shape_param, self.scale)
+
+    def log_prob(self, value):
+        return _op(lambda v, a, s: (a - 1) * jnp.log(v) - v / s
+                   - jsp.gammaln(a) - a * jnp.log(s),
+                   value, self.shape_param, self.scale, name="gamma_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, a, s):
+            a_b = jnp.broadcast_to(a, size)
+            return s * jax.random.gamma(key, a_b, dtype=jnp.result_type(float))
+        return _rsample_op(f, self.shape_param, self.scale,
+                           name="gamma_sample")
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        return _op(lambda a, s: a * s, self.shape_param, self.scale,
+                   name="mean")
+
+    @property
+    def variance(self):
+        return _op(lambda a, s: a * s ** 2, self.shape_param, self.scale,
+                   name="variance")
+
+    def entropy(self):
+        return _op(lambda a, s: a + jnp.log(s) + jsp.gammaln(a)
+                   + (1 - a) * jsp.digamma(a),
+                   self.shape_param, self.scale, name="entropy")
+
+
+class Beta(Distribution):
+    has_grad = True
+
+    def __init__(self, alpha=1.0, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = alpha
+        self.beta = beta
+
+    def _batch_shape(self):
+        return _bshape(self.alpha, self.beta)
+
+    def log_prob(self, value):
+        return _op(lambda v, a, b: (a - 1) * jnp.log(v)
+                   + (b - 1) * jnp.log1p(-v) + jsp.gammaln(a + b)
+                   - jsp.gammaln(a) - jsp.gammaln(b),
+                   value, self.alpha, self.beta, name="beta_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, a, b):
+            return jax.random.beta(key, jnp.broadcast_to(a, size),
+                                   jnp.broadcast_to(b, size))
+        return _rsample_op(f, self.alpha, self.beta, name="beta_sample")
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        return _op(lambda a, b: a / (a + b), self.alpha, self.beta,
+                   name="mean")
+
+    @property
+    def variance(self):
+        return _op(lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                   self.alpha, self.beta, name="variance")
+
+
+class Chi2(Gamma):
+    def __init__(self, df, **kwargs):
+        super().__init__(shape=_op(lambda d: d / 2, df, name="chi2_shape")
+                         if isinstance(df, NDArray) else df / 2.0,
+                         scale=2.0, **kwargs)
+        self.df = df
+
+
+class Pareto(Distribution):
+    has_grad = True
+
+    def __init__(self, alpha=1.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = alpha
+        self.scale = scale
+
+    def _batch_shape(self):
+        return _bshape(self.alpha, self.scale)
+
+    def log_prob(self, value):
+        return _op(lambda v, a, m: jnp.log(a) + a * jnp.log(m)
+                   - (a + 1) * jnp.log(v)
+                   + jnp.where(v >= m, 0.0, -jnp.inf),
+                   value, self.alpha, self.scale, name="pareto_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, a, m):
+            u = jax.random.uniform(key, size, dtype=jnp.result_type(float))
+            return m * (1 - u) ** (-1 / a)
+        return _rsample_op(f, self.alpha, self.scale, name="pareto_sample")
+
+    rsample = sample
+
+
+class Weibull(Distribution):
+    has_grad = True
+
+    def __init__(self, concentration=1.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.concentration = concentration
+        self.scale = scale
+
+    def _batch_shape(self):
+        return _bshape(self.concentration, self.scale)
+
+    def log_prob(self, value):
+        return _op(lambda v, k, s: jnp.log(k / s)
+                   + (k - 1) * jnp.log(v / s) - (v / s) ** k,
+                   value, self.concentration, self.scale,
+                   name="weibull_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, k, s):
+            u = jax.random.uniform(key, size, dtype=jnp.result_type(float))
+            return s * (-jnp.log1p(-u)) ** (1 / k)
+        return _rsample_op(f, self.concentration, self.scale,
+                           name="weibull_sample")
+
+    rsample = sample
+
+
+class Gumbel(Distribution):
+    has_grad = True
+
+    def __init__(self, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.loc = loc
+        self.scale = scale
+
+    def _batch_shape(self):
+        return _bshape(self.loc, self.scale)
+
+    def log_prob(self, value):
+        return _op(lambda v, l, s: -( (v - l) / s + jnp.exp(-(v - l) / s))
+                   - jnp.log(s),
+                   value, self.loc, self.scale, name="gumbel_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, l, s):
+            return l + s * jax.random.gumbel(
+                key, size, dtype=jnp.result_type(float))
+        return _rsample_op(f, self.loc, self.scale, name="gumbel_sample")
+
+    rsample = sample
+
+
+class StudentT(Distribution):
+    has_grad = True
+
+    def __init__(self, df, loc=0.0, scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.df = df
+        self.loc = loc
+        self.scale = scale
+
+    def _batch_shape(self):
+        return _bshape(self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, df, l, s):
+            z = (v - l) / s
+            return jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2) \
+                - 0.5 * jnp.log(df * math.pi) - jnp.log(s) \
+                - (df + 1) / 2 * jnp.log1p(z ** 2 / df)
+        return _op(f, value, self.df, self.loc, self.scale,
+                   name="studentt_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+
+        def f(key, df, l, s):
+            return l + s * jax.random.t(
+                key, jnp.broadcast_to(df, size), dtype=jnp.result_type(float))
+        return _rsample_op(f, self.df, self.loc, self.scale,
+                           name="studentt_sample")
+
+    rsample = sample
+
+
+# ---------------------------------------------------------------------------
+# discrete
+# ---------------------------------------------------------------------------
+def _logits_from_prob(prob):
+    return jnp.log(prob) - jnp.log1p(-prob)
+
+
+def _prob_from_logits(logits):
+    return jax.nn.sigmoid(logits)
+
+
+class Bernoulli(Distribution):
+    """Reference `distributions/bernoulli.py`: one of prob/logits given."""
+
+    def __init__(self, prob=None, logits=None, **kwargs):
+        super().__init__(**kwargs)
+        if (prob is None) == (logits is None):
+            raise ValueError("pass exactly one of prob / logits")
+        self._prob = prob
+        self._logits = logits
+
+    def _batch_shape(self):
+        p = self._prob if self._prob is not None else self._logits
+        return _bshape(p)
+
+    @property
+    def prob(self):
+        if self._prob is not None:
+            return self._prob
+        return _op(_prob_from_logits, self._logits, name="bernoulli_prob")
+
+    @property
+    def logits(self):
+        if self._logits is not None:
+            return self._logits
+        return _op(_logits_from_prob, self._prob, name="bernoulli_logits")
+
+    def log_prob(self, value):
+        if self._logits is not None:
+            return _op(lambda v, lg: v * lg - jax.nn.softplus(lg), value,
+                       self._logits, name="bernoulli_log_prob")
+        return _op(lambda v, p: v * jnp.log(p) + (1 - v) * jnp.log1p(-p),
+                   value, self._prob, name="bernoulli_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+        p = self.prob
+        return _sample_op(
+            lambda key, p_: jax.random.bernoulli(
+                key, p_, size).astype(jnp.result_type(float)),
+            p, name="bernoulli_sample")
+
+    @property
+    def mean(self):
+        return self.prob
+
+    @property
+    def variance(self):
+        return _op(lambda p: p * (1 - p), self.prob, name="variance")
+
+    def entropy(self):
+        return _op(lambda p: -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)),
+                   self.prob, name="entropy")
+
+
+class Binomial(Distribution):
+    def __init__(self, n=1, prob=None, logits=None, **kwargs):
+        super().__init__(**kwargs)
+        if (prob is None) == (logits is None):
+            raise ValueError("pass exactly one of prob / logits")
+        self.n = n
+        self._prob = prob
+        self._logits = logits
+
+    @property
+    def prob(self):
+        if self._prob is not None:
+            return self._prob
+        return _op(_prob_from_logits, self._logits, name="binomial_prob")
+
+    def _batch_shape(self):
+        p = self._prob if self._prob is not None else self._logits
+        return _bshape(p)
+
+    def log_prob(self, value):
+        n = self.n
+        return _op(lambda v, p: jsp.gammaln(n + 1.0) - jsp.gammaln(v + 1.0)
+                   - jsp.gammaln(n - v + 1.0) + v * jnp.log(p)
+                   + (n - v) * jnp.log1p(-p),
+                   value, self.prob, name="binomial_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+        n = self.n
+        return _sample_op(
+            lambda key, p: jax.random.binomial(
+                key, n, p, shape=size).astype(jnp.result_type(float)),
+            self.prob, name="binomial_sample")
+
+    @property
+    def mean(self):
+        return _op(lambda p: self.n * p, self.prob, name="mean")
+
+    @property
+    def variance(self):
+        return _op(lambda p: self.n * p * (1 - p), self.prob, name="variance")
+
+
+class Geometric(Distribution):
+    def __init__(self, prob=None, logits=None, **kwargs):
+        super().__init__(**kwargs)
+        if (prob is None) == (logits is None):
+            raise ValueError("pass exactly one of prob / logits")
+        self._prob = prob
+        self._logits = logits
+
+    @property
+    def prob(self):
+        if self._prob is not None:
+            return self._prob
+        return _op(_prob_from_logits, self._logits, name="geometric_prob")
+
+    def _batch_shape(self):
+        p = self._prob if self._prob is not None else self._logits
+        return _bshape(p)
+
+    def log_prob(self, value):
+        return _op(lambda v, p: v * jnp.log1p(-p) + jnp.log(p), value,
+                   self.prob, name="geometric_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+        return _sample_op(
+            lambda key, p: jax.random.geometric(
+                key, p, shape=size).astype(jnp.result_type(float)) - 1,
+            self.prob, name="geometric_sample")
+
+    @property
+    def mean(self):
+        return _op(lambda p: (1 - p) / p, self.prob, name="mean")
+
+    @property
+    def variance(self):
+        return _op(lambda p: (1 - p) / p ** 2, self.prob, name="variance")
+
+
+class Poisson(Distribution):
+    def __init__(self, rate=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.rate = rate
+
+    def _batch_shape(self):
+        return _bshape(self.rate)
+
+    def log_prob(self, value):
+        return _op(lambda v, r: v * jnp.log(r) - r - jsp.gammaln(v + 1),
+                   value, self.rate, name="poisson_log_prob")
+
+    def sample(self, size=None):
+        size = _full_shape(size, self._batch_shape())
+        return _sample_op(
+            lambda key, r: jax.random.poisson(
+                key, r, shape=size).astype(jnp.result_type(float)),
+            self.rate, name="poisson_sample")
+
+    @property
+    def mean(self):
+        return _op(lambda r: r + 0.0, self.rate, name="mean")
+
+    @property
+    def variance(self):
+        return _op(lambda r: r + 0.0, self.rate, name="variance")
+
+
+class Categorical(Distribution):
+    """Reference `distributions/categorical.py` (int samples over classes)."""
+
+    has_enumerate_support = True
+
+    def __init__(self, num_events=None, prob=None, logits=None, **kwargs):
+        super().__init__(**kwargs)
+        if (prob is None) == (logits is None):
+            raise ValueError("pass exactly one of prob / logits")
+        self._prob = prob
+        self._logits = logits
+        p = prob if prob is not None else logits
+        self.num_events = num_events or jnp.shape(_raw(p))[-1]
+
+    def _batch_shape(self):
+        p = self._prob if self._prob is not None else self._logits
+        return jnp.shape(_raw(p))[:-1]
+
+    @property
+    def logits(self):
+        if self._logits is not None:
+            return self._logits
+        return _op(jnp.log, self._prob, name="categorical_logits")
+
+    @property
+    def prob(self):
+        if self._prob is not None:
+            return self._prob
+        return _op(lambda lg: jax.nn.softmax(lg, axis=-1), self._logits,
+                   name="categorical_prob")
+
+    def log_prob(self, value):
+        return _op(lambda v, lg: jnp.take_along_axis(
+            jax.nn.log_softmax(lg, axis=-1),
+            v.astype(jnp.int32)[..., None], axis=-1)[..., 0],
+            value, self.logits, name="categorical_log_prob")
+
+    def sample(self, size=None):
+        batch = self._batch_shape()
+        size = _full_shape(size, batch)
+        return _sample_op(
+            lambda key, lg: jax.random.categorical(
+                key, lg, shape=size).astype(jnp.result_type(float)),
+            self.logits, name="categorical_sample")
+
+    def enumerate_support(self):
+        return _op(lambda lg: jnp.arange(self.num_events,
+                                         dtype=jnp.result_type(float)),
+                   self.logits, name="categorical_support")
+
+
+class OneHotCategorical(Categorical):
+    def sample(self, size=None):
+        idx = super().sample(size)
+        return _op(lambda i: jax.nn.one_hot(i.astype(jnp.int32),
+                                            self.num_events),
+                   idx, name="onehot_sample")
+
+    def log_prob(self, value):
+        return _op(lambda v, lg: jnp.sum(
+            v * jax.nn.log_softmax(lg, axis=-1), axis=-1),
+            value, self.logits, name="onehot_log_prob")
+
+    def enumerate_support(self):
+        # support points are one-hot vectors, not integer indices
+        return _op(lambda lg: jnp.eye(self.num_events,
+                                      dtype=jnp.result_type(float)),
+                   self.logits, name="onehot_support")
+
+
+class Multinomial(Distribution):
+    def __init__(self, num_events, prob=None, logits=None, total_count=1,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if (prob is None) == (logits is None):
+            raise ValueError("pass exactly one of prob / logits")
+        self._cat = Categorical(num_events, prob=prob, logits=logits)
+        self.num_events = num_events
+        self.total_count = total_count
+
+    def _batch_shape(self):
+        return self._cat._batch_shape()
+
+    def log_prob(self, value):
+        return _op(lambda v, lg: jnp.sum(
+            v * jax.nn.log_softmax(lg, axis=-1), axis=-1)
+            + jsp.gammaln(jnp.sum(v, -1) + 1)
+            - jnp.sum(jsp.gammaln(v + 1), -1),
+            value, self._cat.logits, name="multinomial_log_prob")
+
+    def sample(self, size=None):
+        n = self.total_count
+        idx = self._cat.sample((n,) + _full_shape(size, self._batch_shape()))
+
+        def f(i):
+            oh = jax.nn.one_hot(i.astype(jnp.int32), self.num_events)
+            return jnp.sum(oh, axis=0)
+        return _op(f, idx, name="multinomial_sample")
+
+
+class Dirichlet(Distribution):
+    has_grad = True
+    event_dim = 1
+
+    def __init__(self, alpha, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = alpha
+
+    def _batch_shape(self):
+        return jnp.shape(_raw(self.alpha))[:-1]
+
+    def log_prob(self, value):
+        return _op(lambda v, a: jnp.sum((a - 1) * jnp.log(v), -1)
+                   + jsp.gammaln(jnp.sum(a, -1))
+                   - jnp.sum(jsp.gammaln(a), -1),
+                   value, self.alpha, name="dirichlet_log_prob")
+
+    def sample(self, size=None):
+        batch = self._batch_shape()
+        event = jnp.shape(_raw(self.alpha))[-1:]
+        size = _full_shape(size, batch)
+
+        def f(key, a):
+            a_b = jnp.broadcast_to(a, tuple(size) + tuple(event))
+            return jax.random.dirichlet(key, a_b.reshape(-1, event[0])) \
+                .reshape(tuple(size) + tuple(event))
+        return _rsample_op(f, self.alpha, name="dirichlet_sample")
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        return _op(lambda a: a / jnp.sum(a, -1, keepdims=True), self.alpha,
+                   name="mean")
+
+
+class MultivariateNormal(Distribution):
+    has_grad = True
+    event_dim = 1
+
+    def __init__(self, loc, cov=None, scale_tril=None, **kwargs):
+        super().__init__(**kwargs)
+        if (cov is None) == (scale_tril is None):
+            raise ValueError("pass exactly one of cov / scale_tril")
+        self.loc = loc
+        self._cov = cov
+        self._scale_tril = scale_tril
+
+    @property
+    def scale_tril(self):
+        if self._scale_tril is not None:
+            return self._scale_tril
+        return _op(jnp.linalg.cholesky, self._cov, name="mvn_chol")
+
+    @property
+    def cov(self):
+        if self._cov is not None:
+            return self._cov
+        return _op(lambda L: L @ jnp.swapaxes(L, -1, -2), self._scale_tril,
+                   name="mvn_cov")
+
+    def _batch_shape(self):
+        return jnp.shape(_raw(self.loc))[:-1]
+
+    def log_prob(self, value):
+        def f(v, loc, L):
+            d = loc.shape[-1]
+            diff = v - loc
+            Lb = jnp.broadcast_to(L, diff.shape[:-1] + L.shape[-2:])
+            sol = jax.scipy.linalg.solve_triangular(Lb, diff[..., None],
+                                                    lower=True)[..., 0]
+            maha = jnp.sum(sol ** 2, -1)
+            logdet = 2 * jnp.sum(jnp.log(jnp.diagonal(Lb, axis1=-2, axis2=-1)),
+                                 -1)
+            return -0.5 * (d * math.log(2 * math.pi) + logdet + maha)
+        return _op(f, value, self.loc, self.scale_tril, name="mvn_log_prob")
+
+    def sample(self, size=None):
+        batch = self._batch_shape()
+        event = jnp.shape(_raw(self.loc))[-1:]
+        size = _full_shape(size, batch)
+
+        def f(key, loc, L):
+            eps = jax.random.normal(key, tuple(size) + tuple(event),
+                                    dtype=jnp.result_type(float))
+            return loc + jnp.einsum("...ij,...j->...i",
+                                    jnp.broadcast_to(
+                                        L, tuple(size) + tuple(event) * 2),
+                                    eps)
+        return _rsample_op(f, self.loc, self.scale_tril, name="mvn_sample")
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        return self.loc
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference
+    `distributions/independent.py`)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims, **kwargs):
+        super().__init__(**kwargs)
+        self.base_dist = base
+        self.ndims = reinterpreted_batch_ndims
+
+    def log_prob(self, value):
+        lp = self.base_dist.log_prob(value)
+        n = self.ndims
+        return _op(lambda x: jnp.sum(x, axis=tuple(range(-n, 0))), lp,
+                   name="independent_log_prob")
+
+    def sample(self, size=None):
+        return self.base_dist.sample(size)
+
+    def rsample(self, size=None):
+        return self.base_dist.rsample(size)
+
+
+class MixtureSameFamily(Distribution):
+    """Reference `distributions/mixture_same_family.py`."""
+
+    def __init__(self, mixture_dist, component_dist, **kwargs):
+        super().__init__(**kwargs)
+        self.mixture_dist = mixture_dist
+        self.component_dist = component_dist
+
+    def log_prob(self, value):
+        # value: batch shape; components add a trailing mixture axis
+        def expand(v):
+            return jnp.expand_dims(v, -1)
+        v_exp = _op(expand, value, name="mixture_expand")
+        comp_lp = self.component_dist.log_prob(v_exp)
+        mix_lp = _op(lambda lg: jax.nn.log_softmax(lg, axis=-1),
+                     self.mixture_dist.logits, name="mixture_weights")
+        return _op(lambda c, m: jsp.logsumexp(c + m, axis=-1),
+                   comp_lp, mix_lp, name="mixture_log_prob")
+
+    def sample(self, size=None):
+        idx = self.mixture_dist.sample(size)
+        # components carry a trailing mixture axis: an explicit size must be
+        # extended with it before gathering the selected component
+        comp_size = None if size is None else (
+            _full_shape(size, ()) + self.component_dist._batch_shape()[-1:])
+        comp = self.component_dist.sample(comp_size)
+        return _op(lambda i, c: jnp.take_along_axis(
+            c, i.astype(jnp.int32)[..., None], axis=-1)[..., 0],
+            idx, comp, name="mixture_sample")

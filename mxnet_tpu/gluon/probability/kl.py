@@ -1,0 +1,135 @@
+"""KL divergence registry.
+
+Reference: `python/mxnet/gluon/probability/distributions/divergence.py`
+(`register_kl` decorator + `kl_divergence` double dispatch).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ...ops.invoke import invoke
+from . import distributions as D
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def decorator(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return decorator
+
+
+def kl_divergence(p, q):
+    """KL(p || q).  Dispatch walks the MRO so subclasses inherit rules."""
+    for tp in type(p).__mro__:
+        for tq in type(q).__mro__:
+            fn = _KL_REGISTRY.get((tp, tq))
+            if fn is not None:
+                return fn(p, q)
+    raise NotImplementedError(
+        f"no KL(p||q) rule for {type(p).__name__} || {type(q).__name__}")
+
+
+def _op(fun, *args, name):
+    return invoke(fun, args, name=name)
+
+
+@register_kl(D.Normal, D.Normal)
+def _kl_normal_normal(p, q):
+    return _op(lambda pl, ps, ql, qs:
+               jnp.log(qs / ps) + (ps ** 2 + (pl - ql) ** 2) / (2 * qs ** 2)
+               - 0.5,
+               p.loc, p.scale, q.loc, q.scale, name="kl_normal")
+
+
+@register_kl(D.Bernoulli, D.Bernoulli)
+def _kl_bernoulli(p, q):
+    return _op(lambda pp, qp: pp * (jnp.log(pp) - jnp.log(qp))
+               + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)),
+               p.prob, q.prob, name="kl_bernoulli")
+
+
+@register_kl(D.Categorical, D.Categorical)
+def _kl_categorical(p, q):
+    import jax
+    return _op(lambda pl, ql: jnp.sum(
+        jax.nn.softmax(pl, -1)
+        * (jax.nn.log_softmax(pl, -1) - jax.nn.log_softmax(ql, -1)), -1),
+        p.logits, q.logits, name="kl_categorical")
+
+
+@register_kl(D.Uniform, D.Uniform)
+def _kl_uniform(p, q):
+    return _op(lambda plo, phi, qlo, qhi: jnp.where(
+        (qlo <= plo) & (phi <= qhi),
+        jnp.log((qhi - qlo) / (phi - plo)), jnp.inf),
+        p.low, p.high, q.low, q.high, name="kl_uniform")
+
+
+@register_kl(D.Exponential, D.Exponential)
+def _kl_exponential(p, q):
+    # scale parameterization: rate = 1/scale
+    return _op(lambda ps, qs: jnp.log(qs / ps) + ps / qs - 1,
+               p.scale, q.scale, name="kl_exponential")
+
+
+@register_kl(D.Gamma, D.Gamma)
+def _kl_gamma(p, q):
+    return _op(lambda pa, ps, qa, qs:
+               (pa - qa) * jsp.digamma(pa) - jsp.gammaln(pa) + jsp.gammaln(qa)
+               + qa * (jnp.log(qs) - jnp.log(ps)) + pa * (ps / qs - 1),
+               p.shape_param, p.scale, q.shape_param, q.scale,
+               name="kl_gamma")
+
+
+@register_kl(D.Laplace, D.Laplace)
+def _kl_laplace(p, q):
+    return _op(lambda pl, ps, ql, qs:
+               jnp.log(qs / ps)
+               + (ps * jnp.exp(-jnp.abs(pl - ql) / ps) + jnp.abs(pl - ql)) / qs
+               - 1,
+               p.loc, p.scale, q.loc, q.scale, name="kl_laplace")
+
+
+@register_kl(D.Poisson, D.Poisson)
+def _kl_poisson(p, q):
+    return _op(lambda pr, qr: pr * (jnp.log(pr) - jnp.log(qr)) - pr + qr,
+               p.rate, q.rate, name="kl_poisson")
+
+
+@register_kl(D.Dirichlet, D.Dirichlet)
+def _kl_dirichlet(p, q):
+    def f(pa, qa):
+        p0 = jnp.sum(pa, -1)
+        q0 = jnp.sum(qa, -1)
+        return (jsp.gammaln(p0) - jsp.gammaln(q0)
+                - jnp.sum(jsp.gammaln(pa) - jsp.gammaln(qa), -1)
+                + jnp.sum((pa - qa)
+                          * (jsp.digamma(pa) - jsp.digamma(p0)[..., None]),
+                          -1))
+    return _op(f, p.alpha, q.alpha, name="kl_dirichlet")
+
+
+@register_kl(D.MultivariateNormal, D.MultivariateNormal)
+def _kl_mvn(p, q):
+    def f(pl, pL, ql, qL):
+        import jax
+        d = pl.shape[-1]
+        diff = ql - pl
+        qLb = jnp.broadcast_to(qL, diff.shape[:-1] + qL.shape[-2:])
+        sol = jax.scipy.linalg.solve_triangular(qLb, diff[..., None],
+                                                lower=True)[..., 0]
+        maha = jnp.sum(sol ** 2, -1)
+        M = jax.scipy.linalg.solve_triangular(
+            qLb, jnp.broadcast_to(pL, qLb.shape), lower=True)
+        tr = jnp.sum(M ** 2, axis=(-2, -1))
+        logdet_p = 2 * jnp.sum(jnp.log(jnp.diagonal(pL, axis1=-2, axis2=-1)), -1)
+        logdet_q = 2 * jnp.sum(jnp.log(jnp.diagonal(qL, axis1=-2, axis2=-1)), -1)
+        return 0.5 * (tr + maha - d + logdet_q - logdet_p)
+    return _op(f, p.loc, p.scale_tril, q.loc, q.scale_tril, name="kl_mvn")

@@ -1,0 +1,52 @@
+"""StochasticBlock: HybridBlock with intermediate-loss collection.
+
+Reference: `python/mxnet/gluon/probability/block/stochastic_block.py` —
+`add_loss` inside forward stores auxiliary losses (e.g. KL terms for VAEs)
+retrievable after the call via `.losses`.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn.basic_layers import HybridSequential
+
+__all__ = ["StochasticBlock", "StochasticSequential"]
+
+
+class StochasticBlock(HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self._losses = []
+        self._collecting = False
+
+    @property
+    def losses(self):
+        return self._losses
+
+    def add_loss(self, loss):
+        self._losses.append(loss)
+
+    def __call__(self, *args, **kwargs):
+        self._losses = []
+        return super().__call__(*args, **kwargs)
+
+
+class StochasticSequential(StochasticBlock):
+    """Reference `stochastic_block.py` StochasticSequential."""
+
+    def __init__(self):
+        super().__init__()
+        self._blocks = []
+
+    def add(self, *blocks):
+        for block in blocks:
+            idx = len(self._blocks)
+            self._blocks.append(block)
+            setattr(self, str(idx), block)
+
+    def forward(self, x, *args):
+        for block in self._blocks:
+            x = block(x)
+            if isinstance(block, StochasticBlock):
+                for loss in block.losses:
+                    self.add_loss(loss)
+        return x

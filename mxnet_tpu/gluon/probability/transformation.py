@@ -1,0 +1,207 @@
+"""Bijective transformations + TransformedDistribution.
+
+Reference: `python/mxnet/gluon/probability/transformation/` (Transformation,
+ExpTransformation, AffineTransformation, ComposeTransformation, ...).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops.invoke import invoke
+from .distributions import Distribution
+
+__all__ = [
+    "Transformation", "ExpTransformation", "AffineTransformation",
+    "SigmoidTransformation", "SoftmaxTransformation", "AbsTransformation",
+    "PowerTransformation", "ComposeTransformation", "TransformedDistribution",
+]
+
+
+def _op(fun, *args, name):
+    return invoke(fun, args, name=name)
+
+
+class Transformation:
+    bijective = True
+
+    def __call__(self, x):
+        return self._forward_compute(x)
+
+    def _forward_compute(self, x):
+        raise NotImplementedError
+
+    def _inverse_compute(self, y):
+        raise NotImplementedError
+
+    @property
+    def inv(self):
+        return _InverseTransformation(self)
+
+    def log_det_jacobian(self, x, y):
+        raise NotImplementedError
+
+
+class _InverseTransformation(Transformation):
+    def __init__(self, base):
+        self._base = base
+
+    def _forward_compute(self, y):
+        return self._base._inverse_compute(y)
+
+    def _inverse_compute(self, x):
+        return self._base._forward_compute(x)
+
+    @property
+    def inv(self):
+        return self._base
+
+    def log_det_jacobian(self, y, x):
+        neg = self._base.log_det_jacobian(x, y)
+        return _op(lambda v: -v, neg, name="inv_log_det")
+
+
+class ExpTransformation(Transformation):
+    def _forward_compute(self, x):
+        return _op(jnp.exp, x, name="exp_transform")
+
+    def _inverse_compute(self, y):
+        return _op(jnp.log, y, name="log_transform")
+
+    def log_det_jacobian(self, x, y):
+        return x
+
+
+class AffineTransformation(Transformation):
+    def __init__(self, loc=0.0, scale=1.0):
+        self.loc = loc
+        self.scale = scale
+
+    def _forward_compute(self, x):
+        return _op(lambda v, l, s: l + s * v, x, self.loc, self.scale,
+                   name="affine_transform")
+
+    def _inverse_compute(self, y):
+        return _op(lambda v, l, s: (v - l) / s, y, self.loc, self.scale,
+                   name="affine_inverse")
+
+    def log_det_jacobian(self, x, y):
+        return _op(lambda v, s: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                 jnp.shape(v)),
+                   x, self.scale, name="affine_log_det")
+
+
+class SigmoidTransformation(Transformation):
+    def _forward_compute(self, x):
+        import jax
+        return _op(jax.nn.sigmoid, x, name="sigmoid_transform")
+
+    def _inverse_compute(self, y):
+        return _op(lambda v: jnp.log(v) - jnp.log1p(-v), y,
+                   name="logit_transform")
+
+    def log_det_jacobian(self, x, y):
+        import jax
+        return _op(lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v), x,
+                   name="sigmoid_log_det")
+
+
+class SoftmaxTransformation(Transformation):
+    bijective = False
+
+    def _forward_compute(self, x):
+        import jax
+        return _op(lambda v: jax.nn.softmax(v, axis=-1), x,
+                   name="softmax_transform")
+
+    def _inverse_compute(self, y):
+        return _op(jnp.log, y, name="softmax_inverse")
+
+
+class AbsTransformation(Transformation):
+    bijective = False
+
+    def _forward_compute(self, x):
+        return _op(jnp.abs, x, name="abs_transform")
+
+    def _inverse_compute(self, y):
+        return y
+
+
+class PowerTransformation(Transformation):
+    def __init__(self, exponent):
+        self.exponent = exponent
+
+    def _forward_compute(self, x):
+        return _op(lambda v, e: v ** e, x, self.exponent,
+                   name="power_transform")
+
+    def _inverse_compute(self, y):
+        return _op(lambda v, e: v ** (1.0 / e), y, self.exponent,
+                   name="power_inverse")
+
+    def log_det_jacobian(self, x, y):
+        return _op(lambda xv, yv, e: jnp.log(jnp.abs(e * yv / xv)),
+                   x, y, self.exponent, name="power_log_det")
+
+
+class ComposeTransformation(Transformation):
+    def __init__(self, parts):
+        self.parts = list(parts)
+
+    def _forward_compute(self, x):
+        for part in self.parts:
+            x = part(x)
+        return x
+
+    def _inverse_compute(self, y):
+        for part in reversed(self.parts):
+            y = part._inverse_compute(y)
+        return y
+
+    def log_det_jacobian(self, x, y):
+        total = None
+        for part in self.parts:
+            x_next = part(x)
+            term = part.log_det_jacobian(x, x_next)
+            total = term if total is None else _op(
+                jnp.add, total, term, name="compose_log_det")
+            x = x_next
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through transformations (reference
+    `transformed_distribution.py`)."""
+
+    def __init__(self, base, transforms, **kwargs):
+        super().__init__(**kwargs)
+        self.base_dist = base
+        if isinstance(transforms, Transformation):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+
+    def sample(self, size=None):
+        x = self.base_dist.sample(size)
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+    def rsample(self, size=None):
+        x = self.base_dist.rsample(size)
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+    def log_prob(self, value):
+        lp = None
+        y = value
+        for t in reversed(self.transforms):
+            x = t._inverse_compute(y)
+            term = t.log_det_jacobian(x, y)
+            lp = term if lp is None else _op(jnp.add, lp, term,
+                                             name="td_log_det")
+            y = x
+        base_lp = self.base_dist.log_prob(y)
+        if lp is None:
+            return base_lp
+        return _op(lambda b, j: b - j, base_lp, lp, name="td_log_prob")

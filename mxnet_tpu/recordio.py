@@ -24,29 +24,58 @@ _IR_FORMAT = "IfQQ"
 _IR_SIZE = struct.calcsize(_IR_FORMAT)
 
 
+def _native_lib():
+    from ._native import lib
+    return lib()
+
+
 class MXRecordIO:
+    """Uses the native mmap reader (`mxnet_tpu/src/recordio.cc`) when the
+    C++ core built; falls back to pure-python framing otherwise."""
+
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.pid = None
         self.fp = None
+        self._nat = None
+        self._natw = None
         self.open()
 
     def open(self):
         if self.flag == "w":
-            self.fp = open(self.uri, "wb")
+            if _native_lib() is not None:
+                from ._native import NativeRecordWriter
+                self._natw = NativeRecordWriter(self.uri)
+            else:
+                self.fp = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.fp = open(self.uri, "rb")
+            if _native_lib() is not None:
+                from ._native import NativeRecordReader
+                self._nat = NativeRecordReader(self.uri)
+            else:
+                self.fp = open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
         self.pid = os.getpid()
 
+    @property
+    def is_open(self):
+        return (self.fp is not None or self._nat is not None
+                or self._natw is not None)
+
     def close(self):
         if self.fp is not None:
             self.fp.close()
             self.fp = None
+        if self._nat is not None:
+            self._nat.close()
+            self._nat = None
+        if self._natw is not None:
+            self._natw.close()
+            self._natw = None
 
     def __del__(self):
         self.close()
@@ -54,6 +83,8 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d["fp"] = None
+        d["_nat"] = None
+        d["_natw"] = None
         return d
 
     def __setstate__(self, d):
@@ -66,16 +97,30 @@ class MXRecordIO:
             self.open()
 
     def reset(self):
+        if self._nat is not None:
+            self._nat.reset()
+            return
         self.close()
         self.open()
 
     def tell(self):
+        if self._natw is not None:
+            return self._natw.tell()
+        if self._nat is not None:
+            return self._nat.tell()
         return self.fp.tell()
 
     def write(self, buf):
         assert self.writable
         self._check_pid()
         length = len(buf)
+        if length >= (1 << 29):
+            raise ValueError(
+                "record of %d bytes exceeds the 29-bit recordio frame limit"
+                % length)
+        if self._natw is not None:
+            self._natw.write(buf)
+            return
         self.fp.write(struct.pack("<II", _kMagic, length))
         self.fp.write(buf)
         pad = (4 - length % 4) % 4
@@ -85,6 +130,8 @@ class MXRecordIO:
     def read(self):
         assert not self.writable
         self._check_pid()
+        if self._nat is not None:
+            return self._nat.next()
         header = self.fp.read(8)
         if len(header) < 8:
             return None
@@ -119,7 +166,7 @@ class MXIndexedRecordIO(MXRecordIO):
                     self.keys.append(key)
 
     def close(self):
-        if self.writable and self.fp is not None:
+        if self.writable and self.is_open:
             with open(self.idx_path, "w") as fout:
                 for key in self.keys:
                     fout.write(f"{key}\t{self.idx[key]}\n")
@@ -128,9 +175,14 @@ class MXIndexedRecordIO(MXRecordIO):
     def seek(self, idx):
         assert not self.writable
         self._check_pid()
-        self.fp.seek(self.idx[idx])
+        if self._nat is not None:
+            self._nat.seek_offset(self.idx[idx])
+        else:
+            self.fp.seek(self.idx[idx])
 
     def read_idx(self, idx):
+        # seek + read in both modes, so the sequential cursor advances past
+        # the record just read (reference semantics)
         self.seek(idx)
         return self.read()
 

@@ -1,0 +1,213 @@
+"""gluon.probability tests (reference: `tests/python/unittest/test_gluon_probability_v2.py`).
+
+Oracles: scipy.stats densities and moment checks on large samples.
+"""
+import numpy as onp
+import pytest
+import scipy.stats as ss
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import probability as mgp
+
+
+def _lp(dist, value):
+    return dist.log_prob(mx.np.array(value)).asnumpy()
+
+
+def test_normal_log_prob_matches_scipy():
+    d = mgp.Normal(loc=mx.np.array([0.0, 1.0]), scale=mx.np.array([1.0, 2.0]))
+    v = onp.array([0.5, -0.3], "float32")
+    expect = ss.norm.logpdf(v, loc=[0, 1], scale=[1, 2])
+    assert onp.allclose(_lp(d, v), expect, atol=1e-5)
+
+
+@pytest.mark.parametrize("mk,scipy_lp", [
+    (lambda: mgp.Laplace(0.5, 1.5), lambda v: ss.laplace.logpdf(v, 0.5, 1.5)),
+    (lambda: mgp.Cauchy(0.0, 2.0), lambda v: ss.cauchy.logpdf(v, 0, 2)),
+    (lambda: mgp.Gumbel(1.0, 2.0), lambda v: ss.gumbel_r.logpdf(v, 1, 2)),
+    (lambda: mgp.StudentT(4.0, 0.0, 1.0), lambda v: ss.t.logpdf(v, 4)),
+])
+def test_continuous_log_prob(mk, scipy_lp):
+    v = onp.array([-1.2, 0.0, 0.7, 3.5], "float32")
+    assert onp.allclose(_lp(mk(), v), scipy_lp(v), atol=1e-4)
+
+
+@pytest.mark.parametrize("mk,scipy_lp,v", [
+    (lambda: mgp.Gamma(2.0, 3.0), lambda v: ss.gamma.logpdf(v, 2, scale=3),
+     onp.array([0.5, 2.0, 7.0], "float32")),
+    (lambda: mgp.Beta(2.0, 3.0), lambda v: ss.beta.logpdf(v, 2, 3),
+     onp.array([0.1, 0.5, 0.9], "float32")),
+    (lambda: mgp.Exponential(2.0), lambda v: ss.expon.logpdf(v, scale=2),
+     onp.array([0.1, 1.0, 5.0], "float32")),
+    (lambda: mgp.Weibull(1.5, 2.0), lambda v: ss.weibull_min.logpdf(v, 1.5, scale=2),
+     onp.array([0.5, 1.0, 3.0], "float32")),
+    (lambda: mgp.Pareto(3.0, 1.0), lambda v: ss.pareto.logpdf(v, 3),
+     onp.array([1.5, 2.0, 5.0], "float32")),
+])
+def test_positive_support_log_prob(mk, scipy_lp, v):
+    assert onp.allclose(_lp(mk(), v), scipy_lp(v), atol=1e-4)
+
+
+def test_discrete_log_prob():
+    assert onp.allclose(
+        _lp(mgp.Poisson(3.0), onp.array([0., 2., 5.])),
+        ss.poisson.logpmf([0, 2, 5], 3.0), atol=1e-5)
+    assert onp.allclose(
+        _lp(mgp.Bernoulli(prob=0.3), onp.array([0., 1.])),
+        ss.bernoulli.logpmf([0, 1], 0.3), atol=1e-5)
+    assert onp.allclose(
+        _lp(mgp.Binomial(10, prob=0.4), onp.array([0., 4., 10.])),
+        ss.binom.logpmf([0, 4, 10], 10, 0.4), atol=1e-4)
+    assert onp.allclose(
+        _lp(mgp.Geometric(prob=0.25), onp.array([0., 3.])),
+        ss.geom.logpmf([1, 4], 0.25), atol=1e-5)  # mx counts failures
+
+
+def test_categorical():
+    logits = mx.np.array([[0.1, 0.7, 0.2], [2.0, 1.0, 0.0]])
+    d = mgp.Categorical(3, logits=logits)
+    lp = d.log_prob(mx.np.array([1.0, 0.0]))
+    raw = onp.array([[0.1, 0.7, 0.2], [2.0, 1.0, 0.0]])
+    probs = onp.exp(raw) / onp.exp(raw).sum(-1, keepdims=True)
+    expect = onp.log(probs)
+    assert onp.allclose(lp.asnumpy(), [expect[0][1], expect[1][0]], atol=1e-5)
+    # numpy-style size: the FULL output shape (trailing dims broadcast with
+    # the batch), like mx.np.random.normal(loc=[...], size=(100, 2))
+    s = d.sample((100, 2))
+    assert s.shape == (100, 2)
+    assert float(s.max().asnumpy()) <= 2
+    # sample_n prepends to the batch shape
+    s2 = d.sample_n(50)
+    assert s2.shape == (50, 2)
+
+
+def test_sampling_moments():
+    mx.random.seed(7)
+    for d, mean, std in [
+        (mgp.Normal(2.0, 3.0), 2.0, 3.0),
+        (mgp.Exponential(2.0), 2.0, 2.0),
+        (mgp.Gamma(4.0, 0.5), 2.0, 1.0),
+        (mgp.Uniform(0.0, 6.0), 3.0, 6.0 / onp.sqrt(12)),
+    ]:
+        s = d.sample((20000,)).asnumpy()
+        assert abs(s.mean() - mean) < 0.1 * max(1, abs(mean)), type(d)
+        assert abs(s.std() - std) < 0.1 * std, type(d)
+
+
+def test_rsample_pathwise_gradient():
+    """Reparameterized sampling must carry dL/dparam (VAE training path)."""
+    mu = mx.np.array(1.0)
+    mu.attach_grad()
+    mx.random.seed(0)
+    with mx.autograd.record():
+        d = mgp.Normal(mu, 1.0)
+        s = d.rsample((256,))
+        loss = s.mean()
+    loss.backward()
+    assert abs(float(mu.grad.asnumpy()) - 1.0) < 1e-5  # d mean(mu+eps)/d mu = 1
+
+
+def test_kl_registry():
+    p = mgp.Normal(0.0, 1.0)
+    q = mgp.Normal(1.0, 2.0)
+    kl = mgp.kl_divergence(p, q).asnumpy()
+    expect = onp.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    assert onp.allclose(kl, expect, atol=1e-6)
+    # monte-carlo agreement for gamma
+    mx.random.seed(3)
+    pg, qg = mgp.Gamma(3.0, 1.0), mgp.Gamma(2.0, 2.0)
+    kl_g = float(mgp.kl_divergence(pg, qg).asnumpy())
+    s = pg.sample((40000,))
+    mc = float((pg.log_prob(s) - qg.log_prob(s)).mean().asnumpy())
+    assert abs(kl_g - mc) < 0.05 * max(1.0, abs(kl_g))
+    with pytest.raises(NotImplementedError):
+        mgp.kl_divergence(p, mgp.Poisson(1.0))
+
+
+def test_transformed_distribution_lognormal():
+    base = mgp.Normal(0.3, 0.8)
+    td = mgp.TransformedDistribution(base, mgp.ExpTransformation())
+    ln = mgp.LogNormal(0.3, 0.8)
+    v = onp.array([0.5, 1.0, 2.5], "float32")
+    assert onp.allclose(_lp(td, v), _lp(ln, v), atol=1e-5)
+    assert onp.allclose(_lp(ln, v), ss.lognorm.logpdf(v, 0.8, scale=onp.exp(0.3)),
+                        atol=1e-5)
+
+
+def test_mvn_log_prob():
+    cov = onp.array([[2.0, 0.5], [0.5, 1.0]], "float32")
+    loc = onp.array([1.0, -1.0], "float32")
+    d = mgp.MultivariateNormal(mx.np.array(loc), cov=mx.np.array(cov))
+    v = onp.array([[0.0, 0.0], [1.0, -1.0]], "float32")
+    expect = ss.multivariate_normal.logpdf(v, loc, cov)
+    assert onp.allclose(_lp(d, v), expect, atol=1e-5)
+
+
+def test_independent_and_mixture():
+    base = mgp.Normal(mx.np.zeros((4, 3)), mx.np.ones((4, 3)))
+    ind = mgp.Independent(base, 1)
+    v = onp.random.randn(4, 3).astype("float32")
+    assert onp.allclose(_lp(ind, v), ss.norm.logpdf(v).sum(-1), atol=1e-5)
+
+    mix = mgp.MixtureSameFamily(
+        mgp.Categorical(2, logits=mx.np.array([0.0, 0.0])),
+        mgp.Normal(mx.np.array([-2.0, 2.0]), mx.np.array([1.0, 1.0])))
+    val = onp.array([0.0], "float32")
+    expect = onp.log(0.5 * ss.norm.pdf(0, -2, 1) + 0.5 * ss.norm.pdf(0, 2, 1))
+    assert onp.allclose(_lp(mix, val), expect, atol=1e-5)
+
+
+def test_mixture_sample_with_size():
+    mix = mgp.MixtureSameFamily(
+        mgp.Categorical(2, logits=mx.np.array([0.0, 0.0])),
+        mgp.Normal(mx.np.array([-2.0, 2.0]), mx.np.array([0.1, 0.1])))
+    s = mix.sample((500,))
+    assert s.shape == (500,)
+    # every draw lands near one of the two well-separated component means
+    arr = onp.asarray(s.asnumpy())
+    assert onp.all(onp.minimum(onp.abs(arr + 2), onp.abs(arr - 2)) < 1.0)
+    assert (arr < 0).any() and (arr > 0).any()
+
+
+def test_onehot_enumerate_support():
+    d = mgp.OneHotCategorical(3, logits=mx.np.array([0.1, 0.2, 0.7]))
+    sup = d.enumerate_support()
+    assert sup.shape == (3, 3)
+    assert onp.allclose(onp.asarray(sup.asnumpy()), onp.eye(3))
+    lp = d.log_prob(sup)
+    assert onp.allclose(onp.exp(onp.asarray(lp.asnumpy())).sum(), 1.0,
+                        atol=1e-5)
+
+
+def test_multinomial_batched_sample():
+    probs = mx.np.array([[0.2, 0.3, 0.5], [0.1, 0.1, 0.8]])
+    d = mgp.Multinomial(3, prob=probs, total_count=7)
+    s = d.sample()
+    assert s.shape == (2, 3)
+    arr = onp.asarray(s.asnumpy())
+    assert onp.all(arr.sum(-1) == 7)
+    s2 = d.sample((5, 2))
+    assert s2.shape == (5, 2, 3)
+    assert onp.all(onp.asarray(s2.asnumpy()).sum(-1) == 7)
+
+
+def test_stochastic_block_collects_losses():
+    from mxnet_tpu.gluon import nn
+
+    class VAEIsh(mgp.StochasticBlock):
+        def __init__(self):
+            super().__init__()
+            self.enc = nn.Dense(4, flatten=False)
+
+        def forward(self, x):
+            h = self.enc(x)
+            q = mgp.Normal(h, 1.0)
+            self.add_loss(mgp.kl_divergence(q, mgp.Normal(0.0, 1.0)))
+            return q.rsample()
+
+    net = VAEIsh()
+    net.initialize()
+    out = net(mx.np.array(onp.random.randn(2, 3), dtype="float32"))
+    assert out.shape == (2, 4)
+    assert len(net.losses) == 1
+    assert net.losses[0].shape == (2, 4)

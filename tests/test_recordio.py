@@ -1,0 +1,174 @@
+"""RecordIO + native core tests (reference: `tests/python/unittest/test_recordio.py`)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu._native import lib as native_lib
+
+
+def _write(tmp_path, n=100, indexed=True):
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    payloads = [os.urandom(int(onp.random.randint(1, 2000))) for _ in range(n)]
+    if indexed:
+        w = recordio.MXIndexedRecordIO(idx, rec, "w")
+        for i, p in enumerate(payloads):
+            w.write_idx(i, p)
+    else:
+        w = recordio.MXRecordIO(rec, "w")
+        for p in payloads:
+            w.write(p)
+    w.close()
+    return rec, idx, payloads
+
+
+def test_native_lib_builds():
+    """The C++ core must compile in this image (g++ is baked in)."""
+    assert native_lib() is not None
+
+
+def test_sequential_roundtrip(tmp_path):
+    rec, _idx, payloads = _write(tmp_path, indexed=False)
+    r = recordio.MXRecordIO(rec, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.reset()
+    assert r.read() == payloads[0]
+    r.close()
+
+
+def test_indexed_roundtrip(tmp_path):
+    rec, idx, payloads = _write(tmp_path)
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert len(r.keys) == len(payloads)
+    for i in [0, 99, 50, 7]:
+        assert r.read_idx(i) == payloads[i]
+    r.close()
+
+
+def test_native_reader_matches_python(tmp_path):
+    rec, _idx, payloads = _write(tmp_path, indexed=False)
+    from mxnet_tpu._native import NativeRecordReader
+    nr = NativeRecordReader(rec)
+    assert len(nr) == len(payloads)
+    for i in [0, 5, 99]:
+        assert nr.read(i) == payloads[i]
+    nr.close()
+
+
+def test_seek_then_read(tmp_path):
+    """seek()+read() must honor the seek in both native and python modes."""
+    rec, idx, payloads = _write(tmp_path)
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    r.seek(50)
+    assert r.read() == payloads[50]
+    assert r.read() == payloads[51]  # sequential cursor advanced past 50
+    r.close()
+
+
+def test_reader_tell_builds_index(tmp_path):
+    """The pos=tell(); read() idiom for building an .idx file."""
+    rec, idx, payloads = _write(tmp_path, n=20)
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    positions = []
+    while True:
+        pos = r.tell()
+        if r.read() is None:
+            break
+        positions.append(pos)
+    assert positions == [r.idx[k] for k in r.keys]
+    r.close()
+
+
+def test_read_at_rejects_hostile_offset(tmp_path):
+    """Bounds checks must not wrap on offsets near 2^64 (OOB mmap read)."""
+    rec, _idx, _payloads = _write(tmp_path, n=3, indexed=False)
+    from mxnet_tpu._native import NativeRecordReader
+    nr = NativeRecordReader(rec)
+    for off in [2 ** 64 - 8, 2 ** 64 - 1, 10 ** 15]:
+        with pytest.raises(IOError):
+            nr.read_at(off)
+    nr.close()
+
+
+def test_native_rejects_corrupt_file(tmp_path):
+    bad = tmp_path / "bad.rec"
+    bad.write_bytes(b"\x00" * 64)
+    from mxnet_tpu._native import NativeRecordReader
+    with pytest.raises(IOError, match="magic"):
+        NativeRecordReader(str(bad))
+
+
+def test_truncated_tail_is_tolerated(tmp_path):
+    """A producer killed mid-write leaves a truncated last record; all
+    preceding complete records must stay readable (dmlc semantics)."""
+    rec, _idx, payloads = _write(tmp_path, n=5, indexed=False)
+    with open(rec, "ab") as f:
+        # header claiming 100 bytes, only 4 present
+        f.write((0xCED7230A).to_bytes(4, "little"))
+        f.write((100).to_bytes(4, "little"))
+        f.write(b"\x01\x02\x03\x04")
+    r = recordio.MXRecordIO(rec, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+    from mxnet_tpu._native import NativeRecordReader
+    nr = NativeRecordReader(rec)
+    assert len(nr) == 5
+    nr.close()
+
+
+def test_read_idx_then_sequential_read(tmp_path):
+    """read_idx must advance the sequential cursor (read_idx = seek+read)."""
+    rec, idx, payloads = _write(tmp_path, n=10)
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(3) == payloads[3]
+    assert r.read() == payloads[4]
+    r.close()
+
+
+def test_oversized_record_rejected(tmp_path):
+    rec = str(tmp_path / "big.rec")
+    w = recordio.MXRecordIO(rec, "w")
+
+    class FakeBig(bytes):
+        def __len__(self):
+            return 1 << 29
+    with pytest.raises(ValueError, match="frame limit"):
+        w.write(FakeBig())
+    w.close()
+
+
+def test_pack_unpack_img(tmp_path):
+    img = onp.random.randint(0, 255, (16, 16, 3), dtype=onp.uint8)
+    buf = recordio.pack_img(recordio.IRHeader(0, 3.0, 7, 0), img)
+    header, decoded = recordio.unpack_img(buf)
+    assert header.label == 3.0 and header.id == 7
+    assert decoded.shape == (16, 16, 3)
+
+
+def test_image_record_dataset_pipeline(tmp_path):
+    rec = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(12):
+        img = onp.random.randint(0, 255, (8, 8, 3), dtype=onp.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img))
+    w.close()
+
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision import ImageRecordDataset
+    ds = ImageRecordDataset(rec)
+    assert len(ds) == 12
+    img, label = ds[4]
+    assert img.shape == (8, 8, 3) and label == 1.0
+    dl = DataLoader(ds, batch_size=4, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 8, 8, 3)
