@@ -40,6 +40,7 @@ from . import optimizer
 from . import lr_scheduler
 from . import kvstore as kv
 from . import kvstore
+from . import io
 from . import gluon
 from . import models
 from . import parallel

@@ -1,0 +1,6 @@
+"""Legacy data iterators (reference: `python/mxnet/io/`)."""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,
+                 ResizeIter, PrefetchingIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ResizeIter", "PrefetchingIter"]

@@ -2,11 +2,11 @@
 from .optimizer import Optimizer, Updater, get_updater, register, create, Test
 from .sgd import SGD, NAG, Signum, SGLD, LARS, DCASGD
 from .adam import Adam, AdamW, Adamax, Nadam, LAMB, LANS
-from .rmsprop import RMSProp, AdaGrad, AdaDelta, Ftrl
+from .rmsprop import RMSProp, AdaGrad, AdaDelta, Ftrl, FTML
 
 __all__ = [
     "Optimizer", "Updater", "get_updater", "register", "create", "Test",
     "SGD", "NAG", "Signum", "SGLD", "LARS", "DCASGD",
     "Adam", "AdamW", "Adamax", "Nadam", "LAMB", "LANS",
-    "RMSProp", "AdaGrad", "AdaDelta", "Ftrl",
+    "RMSProp", "AdaGrad", "AdaDelta", "Ftrl", "FTML",
 ]

@@ -118,3 +118,40 @@ class Ftrl(Optimizer):
             ((self.beta + jnp.sqrt(new_n)) / lr + wd),
             0.0)
         return new_w.astype(weight.dtype), (new_z, new_n)
+
+
+@register
+class FTML(Optimizer):
+    """Follow the Moving Leader (reference `ftml.py` / `ftml_update` in
+    `src/operator/optimizer_op.cc`)::
+
+        v = beta2*v + (1-beta2)*g^2
+        d = (1-beta1^t)/lr * (sqrt(v/(1-beta2^t)) + epsilon)
+        z = beta1*z + (1-beta1)*g - (d - beta1*d_prev)*weight
+        weight = -z/d
+    """
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros_like(weight, dtype="float32"),   # d_prev
+                zeros_like(weight, dtype="float32"),   # v
+                zeros_like(weight, dtype="float32"))   # z
+
+    def update_math(self, weight, grad, states, lr, wd, t):
+        grad = grad.astype(jnp.float32)
+        w32 = weight.astype(jnp.float32)
+        d_prev, v, z = states
+        g = grad + wd * w32
+        new_v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        d = (1 - self.beta1 ** t) / lr * \
+            (jnp.sqrt(new_v / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma = d - self.beta1 * d_prev
+        new_z = self.beta1 * z + (1 - self.beta1) * g - sigma * w32
+        new_w = -new_z / d
+        return new_w.astype(weight.dtype), (d, new_v, new_z)

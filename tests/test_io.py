@@ -1,0 +1,140 @@
+"""Legacy io module tests (reference: `tests/python/unittest/test_io.py`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import (NDArrayIter, CSVIter, ResizeIter, PrefetchingIter,
+                          DataDesc)
+
+
+def _collect(it):
+    it.reset()
+    return list(it)
+
+
+def test_ndarrayiter_exact_batches():
+    data = onp.arange(40, dtype="float32").reshape(20, 2)
+    label = onp.arange(20, dtype="float32")
+    it = NDArrayIter(data, label, batch_size=5)
+    batches = _collect(it)
+    assert len(batches) == 4
+    got = onp.concatenate([b.data[0].asnumpy() for b in batches])
+    assert onp.array_equal(got, data)
+    assert all(b.pad == 0 for b in batches)
+    got_l = onp.concatenate([b.label[0].asnumpy() for b in batches])
+    assert onp.array_equal(got_l, label)
+
+
+def test_ndarrayiter_pad():
+    data = onp.arange(26, dtype="float32").reshape(13, 2)
+    it = NDArrayIter(data, batch_size=5, last_batch_handle="pad")
+    batches = _collect(it)
+    assert len(batches) == 3
+    assert [b.pad for b in batches] == [0, 0, 2]
+    # padded region wraps to the head of the data
+    assert onp.array_equal(batches[2].data[0].asnumpy()[-2:], data[:2])
+    # second epoch identical
+    assert len(_collect(it)) == 3
+
+
+def test_ndarrayiter_discard():
+    data = onp.zeros((13, 2), "float32")
+    it = NDArrayIter(data, batch_size=5, last_batch_handle="discard")
+    batches = _collect(it)
+    assert len(batches) == 2
+    assert all(b.data[0].shape == (5, 2) for b in batches)
+
+
+def test_ndarrayiter_roll_over():
+    data = onp.arange(13, dtype="float32").reshape(13, 1)
+    it = NDArrayIter(data, batch_size=5, last_batch_handle="roll_over")
+    first = _collect(it)
+    assert len(first) == 2  # tail of 3 rolled to next epoch
+    second = _collect(it)
+    assert len(second) == 3  # 3 cached + 13 = 16 rows -> 3 full batches, tail 1
+    # first batch of epoch 2 starts with the cached tail rows 10,11,12
+    assert onp.array_equal(second[0].data[0].asnumpy()[:3],
+                           data[10:])
+    assert onp.array_equal(second[0].data[0].asnumpy()[3:], data[:2])
+    assert all(b.data[0].shape == (5, 1) for b in second)
+
+
+def test_ndarrayiter_shuffle_covers_all():
+    data = onp.arange(20, dtype="float32").reshape(20, 1)
+    it = NDArrayIter(data, batch_size=5, shuffle=True)
+    got = onp.concatenate([b.data[0].asnumpy() for b in _collect(it)])
+    assert sorted(got.ravel().tolist()) == list(range(20))
+
+
+def test_ndarrayiter_dict_input_and_provide():
+    it = NDArrayIter({"a": onp.zeros((8, 3)), "b": onp.ones((8, 2))},
+                     {"lbl": onp.zeros(8)}, batch_size=4)
+    names = sorted(d.name for d in it.provide_data)
+    assert names == ["a", "b"]
+    assert it.provide_label[0].name == "lbl"
+    assert it.provide_data[0].shape[0] == 4
+    batch = next(iter(it))
+    assert len(batch.data) == 2 and len(batch.label) == 1
+
+
+def test_csviter(tmp_path):
+    data = onp.random.rand(12, 4).astype("float32")
+    label = onp.arange(12, dtype="float32").reshape(12, 1)
+    dcsv = tmp_path / "d.csv"
+    lcsv = tmp_path / "l.csv"
+    onp.savetxt(dcsv, data, delimiter=",")
+    onp.savetxt(lcsv, label, delimiter=",")
+    it = CSVIter(str(dcsv), (4,), str(lcsv), (1,), batch_size=4)
+    batches = _collect(it)
+    assert len(batches) == 3
+    assert onp.allclose(
+        onp.concatenate([b.data[0].asnumpy() for b in batches]), data,
+        atol=1e-6)
+
+
+def test_resizeiter():
+    data = onp.zeros((10, 2), "float32")
+    base = NDArrayIter(data, batch_size=5)
+    it = ResizeIter(base, 7)
+    assert len(_collect(it)) == 7
+    assert len(_collect(it)) == 7
+
+
+def test_prefetchingiter():
+    data = onp.arange(20, dtype="float32").reshape(20, 1)
+    base = NDArrayIter(data, onp.arange(20, dtype="float32"), batch_size=5)
+    it = PrefetchingIter(base)
+    batches = _collect(it)
+    assert len(batches) == 4
+    got = onp.concatenate([b.data[0].asnumpy() for b in batches])
+    assert onp.array_equal(got, data)
+    # second epoch works after reset
+    assert len(_collect(it)) == 4
+
+
+def test_datadesc_layout():
+    d = DataDesc("x", (32, 3, 224, 224), layout="NCHW")
+    assert DataDesc.get_batch_axis(d.layout) == 0
+    assert DataDesc.get_batch_axis("TNC") == 1
+
+
+def test_dict_input_sorted_by_name():
+    """Reference `_init_data` sorts dict keys; scripts index batch.data
+    positionally and rely on it."""
+    it = NDArrayIter({"z": onp.zeros((4, 1)), "a": onp.ones((4, 2))},
+                     batch_size=2)
+    assert [d.name for d in it.provide_data] == ["a", "z"]
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 2)  # 'a' first
+
+
+def test_prefetchingiter_propagates_worker_error():
+    class Broken(NDArrayIter):
+        def next(self):
+            raise ValueError("corrupt row")
+
+    base = Broken(onp.zeros((10, 2), "float32"), batch_size=5)
+    it = PrefetchingIter(base)
+    with pytest.raises(ValueError, match="corrupt row"):
+        next(iter(it))
+    it.close()
