@@ -41,12 +41,16 @@ from . import lr_scheduler
 from . import kvstore as kv
 from . import kvstore
 from . import io
+from . import contrib
 from . import gluon
 from . import models
 from . import parallel
 from . import amp
 from . import profiler
 from .runtime import Features, feature_list
+from . import rtc
+from . import visualization
+from . import visualization as viz
 from . import test_utils
 
 __all__ = [

@@ -1,0 +1,11 @@
+"""Contrib namespace (reference: `python/mxnet/contrib/` and the
+`_contrib_*` op family in `src/operator/contrib/`)."""
+from ..ops.contrib import (box_iou, box_nms, bipartite_matching, roi_align,
+                           boolean_mask, allclose, index_copy, index_array)
+
+# reference CamelCase aliases (mx.nd.contrib.ROIAlign)
+ROIAlign = roi_align
+
+__all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
+           "ROIAlign", "boolean_mask", "allclose", "index_copy",
+           "index_array"]

@@ -1,0 +1,273 @@
+"""Contrib detection / indexing ops.
+
+Reference: `src/operator/contrib/` — `bounding_box.cc` (`box_iou`,
+`box_nms`, `bipartite_matching`), ROIAlign (`roi_align.cc`), `boolean_mask`
+(`boolean_mask.cc`), `allclose` (`allclose_op.cc`), `index_copy`
+(`index_copy.cc`), `index_array` (`index_array.cc`).
+
+TPU-native design: everything is static-shape so it jits onto the MXU/VPU.
+`box_nms` keeps its input shape and marks suppressed boxes with score -1
+(exactly the reference's in-place suppression contract), implemented as a
+`lax.scan` greedy pass over a precomputed pairwise-IoU matrix instead of the
+reference's CUDA bitonic sort + bitmask kernels.  `boolean_mask` is the one
+data-dependent-shape op: eager-only, documented as such (the reference's GPU
+kernel has the same dynamic output).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .invoke import invoke
+
+__all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
+           "boolean_mask", "allclose", "index_copy", "index_array"]
+
+
+def _corner(boxes, fmt):
+    """Convert to corner (x1,y1,x2,y2) layout."""
+    if fmt == "corner":
+        return boxes
+    # center: (cx, cy, w, h)
+    cx, cy, w, h = (boxes[..., i] for i in range(4))
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+
+def _pairwise_iou(a, b):
+    """IoU of every box in a (..., N, 4) with every box in b (..., M, 4)."""
+    a = a[..., :, None, :]
+    b = b[..., None, :, :]
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:], b[..., 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+def box_iou(lhs, rhs, format="corner"):  # noqa: A002
+    """Pairwise IoU (reference `_contrib_box_iou`, bounding_box.cc)."""
+    def f(l, r):
+        return _pairwise_iou(_corner(l, format), _corner(r, format))
+    return invoke(f, (lhs, rhs), name="box_iou")
+
+
+def _to_center(boxes):
+    x1, y1, x2, y2 = (boxes[..., i] for i in range(4))
+    return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], -1)
+
+
+def _nms_single(boxes6, overlap_thresh, valid_thresh, topk, coord_start,
+                score_index, id_index, background_id, force_suppress,
+                in_fmt, out_fmt):
+    """Greedy NMS over one (N, K) tensor.  Matches the reference output
+    contract (`bounding_box-inl.h`): survivors packed at the top in
+    descending score order, suppressed/invalid rows entirely -1."""
+    scores = boxes6[:, score_index]
+    coords = _corner(boxes6[:, coord_start:coord_start + 4], in_fmt)
+    n = boxes6.shape[0]
+
+    order = jnp.argsort(-scores)
+    sorted_rows = boxes6[order]
+    sorted_scores = scores[order]
+    sorted_coords = coords[order]
+
+    iou = _pairwise_iou(sorted_coords, sorted_coords)
+    if id_index >= 0 and not force_suppress:
+        ids = sorted_rows[:, id_index]
+        same_class = ids[:, None] == ids[None, :]
+        iou = jnp.where(same_class, iou, 0.0)
+
+    valid = sorted_scores > valid_thresh  # strict, as the reference
+    if id_index >= 0 and background_id >= 0:
+        valid = valid & (sorted_rows[:, id_index] != background_id)
+    if topk > 0:
+        valid = valid & (jnp.arange(n) < topk)
+
+    def step(keep, i):
+        # suppress i if any kept higher-scored box overlaps it too much
+        overlapped = (jnp.arange(n) < i) & keep & (iou[:, i] > overlap_thresh)
+        keep_i = valid[i] & ~jnp.any(overlapped)
+        keep = keep.at[i].set(keep_i)
+        return keep, keep_i
+
+    keep, _ = lax.scan(step, jnp.zeros(n, bool), jnp.arange(n))
+
+    if out_fmt != in_fmt:
+        cs = coord_start
+        converted = sorted_coords if out_fmt == "corner" else \
+            _to_center(sorted_coords)
+        sorted_rows = sorted_rows.at[:, cs:cs + 4].set(converted)
+
+    # reference contract: survivors compacted to the top (score order is
+    # already descending and argsort is stable), suppressed rows all -1
+    out = jnp.where(keep[:, None], sorted_rows, -1.0)
+    perm = jnp.argsort(~keep, stable=True)
+    return out[perm]
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Non-maximum suppression (reference `_contrib_box_nms`,
+    bounding_box.cc).  Shape-preserving: survivors are packed at the top in
+    descending score order and suppressed/invalid rows are filled with -1,
+    exactly as the reference kernel emits.  Batch dims are vmapped."""
+    def f(d):
+        fn = lambda x: _nms_single(x, overlap_thresh, valid_thresh, topk,
+                                   coord_start, score_index, id_index,
+                                   background_id, force_suppress,
+                                   in_format, out_format)
+        if d.ndim == 2:
+            return fn(d)
+        batch_shape = d.shape[:-2]
+        flat = d.reshape((-1,) + d.shape[-2:])
+        return jax.vmap(fn)(flat).reshape(batch_shape + d.shape[-2:])
+    return invoke(f, (data,), name="box_nms")
+
+
+def bipartite_matching(data, threshold, is_ascend=False, topk=-1):
+    """Greedy bipartite matching (reference `_contrib_bipartite_matching`):
+    repeatedly match the best-scoring (row, col) pair, removing both.
+    Returns (row_assignments (N,), col_assignments (M,)) with -1 unmatched."""
+    def f(scores):
+        n, m = scores.shape
+        k = min(n, m) if topk <= 0 else min(topk, n, m)
+        sign = 1.0 if is_ascend else -1.0
+        big = jnp.inf
+
+        def step(carry, _):
+            s, row_as, col_as = carry
+            flat = jnp.argmin(sign * s)
+            i, j = flat // m, flat % m
+            ok = (s[i, j] > threshold) if not is_ascend else \
+                (s[i, j] < threshold)
+            row_as = jnp.where(ok, row_as.at[i].set(j), row_as)
+            col_as = jnp.where(ok, col_as.at[j].set(i), col_as)
+            # retire row i / col j: sign*big is the worst value for the
+            # argmin over sign*s, so they are never picked again
+            s = s.at[i, :].set(sign * big).at[:, j].set(sign * big)
+            return (s, row_as, col_as), None
+
+        init = (scores.astype(jnp.float32),
+                jnp.full((n,), -1, jnp.int32),
+                jnp.full((m,), -1, jnp.int32))
+        (s, row_as, col_as), _ = lax.scan(step, init, None, length=k)
+        return row_as, col_as
+    return invoke(f, (data,), name="bipartite_matching",
+                  differentiable=False)
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
+              position_sensitive=False, aligned=False):
+    """ROI Align (reference `_contrib_ROIAlign`, roi_align.cc): bilinear
+    sampling on a regular grid inside each region, averaged per output cell.
+
+    data: (B, C, H, W); rois: (R, 5) of [batch_idx, x1, y1, x2, y2].
+
+    Deviation from the reference: with ``sample_ratio<=0`` the reference
+    adapts the grid per ROI (``ceil(roi_size/pooled_size)`` samples per
+    bin, roi_align.cc:199); a data-dependent grid cannot be a static XLA
+    shape, so a fixed 2x2 grid is used instead.  Pass an explicit
+    ``sample_ratio`` to control sampling density.
+    """
+    assert not position_sensitive, "position_sensitive not supported"
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    sr = sample_ratio if sample_ratio > 0 else 2
+
+    def f(x, r):
+        b, c, h, w = x.shape
+        offset = 0.5 if aligned else 0.0
+        batch_idx = r[:, 0].astype(jnp.int32)
+        x1 = r[:, 1] * spatial_scale - offset
+        y1 = r[:, 2] * spatial_scale - offset
+        x2 = r[:, 3] * spatial_scale - offset
+        y2 = r[:, 4] * spatial_scale - offset
+        roi_w = x2 - x1
+        roi_h = y2 - y1
+        if not aligned:  # legacy: force minimum size 1
+            roi_w = jnp.maximum(roi_w, 1.0)
+            roi_h = jnp.maximum(roi_h, 1.0)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+
+        # sample grid: (R, ph, sr) y-coords and (R, pw, sr) x-coords
+        sub = (jnp.arange(sr) + 0.5) / sr  # sub-cell sample offsets
+        ys = y1[:, None, None] + \
+            (jnp.arange(ph)[None, :, None] + sub[None, None, :]) * \
+            bin_h[:, None, None]
+        xs = x1[:, None, None] + \
+            (jnp.arange(pw)[None, :, None] + sub[None, None, :]) * \
+            bin_w[:, None, None]
+
+        def bilinear(img, yy, xx):
+            # img: (C, H, W); yy: (ph*sr,); xx: (pw*sr,) -> (C, ph*sr, pw*sr)
+            yy = jnp.clip(yy, 0.0, h - 1.0)
+            xx = jnp.clip(xx, 0.0, w - 1.0)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y1i = jnp.minimum(y0 + 1, h - 1)
+            x1i = jnp.minimum(x0 + 1, w - 1)
+            wy = yy - y0
+            wx = xx - x0
+            v00 = img[:, y0, :][:, :, x0]
+            v01 = img[:, y0, :][:, :, x1i]
+            v10 = img[:, y1i, :][:, :, x0]
+            v11 = img[:, y1i, :][:, :, x1i]
+            top = v00 * (1 - wx)[None, None, :] + v01 * wx[None, None, :]
+            bot = v10 * (1 - wx)[None, None, :] + v11 * wx[None, None, :]
+            return top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+
+        def one_roi(bi, ys_r, xs_r):
+            img = x[bi]                               # (C, H, W)
+            vals = bilinear(img, ys_r.reshape(-1), xs_r.reshape(-1))
+            vals = vals.reshape(c, ph, sr, pw, sr)
+            return vals.mean(axis=(2, 4))             # (C, ph, pw)
+
+        return jax.vmap(one_roi)(batch_idx, ys, xs)
+    return invoke(f, (data, rois), name="roi_align")
+
+
+def boolean_mask(data, index, axis=0):
+    """Select rows where index!=0 (reference `_contrib_boolean_mask`).
+    Output shape is data-dependent — eager-only, like the reference."""
+    def f(d, m):
+        keep = jnp.asarray(m) != 0
+        idx = jnp.nonzero(keep)[0]  # host-sync: data-dependent shape
+        return jnp.take(d, idx, axis=axis)
+    return invoke(f, (data, index), name="boolean_mask")
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    """Reference `_contrib_allclose` (allclose_op.cc): scalar 0/1 tensor."""
+    def f(x, y):
+        return jnp.allclose(x, y, rtol=rtol, atol=atol,
+                            equal_nan=equal_nan).astype(jnp.float32)
+    return invoke(f, (a, b), name="allclose", differentiable=False)
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    """Copy rows of new_tensor into old_tensor at index_vector (reference
+    `_contrib_index_copy`, index_copy.cc) — functional on TPU: returns the
+    updated tensor."""
+    def f(old, idx, new):
+        return old.at[idx.astype(jnp.int32)].set(new)
+    return invoke(f, (old_tensor, index_vector, new_tensor),
+                  name="index_copy")
+
+
+def index_array(data, axes=None):
+    """Per-element N-d indices (reference `_contrib_index_array`)."""
+    def f(d):
+        idx = jnp.stack(jnp.meshgrid(
+            *[jnp.arange(s) for s in d.shape], indexing="ij"), axis=-1)
+        if axes is not None:
+            idx = idx[..., list(axes)]
+        # reference emits int64; int32 is the TPU-native index type
+        return idx.astype(jnp.int32)
+    return invoke(f, (data,), name="index_array", differentiable=False)

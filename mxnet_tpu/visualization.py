@@ -1,0 +1,108 @@
+"""Network visualization.
+
+Reference: `python/mxnet/visualization.py` — `print_summary` (layer table
+with shapes/params) and `plot_network` (graphviz digraph) over Symbols.
+Here both work on Gluon blocks: the block is traced to a jaxpr (the
+TPU-native graph IR, standing in for the nnvm symbol graph) and rendered
+as a table or DOT text.  `plot_network` returns a DOT string so no
+graphviz runtime is required; pipe it to `dot -Tpng` to draw.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _collect_rows(block, prefix=""):
+    rows = []
+    params = 0
+    for p in block._reg_params.values():
+        if p._shape_known():
+            params += int(onp.prod(p.shape))
+    shapes = sorted(
+        (name, tuple(p.shape)) for name, p in block._reg_params.items()
+        if p._shape_known())
+    rows.append((prefix + type(block).__name__, shapes, params))
+    for name, child in block._children.items():
+        rows.extend(_collect_rows(child, prefix + name + "/"))
+    return rows
+
+
+def print_summary(block, line_length=90):
+    """Print a layer table (reference `visualization.py` print_summary).
+
+    Works on any (initialized) Block; shapes come from parameters rather
+    than symbol shape inference.
+    """
+    rows = _collect_rows(block)
+    header = f"{'Layer':<45}{'Param shapes':<30}{'#Params':>12}"
+    sep = "=" * line_length
+    print(sep)
+    print(header)
+    print(sep)
+    total = 0
+    for name, shapes, params in rows:
+        shape_str = ", ".join(f"{n}{list(s)}" for n, s in shapes) or "-"
+        if len(shape_str) > 28:
+            shape_str = shape_str[:25] + "..."
+        print(f"{name:<45}{shape_str:<30}{params:>12}")
+        total += params
+    print(sep)
+    print(f"Total params: {total}")
+    print(sep)
+    return total
+
+
+def _jaxpr_of(block, *inputs):
+    import jax
+
+    from .ndarray.ndarray import NDArray
+
+    datas = [x._data if isinstance(x, NDArray) else x for x in inputs]
+
+    def fn(*xs):
+        wrapped = [NDArray(x) for x in xs]
+        out = block(*wrapped)
+        return out._data if isinstance(out, NDArray) else out
+
+    return jax.make_jaxpr(fn)(*datas)
+
+
+def plot_network(block, *inputs, title="plot", hide_weights=True):
+    """Render the traced compute graph as DOT text (reference
+    `plot_network` returns a graphviz Digraph; here a DOT string).
+
+    `inputs` are example NDArrays used to trace the block.
+    """
+    jaxpr = _jaxpr_of(block, *inputs).jaxpr
+    lines = [f'digraph "{title}" {{', "  rankdir=BT;",
+             '  node [shape=box, style=filled, fillcolor="#8dd3c7"];']
+    names = {}
+
+    def name_of(var):
+        key = str(var)
+        if key not in names:
+            names[key] = f"v{len(names)}"
+        return names[key]
+
+    for v in jaxpr.invars:
+        n = name_of(v)
+        lines.append(
+            f'  {n} [label="input\\n{getattr(v.aval, "shape", "")}", '
+            'fillcolor="#fb8072"];')
+    for i, eqn in enumerate(jaxpr.eqns):
+        op_node = f"op{i}"
+        out_shape = getattr(eqn.outvars[0].aval, "shape", "")
+        lines.append(f'  {op_node} [label="{eqn.primitive.name}\\n'
+                     f'{out_shape}"];')
+        for v in eqn.invars:
+            if hasattr(v, "aval"):  # skip literals
+                if hide_weights and str(v) not in names:
+                    # unseen var: a captured constant/weight; skip the node
+                    continue
+                lines.append(f"  {name_of(v)} -> {op_node};")
+        for v in eqn.outvars:
+            names[str(v)] = op_node
+    lines.append("}")
+    return "\n".join(lines)

@@ -1,0 +1,166 @@
+"""Contrib op tests vs brute-force numpy oracles.
+
+Reference strategy: `tests/python/unittest/test_contrib_operator.py`
+(box_nms/box_iou against python reference implementations).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import contrib
+
+
+def _np_iou(a, b):
+    tl = onp.maximum(a[:2], b[:2])
+    br = onp.minimum(a[2:], b[2:])
+    wh = onp.maximum(br - tl, 0)
+    inter = wh[0] * wh[1]
+    area = lambda x: max(x[2] - x[0], 0) * max(x[3] - x[1], 0)
+    return inter / max(area(a) + area(b) - inter, 1e-12)
+
+
+def test_box_iou_matches_bruteforce():
+    onp.random.seed(3)
+    a = onp.sort(onp.random.rand(5, 2, 2), axis=-2).reshape(5, 4)
+    b = onp.sort(onp.random.rand(7, 2, 2), axis=-2).reshape(7, 4)
+    got = contrib.box_iou(mx.np.array(a), mx.np.array(b)).asnumpy()
+    for i in range(5):
+        for j in range(7):
+            assert got[i, j] == pytest.approx(_np_iou(a[i], b[j]), abs=1e-5)
+
+
+def _np_greedy_nms(boxes, thresh, valid_thresh):
+    """Oracle matching the reference contract: survivors packed at the top
+    in descending score order, suppressed rows entirely -1."""
+    order = onp.argsort(-boxes[:, 1])
+    rows = boxes[order]
+    kept = []
+    for i in range(len(rows)):
+        if rows[i, 1] <= valid_thresh:
+            continue
+        if any(_np_iou(rows[i, 2:6], rows[k, 2:6]) > thresh for k in kept):
+            continue
+        kept.append(i)
+    out = onp.full_like(boxes, -1.0)
+    out[:len(kept)] = rows[kept]
+    return out
+
+
+def test_box_nms_matches_bruteforce():
+    onp.random.seed(7)
+    n = 20
+    coords = onp.sort(onp.random.rand(n, 2, 2) * 10, axis=-2).reshape(n, 4)
+    scores = onp.random.rand(n, 1)
+    ids = onp.zeros((n, 1))
+    data = onp.concatenate([ids, scores, coords], axis=1).astype("float32")
+    expect = _np_greedy_nms(data, 0.5, 0.1)
+    got = contrib.box_nms(mx.np.array(data), overlap_thresh=0.5,
+                          valid_thresh=0.1, coord_start=2, score_index=1,
+                          id_index=0).asnumpy()
+    assert onp.allclose(got, expect, atol=1e-5)
+
+
+def test_box_nms_background_and_format():
+    # background boxes are removed; out_format converts the coordinates
+    data = onp.array([[0, 0.9, 2, 2, 4, 6],
+                      [1, 0.8, 10, 10, 12, 12]], dtype="float32")
+    got = contrib.box_nms(mx.np.array(data), id_index=0, background_id=0,
+                          out_format="center").asnumpy()
+    assert (got[:, 1] >= 0).sum() == 1
+    # survivor is the class-1 box, converted to (cx, cy, w, h)
+    assert got[0, 2:].tolist() == [11, 11, 2, 2]
+    assert onp.all(got[1] == -1)
+
+
+def test_box_nms_per_class():
+    # two perfectly overlapping boxes of different classes both survive
+    # without force_suppress, one dies with it
+    data = onp.array([[0, 0.9, 0, 0, 1, 1],
+                      [1, 0.8, 0, 0, 1, 1]], dtype="float32")
+    got = contrib.box_nms(mx.np.array(data), overlap_thresh=0.5,
+                          id_index=0).asnumpy()
+    assert (got[:, 1] >= 0).sum() == 2
+    got2 = contrib.box_nms(mx.np.array(data), overlap_thresh=0.5,
+                           id_index=0, force_suppress=True).asnumpy()
+    assert (got2[:, 1] >= 0).sum() == 1
+
+
+def test_box_nms_batched():
+    data = onp.random.rand(3, 8, 6).astype("float32")
+    data[..., 2:] = onp.sort(
+        onp.random.rand(3, 8, 2, 2) * 5, axis=-2).reshape(3, 8, 4)
+    got = contrib.box_nms(mx.np.array(data)).asnumpy()
+    assert got.shape == (3, 8, 6)
+
+
+def test_bipartite_matching():
+    score = onp.array([[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]], "float32")
+    rows, cols = contrib.bipartite_matching(mx.np.array(score), threshold=0.2)
+    rows, cols = rows.asnumpy(), cols.asnumpy()
+    # greedy: best is (0,1)=0.6, then (2,0)=0.3; row 1 unmatched
+    assert rows.tolist() == [1, -1, 0]
+    assert cols.tolist() == [2, 0]
+
+
+def test_roi_align_identity():
+    """A ROI covering one exact cell of a linear image reproduces bilinear
+    interpolation values."""
+    h = w = 8
+    img = onp.arange(h * w, dtype="float32").reshape(1, 1, h, w)
+    # whole-image ROI, pooled to the same resolution with aligned=True
+    rois = onp.array([[0, 0, 0, w - 1, h - 1]], dtype="float32")
+    out = contrib.roi_align(mx.np.array(img), mx.np.array(rois),
+                            pooled_size=(h, w), spatial_scale=1.0,
+                            sample_ratio=2, aligned=False).asnumpy()
+    assert out.shape == (1, 1, h, w)
+    # monotone along both axes like the source
+    assert onp.all(onp.diff(out[0, 0], axis=0) > 0)
+    assert onp.all(onp.diff(out[0, 0], axis=1) > 0)
+    # average of the whole map is preserved for an exact cover
+    assert out.mean() == pytest.approx(img.mean(), rel=0.05)
+
+
+def test_roi_align_batch_index():
+    imgs = onp.stack([onp.zeros((1, 4, 4)), onp.ones((1, 4, 4))]) \
+        .astype("float32")
+    rois = onp.array([[1, 0, 0, 3, 3], [0, 0, 0, 3, 3]], dtype="float32")
+    out = contrib.roi_align(mx.np.array(imgs), mx.np.array(rois),
+                            pooled_size=2).asnumpy()
+    assert onp.allclose(out[0], 1.0)
+    assert onp.allclose(out[1], 0.0)
+
+
+def test_boolean_mask():
+    data = onp.arange(12, dtype="float32").reshape(4, 3)
+    idx = onp.array([1, 0, 1, 0], "float32")
+    out = contrib.boolean_mask(mx.np.array(data), mx.np.array(idx)).asnumpy()
+    assert onp.array_equal(out, data[[0, 2]])
+
+
+def test_allclose_and_index_ops():
+    a = mx.np.ones((3, 3))
+    assert float(contrib.allclose(a, a).asnumpy()) == 1.0
+    assert float(contrib.allclose(a, a * 2).asnumpy()) == 0.0
+
+    old = mx.np.zeros((4, 2))
+    new = mx.np.ones((2, 2))
+    out = contrib.index_copy(old, mx.np.array([1, 3]), new).asnumpy()
+    assert onp.array_equal(out.sum(axis=1), [0, 2, 0, 2])
+
+    idx = contrib.index_array(mx.np.zeros((2, 3))).asnumpy()
+    assert idx.shape == (2, 3, 2)
+    assert idx[1, 2].tolist() == [1, 2]
+
+
+def test_roi_align_gradient_flows():
+    from mxnet_tpu import autograd
+    img = mx.np.array(onp.random.rand(1, 2, 6, 6).astype("float32"))
+    rois = mx.np.array([[0, 1, 1, 4, 4]], dtype="float32")
+    img.attach_grad()
+    with autograd.record():
+        out = contrib.roi_align(img, rois, pooled_size=3)
+        loss = out.sum()
+    loss.backward()
+    g = img.grad.asnumpy()
+    assert g.shape == img.shape
+    assert g.sum() > 0  # gradient lands on sampled pixels

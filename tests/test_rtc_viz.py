@@ -1,0 +1,63 @@
+"""Tests for mx.rtc (Pallas user kernels) and mx.visualization."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+
+
+def test_pallas_module_axpy():
+    def axpy_kernel(x_ref, y_ref, o_ref):
+        o_ref[...] = 2.0 * x_ref[...] + y_ref[...]
+
+    mod = mx.rtc.PallasModule(axpy_kernel)
+    k = mod.get_kernel("axpy_kernel", out_like=0)
+    x = mx.np.array(onp.arange(8, dtype="float32"))
+    y = mx.np.ones(8)
+    z = k.launch((x, y))
+    assert onp.allclose(z.asnumpy(), 2 * x.asnumpy() + 1)
+
+
+def test_pallas_kernel_out_shape():
+    def sum_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...].sum(keepdims=True).reshape(1, 1)
+
+    mod = mx.rtc.PallasModule(sum_kernel)
+    k = mod.get_kernel("sum_kernel", out_shape=(1, 1))
+    x = mx.np.ones((4, 4))
+    assert float(k.launch((x,)).asnumpy()) == 16.0
+
+
+def test_pallas_unknown_kernel():
+    mod = mx.rtc.PallasModule()
+    try:
+        mod.get_kernel("nope")
+        assert False
+    except ValueError as e:
+        assert "unknown kernel" in str(e)
+
+
+def _net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    return net
+
+
+def test_print_summary(capsys):
+    net = _net()
+    net(mx.np.ones((2, 8)))  # materialize deferred shapes
+    total = mx.visualization.print_summary(net)
+    out = capsys.readouterr().out
+    assert "Total params" in out
+    assert total == (8 * 16 + 16) + (16 * 4 + 4)
+
+
+def test_plot_network_dot():
+    net = _net()
+    x = mx.np.ones((2, 8))
+    net(x)
+    dot = mx.viz.plot_network(net, x)
+    assert dot.startswith("digraph")
+    assert "dot_general" in dot or "matmul" in dot  # the MXU ops are there
+    assert dot.rstrip().endswith("}")
