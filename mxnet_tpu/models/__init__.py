@@ -7,6 +7,7 @@ holds the transformer/BERT family, written mesh-aware from the start:
 parameters carry partition rules so the same Block runs single-chip or
 dp/tp/sp-sharded over a `jax.sharding.Mesh` unchanged.
 """
+from .rnn_lm import RNNModel, rnn_lm_partition_rules
 from .transformer import (
     MultiHeadAttention,
     PositionwiseFFN,
@@ -20,6 +21,7 @@ from .transformer import (
 )
 
 __all__ = [
+    "RNNModel", "rnn_lm_partition_rules",
     "MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderLayer",
     "TransformerEncoder", "BertModel", "BertForPretraining",
     "bert_partition_rules", "bert_base", "bert_large",

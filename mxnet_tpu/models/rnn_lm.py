@@ -1,0 +1,86 @@
+"""LSTM language model — BASELINE config 5 (reference `example/rnn/word_lm`).
+
+The reference trains this with the fused cuDNN RNN op
+(`src/operator/rnn.cc:295`); here the recurrence is the `lax.scan` lowering
+inside `gluon.rnn.LSTM`, which XLA pipelines onto the MXU per step.  The
+model is the classic tied-embedding word LM: Embedding -> dropout ->
+stacked LSTM -> dropout -> (tied) Dense decoder over the vocabulary.
+"""
+from __future__ import annotations
+
+from ..gluon import nn, rnn
+from ..gluon.block import HybridBlock
+
+__all__ = ["RNNModel", "rnn_lm_partition_rules"]
+
+
+class RNNModel(HybridBlock):
+    """Word-level RNN language model (reference word_lm/model.py RNNModel).
+
+    Parameters mirror the reference script: mode in {'rnn_relu','rnn_tanh',
+    'lstm','gru'}, optional weight tying between the embedding and the
+    decoder (tie_weights requires num_hidden == num_embed).
+    """
+
+    def __init__(self, vocab_size, num_embed=200, num_hidden=200,
+                 num_layers=2, mode="lstm", dropout=0.5, tie_weights=False):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.num_hidden = num_hidden
+        self.tie_weights = tie_weights
+        self.drop = nn.Dropout(dropout)
+        self.encoder = nn.Embedding(vocab_size, num_embed)
+        if mode == "lstm":
+            self.rnn = rnn.LSTM(num_hidden, num_layers, dropout=dropout,
+                                input_size=num_embed)
+        elif mode == "gru":
+            self.rnn = rnn.GRU(num_hidden, num_layers, dropout=dropout,
+                               input_size=num_embed)
+        elif mode in ("rnn_relu", "rnn_tanh"):
+            self.rnn = rnn.RNN(num_hidden, num_layers,
+                               activation=mode.split("_")[1], dropout=dropout,
+                               input_size=num_embed)
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+        if tie_weights:
+            if num_hidden != num_embed:
+                raise ValueError("tie_weights requires num_hidden==num_embed")
+            self.decoder = None  # decode through the embedding matrix
+        else:
+            self.decoder = nn.Dense(vocab_size, flatten=False)
+
+    def begin_state(self, batch_size, ctx=None):
+        return self.rnn.begin_state(batch_size, ctx=ctx)
+
+    def forward(self, inputs, state=None):
+        """inputs: (T, N) int tokens -> (logits (T, N, V), new state)."""
+        emb = self.drop(self.encoder(inputs))
+        if state is None:
+            output = self.rnn(emb)
+            state = None
+        else:
+            output, state = self.rnn(emb, state)
+        output = self.drop(output)
+        if self.tie_weights:
+            # decode with the embedding matrix transposed (weight tying)
+            from .. import numpy as np
+            w = self.encoder.weight.data()
+            logits = np.matmul(output, w.T)
+        else:
+            logits = self.decoder(output)
+        return (logits, state) if state is not None else logits
+
+
+def rnn_lm_partition_rules(tp_axis="tp"):
+    """Sharding rules for tensor-parallel LM training (consumed by
+    `parallel.shard_parameters`): shard embedding and decoder over the
+    vocab axis, stacked LSTM gate matrices over the gate/hidden dim."""
+    from ..parallel.mesh import PartitionSpec
+
+    col = PartitionSpec(tp_axis, None)
+    return [
+        ("encoder.weight", col),
+        ("decoder.weight", col),
+        (r"rnn\..*i2h.*weight", col),
+        (r"rnn\..*h2h.*weight", col),
+    ]

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Pack an image dataset into RecordIO (.rec/.idx/.lst).
+
+Reference: `tools/im2rec.py` / `tools/im2rec.cc` — same three modes:
+
+  1. make a .lst file from an image directory (one class per subfolder):
+       python tools/im2rec.py --list prefix image_root
+  2. pack a .lst into .rec/.idx (images JPEG-encoded, optionally resized):
+       python tools/im2rec.py prefix image_root [--resize N] [--quality Q]
+
+The .rec format is byte-compatible with the reference (pack_img framing
+over dmlc recordio), written through the native C++ writer when built.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import recordio  # noqa: E402
+from mxnet_tpu import image as mximg  # noqa: E402
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def list_images(root):
+    """Yield (relpath, label) with one label per sorted subdirectory."""
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+    if classes:
+        for label, cls in enumerate(classes):
+            for dirpath, _dirs, files in sorted(os.walk(os.path.join(root, cls))):
+                for f in sorted(files):
+                    if os.path.splitext(f)[1].lower() in _EXTS:
+                        yield os.path.relpath(os.path.join(dirpath, f), root), label
+    else:
+        for i, f in enumerate(sorted(os.listdir(root))):
+            if os.path.splitext(f)[1].lower() in _EXTS:
+                yield f, 0
+
+
+def write_list(prefix, root, shuffle=True):
+    items = list(list_images(root))
+    if shuffle:
+        random.shuffle(items)
+    lst = prefix + ".lst"
+    with open(lst, "w") as f:
+        for i, (path, label) in enumerate(items):
+            f.write(f"{i}\t{float(label)}\t{path}\n")
+    print(f"wrote {len(items)} entries to {lst}")
+    return lst
+
+
+def read_list(lst):
+    with open(lst) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            label = [float(x) for x in parts[1:-1]]
+            path = parts[-1]
+            yield idx, label[0] if len(label) == 1 else label, path
+
+
+def pack(prefix, root, resize=0, quality=95, color=1, shuffle=True):
+    lst = prefix + ".lst"
+    if not os.path.exists(lst):
+        write_list(prefix, root, shuffle=shuffle)
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, label, path in read_list(lst):
+        img = mximg.imread(os.path.join(root, path), flag=color)
+        if resize:
+            img = mximg.resize_short(img, resize)
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack_img(header, img, quality=quality))
+        count += 1
+        if count % 1000 == 0:
+            print(f"packed {count} images")
+    rec.close()
+    print(f"wrote {count} records to {prefix}.rec")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="output prefix for .lst/.rec/.idx")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--list", action="store_true",
+                   help="only generate the .lst file")
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter side to this many pixels")
+    p.add_argument("--quality", type=int, default=95, help="JPEG quality")
+    p.add_argument("--no-shuffle", action="store_true")
+    p.add_argument("--color", type=int, default=1, choices=[0, 1],
+                   help="1: color, 0: grayscale")
+    args = p.parse_args(argv)
+    if args.list:
+        write_list(args.prefix, args.root, shuffle=not args.no_shuffle)
+    else:
+        pack(args.prefix, args.root, resize=args.resize,
+             quality=args.quality, color=args.color,
+             shuffle=not args.no_shuffle)
+
+
+if __name__ == "__main__":
+    main()
