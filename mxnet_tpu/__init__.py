@@ -12,6 +12,13 @@ __version__ = "0.1.0"
 
 import jax as _jax
 
+# Multi-host bootstrap: when tools/launch.py (or a pod scheduler) provides
+# coordination env vars, wire jax.distributed now — it must run before
+# anything touches the XLA backend.
+from . import _distributed
+
+_distributed.init_from_env()
+
 # MXNet float32 ops compute in true float32 (CUDA/MKL kernels); XLA's
 # "fastest" default would silently downcast matmul/conv inputs to bf16 on
 # TPU.  Half-precision speed is opt-in via bf16 arrays / amp, as in the

@@ -1,0 +1,53 @@
+"""Multi-host bootstrap shared by package import and `parallel.init_distributed`.
+
+Depends only on os/jax so it can run before anything touches the XLA
+backend (reference analogue: ps-lite's DMLC_* env bootstrap,
+`src/kvstore/kvstore_dist.h:44`).
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+_ENV_VARS = ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+             "JAX_PROCESS_ID")
+
+
+def read_env():
+    """Returns (coordinator_address, num_processes, process_id) from the
+    launcher environment, or None if the env is absent or malformed (a
+    malformed set warns rather than making the package unimportable)."""
+    present = [v for v in _ENV_VARS if v in os.environ]
+    if not present:
+        return None
+    if len(present) < len(_ENV_VARS):
+        warnings.warn(
+            f"incomplete multi-host environment: have {present}, need all "
+            f"of {_ENV_VARS}; skipping jax.distributed bootstrap")
+        return None
+    try:
+        return (os.environ["JAX_COORDINATOR_ADDRESS"],
+                int(os.environ["JAX_NUM_PROCESSES"]),
+                int(os.environ["JAX_PROCESS_ID"]))
+    except ValueError:
+        warnings.warn(
+            "non-integer JAX_NUM_PROCESSES/JAX_PROCESS_ID; skipping "
+            "jax.distributed bootstrap")
+        return None
+
+
+def init_from_env():
+    """Call jax.distributed.initialize from the launcher env if present.
+    Safe to call more than once; returns True if initialization ran."""
+    spec = read_env()
+    if spec is None:
+        return False
+    import jax
+
+    try:
+        jax.distributed.initialize(coordinator_address=spec[0],
+                                   num_processes=spec[1],
+                                   process_id=spec[2])
+    except RuntimeError:
+        return False  # backend already up (interactive import after use)
+    return True

@@ -42,6 +42,19 @@ def _is_nd(x):
     return isinstance(x, NDArray)
 
 
+def _first_ctx(items):
+    """Context of the first NDArray found in items (one level of
+    list/tuple nesting, covering RNN-style state lists)."""
+    for a in items:
+        if _is_nd(a):
+            return a.ctx
+        if isinstance(a, (list, tuple)):
+            for b in a:
+                if _is_nd(b):
+                    return b.ctx
+    return None
+
+
 class Block:
     """Base building block (reference `block.py:202`)."""
 
@@ -189,7 +202,15 @@ class Block:
     def __call__(self, *args, **kwargs):
         for hook in self._forward_pre_hooks:
             hook(self, args)
-        out = self.forward(*args, **kwargs)
+        # classic multi-device data parallelism: parameters resolve their
+        # per-context copy through current_context(), so scope it to the
+        # input's context (the reference dispatches kernels by data ctx)
+        in_ctx = _first_ctx(args) or _first_ctx(kwargs.values())
+        if in_ctx is not None and in_ctx != current_context():
+            with in_ctx:
+                out = self.forward(*args, **kwargs)
+        else:
+            out = self.forward(*args, **kwargs)
         for hook in self._forward_hooks:
             hook(self, args, out)
         return out

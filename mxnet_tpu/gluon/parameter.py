@@ -154,12 +154,15 @@ class Parameter:
 
     def _finish_init(self, init, ctx_list, default_init):
         self._deferred_init = None
-        data = {}
-        for c in ctx_list:
-            arr = NDArray(jnp.zeros(self._shape, self.dtype), ctx=c)
-            (init or self.init or default_init)(
-                initializer.InitDesc(self.name), arr)
-            data[c] = arr
+        # initialize ONCE and replicate: every device copy must start
+        # identical (the reference initializes through the kvstore broadcast,
+        # `gluon/trainer.py:164-174`)
+        first = NDArray(jnp.zeros(self._shape, self.dtype), ctx=ctx_list[0])
+        (init or self.init or default_init)(
+            initializer.InitDesc(self.name), first)
+        data = {ctx_list[0]: first}
+        for c in ctx_list[1:]:
+            data[c] = first.as_in_ctx(c)
         self._data = data
         if self._grad_req != "null":
             self._init_grad()

@@ -41,12 +41,7 @@ class Trainer:
                 raise ValueError(f"element {i} is not a Parameter")
             self._param2idx[param.name] = i
             self._params.append(param)
-        if compression_params is not None:
-            # 2-bit gradient compression exists for slow PCIe/TCP links
-            # (`src/kvstore/gradient_compression.h`); ICI bandwidth makes it
-            # counterproductive on TPU.
-            raise NotImplementedError(
-                "gradient compression is not supported on kvstore='tpu_ici'")
+        self._compression_params = compression_params
         self._scale = 1.0
         self._kvstore_type = kvstore
         self._kvstore = None
@@ -109,6 +104,20 @@ class Trainer:
                     f"kvstore {self._kvstore.type} does not support "
                     "update_on_kvstore")
             self._kvstore.set_optimizer(self._optimizer)
+        if self._kvstore is not None and self._compression_params is not None:
+            if not hasattr(self._kvstore, "set_gradient_compression"):
+                raise ValueError(
+                    f"kvstore {self._kvstore.type} does not support "
+                    "gradient compression")
+            self._kvstore.set_gradient_compression(self._compression_params)
+        if self._kvstore is not None:
+            # broadcast initial values so every device copy agrees
+            # (reference trainer.py:164-174 kvstore init + pull)
+            for i, param in enumerate(self._params):
+                ctxs = param.list_ctx()
+                if len(ctxs) > 1 and param._data is not None:
+                    self._kvstore.broadcast(i, param.data(ctxs[0]),
+                                            param.list_data())
         self._kv_initialized = True
 
     @property
@@ -122,9 +131,18 @@ class Trainer:
             self._states = {}
             for i, param in enumerate(self._params):
                 if param.grad_req != "null":
-                    self._states[i] = \
-                        self._optimizer.create_state_multi_precision(
-                            i, param.data())
+                    ctxs = param.list_ctx()
+                    if len(ctxs) == 1:
+                        self._states[i] = \
+                            self._optimizer.create_state_multi_precision(
+                                i, param.data())
+                    else:
+                        # one state per device copy (the reference keeps a
+                        # per-device updater; sharing state would apply
+                        # momentum N times per step)
+                        self._states[i] = [
+                            self._optimizer.create_state_multi_precision(i, w)
+                            for w in param.list_data()]
 
     # -- step -------------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
@@ -169,8 +187,22 @@ class Trainer:
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
-            for w, g in zip(param.list_data(), param.list_grad()):
-                self._optimizer.update([i], [w], [g], [self._states[i]])
+            ws, gs = param.list_data(), param.list_grad()
+            sts = self._states[i]
+            if not isinstance(sts, list):
+                sts = [sts]
+            if len(sts) != len(ws):
+                # device set changed since states were created (reset_ctx):
+                # rebuild this parameter's states to match
+                sts = [self._optimizer.create_state_multi_precision(i, w)
+                       for w in ws]
+                self._states[i] = sts if len(sts) > 1 else sts[0]
+            for dev_id, (w, g, st) in enumerate(zip(ws, gs, sts)):
+                # per-device update counts (reference
+                # `Optimizer._set_current_context`)
+                self._optimizer._set_current_context(dev_id)
+                self._optimizer.update([i], [w], [g], [st])
+            self._optimizer._set_current_context(0)
 
     # -- the fused path ----------------------------------------------------
     def _try_fused_update(self):
@@ -223,7 +255,12 @@ class Trainer:
     def save_states(self, fname):
         self._init_states()
         updater = opt.Updater(self._optimizer)
-        updater.states = dict(self._states or {})
+        # multi-device params keep one state per copy; the copies are in
+        # sync, so persist the first (the reference saves one updater too)
+        updater.states = {
+            i: (st[0] if isinstance(st, list) else st)
+            for i, st in (self._states or {}).items()
+        }
         with open(fname, "wb") as f:
             f.write(updater.get_states(dump_optimizer=False))
 
@@ -233,8 +270,12 @@ class Trainer:
             updater.set_states(f.read())
         self._init_states()
         for i, st in updater.states.items():
-            if i in self._states:
-                for cur, new in zip(_as_tuple(self._states[i]), _as_tuple(st)):
+            if i not in self._states:
+                continue
+            cur_entry = self._states[i]
+            entries = cur_entry if isinstance(cur_entry, list) else [cur_entry]
+            for entry in entries:  # every device copy gets the loaded state
+                for cur, new in zip(_as_tuple(entry), _as_tuple(st)):
                     cur._rebind(new._data)
 
 

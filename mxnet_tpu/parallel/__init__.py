@@ -8,11 +8,12 @@ collectives (psum/all-gather/reduce-scatter) and schedules them over ICI.
 from .mesh import (
     make_mesh, current_mesh, mesh_scope, data_sharding, replicated_sharding,
     match_partition_rules, shard_parameters, constrain,
+    init_distributed,
 )
 from .ring_attention import ring_attention
 
 __all__ = [
     "make_mesh", "current_mesh", "mesh_scope", "data_sharding",
     "replicated_sharding", "match_partition_rules", "shard_parameters",
-    "constrain", "ring_attention",
+    "constrain", "ring_attention", "init_distributed",
 ]

@@ -125,3 +125,27 @@ def constrain(x, mesh, spec):
         return jax.lax.with_sharding_constraint(d, sharding)
 
     return invoke(f, (x,), name="sharding_constraint")
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Initialize multi-host JAX from explicit args or the environment set
+    by `tools/launch.py` (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID).
+
+    The reference analogue is ps-lite's DMLC_* env bootstrap
+    (`src/kvstore/kvstore_dist.h`); here every process is a peer and the
+    coordination service at process 0 takes the scheduler's role.  On a
+    real TPU pod slice, call with no arguments outside a launcher — the
+    TPU runtime supplies the topology.
+    """
+    import jax
+
+    if coordinator_address is None and num_processes is None and \
+            process_id is None:
+        from .._distributed import init_from_env
+        init_from_env()
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)

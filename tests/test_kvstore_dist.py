@@ -1,0 +1,170 @@
+"""KVStore collective + launcher tests.
+
+Reference pattern: `tests/nightly/dist_sync_kvstore.py` — deterministic
+push/pull value checks, run as multiple local processes via
+`tools/launch.py -n N --launcher local`.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_aliases_resolve():
+    for name in ["tpu_ici", "nccl", "dist_sync", "dist_device_sync",
+                 "horovod"]:
+        assert kvstore.create(name).type == "tpu_ici"
+    with pytest.raises(mx.MXNetError):
+        kvstore.create("dist_async")
+    with pytest.raises(mx.MXNetError):
+        kvstore.create("p3")
+
+
+def test_pushpull_reduces_copies():
+    kv = kvstore.create("tpu_ici")
+    vals = [mx.np.full((4, 3), float(i + 1)) for i in range(4)]
+    kv.pushpull("w", vals)
+    for v in vals:
+        assert onp.allclose(v.asnumpy(), 1 + 2 + 3 + 4)
+
+
+def test_gradient_compression_2bit():
+    kv = kvstore.create("tpu_ici")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    # two device copies, reduced with quantized levels (per-copy quantize)
+    a = mx.np.array([2.5, -0.4, 0.1, -3.0])
+    b = mx.np.array([2.5, -0.4, 0.1, -3.0])
+    kv.pushpull("g", [a, b])  # out=None -> in-place on the pushed arrays
+    # each copy quantizes to [1, 0, 0, -1]; the sum is [2, 0, 0, -2]
+    assert a.asnumpy().tolist() == [2.0, 0.0, 0.0, -2.0]
+    assert b.asnumpy().tolist() == [2.0, 0.0, 0.0, -2.0]
+
+    # error feedback: residual [1.5, -0.4, 0.1, -2.0] per copy crosses the
+    # threshold again on the next round even with zero new gradient
+    a2, b2 = mx.np.zeros(4), mx.np.zeros(4)
+    out = [mx.np.zeros(4), mx.np.zeros(4)]
+    kv.pushpull("g", [a2, b2], out=out)
+    assert out[0].asnumpy().tolist() == [2.0, 0.0, 0.0, -2.0]
+
+    # SPMD single-array path is not quantized (XLA already reduced)
+    v = mx.np.array([0.3, -0.2])
+    o = mx.np.zeros(2)
+    kv.pushpull("h", [v], out=[o])
+    assert onp.allclose(o.asnumpy(), [0.3, -0.2])
+
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "1bit"})
+
+
+def test_dead_nodes_api():
+    kv = kvstore.create("tpu_ici")
+    assert kv.get_dead_nodes() == []
+
+
+def test_multi_device_data_parallel_training():
+    """Classic DP (reference pattern: initialize(ctx=list) + split_and_load
+    + kvstore) — copies must start identical, reduce grads through the
+    store, and stay bitwise in sync."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.utils import split_and_load
+
+    onp.random.seed(0)
+    ctxs = [mx.cpu(i) for i in range(4)]
+    net = nn.Dense(1, in_units=6)
+    net.initialize(ctx=ctxs)
+    p = net.collect_params()["weight"]
+    first = p.list_data()[0].asnumpy()
+    assert all(onp.array_equal(first, d.asnumpy()) for d in p.list_data())
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore="dist_sync")
+    lf = gluon.loss.L2Loss()
+    X = onp.random.randn(64, 6).astype("float32")
+    Y = X @ onp.random.randn(6, 1).astype("float32")
+    losses = []
+    for _ in range(60):
+        xs = split_and_load(mx.np.array(X), ctxs)
+        ys = split_and_load(mx.np.array(Y), ctxs)
+        with autograd.record():
+            ls = [lf(net(xb), yb).mean() for xb, yb in zip(xs, ys)]
+        autograd.backward(ls)
+        trainer.step(16)
+        losses.append(onp.mean([float(l.asnumpy()) for l in ls]))
+    assert losses[-1] < losses[0] * 1e-2, (losses[0], losses[-1])
+    copies = [d.asnumpy() for d in p.list_data()]
+    assert all(onp.array_equal(copies[0], c) for c in copies[1:])
+
+
+def test_trainer_compression_params_and_states(tmp_path):
+    """Trainer wires compression_params to the store, and optimizer-state
+    save/load round-trips with multi-device per-copy states."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.utils import split_and_load
+
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    net = nn.Dense(1, in_units=3)
+    net.initialize(ctx=ctxs)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore="dist_sync",
+                            compression_params={"type": "2bit",
+                                                "threshold": 10.0})
+    lf = gluon.loss.L2Loss()
+    X = onp.random.randn(8, 3).astype("float32")
+    Y = onp.zeros((8, 1), "float32")
+    xs = split_and_load(mx.np.array(X), ctxs)
+    ys = split_and_load(mx.np.array(Y), ctxs)
+    with autograd.record():
+        ls = [lf(net(xb), yb).mean() for xb, yb in zip(xs, ys)]
+    autograd.backward(ls)
+    trainer.step(4)
+    assert trainer.kvstore._compression["threshold"] == 10.0
+
+    f = str(tmp_path / "states.bin")
+    trainer.save_states(f)
+    trainer.load_states(f)  # round-trip over list-of-per-device states
+
+
+def test_launcher_spawns_workers(tmp_path):
+    """tools/launch.py runs N local processes with distinct ranks and a
+    shared coordinator address (reference local-launcher pattern)."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "rank = os.environ['JAX_PROCESS_ID']\n"
+        "n = os.environ['JAX_NUM_PROCESSES']\n"
+        "addr = os.environ['JAX_COORDINATOR_ADDRESS']\n"
+        "out = os.path.join(os.path.dirname(__file__), f'r{rank}.txt')\n"
+        "open(out, 'w').write(f'{rank}/{n}@{addr}')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "3", "--", sys.executable, str(script)],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert r.returncode == 0, r.stderr
+    reports = sorted((tmp_path / f"r{i}.txt").read_text() for i in range(3))
+    assert [x.split("/")[0] for x in reports] == ["0", "1", "2"]
+    addrs = {x.split("@")[1] for x in reports}
+    assert len(addrs) == 1  # all workers share one coordinator
+
+
+def test_launcher_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import os, sys; sys.exit(int(os.environ['JAX_PROCESS_ID']))\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--", sys.executable, str(script)],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert r.returncode == 1
+    assert "workers failed: [1]" in r.stderr

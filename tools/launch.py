@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Multi-process / multi-host training launcher.
+
+Reference: `tools/launch.py` (`:72-74`) — spawns the ps-lite scheduler,
+servers, and workers for `kvstore='dist_*'` via local/ssh/mpi launchers.
+
+TPU-native equivalent: SPMD has no scheduler/server roles; every process
+is a worker running the same script.  This launcher spawns N processes
+(`--launcher local`, the mode the reference CI uses for distributed tests)
+wired for `jax.distributed.initialize()`:
+
+  JAX_COORDINATOR_ADDRESS   host:port of process 0
+  JAX_NUM_PROCESSES         N
+  JAX_PROCESS_ID            0..N-1
+
+On a real TPU pod each host runs one process and the TPU runtime supplies
+the topology; `--launcher local` is for CPU-mesh testing (each process gets
+a slice of virtual devices), mirroring how the reference tests dist kvstore
+with N local processes (`tests/nightly/test_distributed_training-gpu.sh`).
+
+Example:
+  python tools/launch.py -n 4 --launcher local -- python train.py --kv-store tpu_ici
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+__all__ = ["launch_local"]
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch_local(num_workers, command, env_extra=None,
+                 devices_per_worker=None):
+    """Spawn `num_workers` local processes running `command`; returns the
+    list of exit codes (reference local launcher semantics: fail if any
+    worker fails)."""
+    port = _free_port()
+    procs = []
+    for rank in range(num_workers):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["JAX_NUM_PROCESSES"] = str(num_workers)
+        env["JAX_PROCESS_ID"] = str(rank)
+        # reference-compatible names some scripts read
+        env["DMLC_NUM_WORKER"] = str(num_workers)
+        env["DMLC_WORKER_ID"] = str(rank)
+        if devices_per_worker:
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "") +
+                f" --xla_force_host_platform_device_count={devices_per_worker}"
+            ).strip()
+        procs.append(subprocess.Popen(command, env=env))
+    codes = [p.wait() for p in procs]
+    return codes
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("--launcher", choices=["local"], default="local",
+                   help="ssh/mpi/sge/yarn launchers of the reference are "
+                        "out of scope: TPU pods schedule one process per "
+                        "host through their own runtime")
+    p.add_argument("--devices-per-worker", type=int, default=0,
+                   help="virtual CPU devices per process (testing)")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command (prefix with --)")
+    args = p.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        p.error("no command given")
+    codes = launch_local(args.num_workers, command,
+                         devices_per_worker=args.devices_per_worker or None)
+    bad = [i for i, c in enumerate(codes) if c != 0]
+    if bad:
+        print(f"workers failed: {bad}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
