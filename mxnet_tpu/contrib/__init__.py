@@ -1,11 +1,13 @@
 """Contrib namespace (reference: `python/mxnet/contrib/` and the
 `_contrib_*` op family in `src/operator/contrib/`)."""
 from ..ops.contrib import (box_iou, box_nms, bipartite_matching, roi_align,
-                           boolean_mask, allclose, index_copy, index_array)
+                           multibox_detection, boolean_mask, allclose,
+                           index_copy, index_array)
 
 # reference CamelCase aliases (mx.nd.contrib.ROIAlign)
 ROIAlign = roi_align
+MultiBoxDetection = multibox_detection
 
 __all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
-           "ROIAlign", "boolean_mask", "allclose", "index_copy",
-           "index_array"]
+           "ROIAlign", "multibox_detection", "MultiBoxDetection",
+           "boolean_mask", "allclose", "index_copy", "index_array"]

@@ -300,6 +300,15 @@ def stack(seq, axis=0, out=None):
     return _apply_out(res, out)
 
 
+def copyto(dst, src):
+    """NumPy-compatible copyto: broadcast src into dst in place."""
+    src_nd = src if isinstance(src, NDArray) else array(src)
+    if src_nd.shape != dst.shape:
+        src_nd = broadcast_to(src_nd, dst.shape)
+    src_nd.copyto(dst)
+    return dst
+
+
 def isnat(*_a, **_k):
     raise NotImplementedError("datetime dtypes are not supported on TPU")
 

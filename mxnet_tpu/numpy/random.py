@@ -22,7 +22,7 @@ __all__ = [
     "bernoulli", "binomial", "multinomial", "laplace", "gumbel", "logistic",
     "lognormal", "chisquare", "rayleigh", "pareto", "power", "weibull",
     "multivariate_normal", "f", "standard_normal", "standard_exponential",
-    "standard_gamma",
+    "standard_gamma", "t", "geometric", "negative_binomial",
 ]
 
 seed = _rng.seed
@@ -351,3 +351,46 @@ def f(dfnum, dfden, size=None, ctx=None, device=None):
         return (x1 / jnp.asarray(d1, dtype)) / (x2 / jnp.asarray(d2, dtype))
 
     return _sample(fun, (dfnum, dfden), size, dtype, ctx, device, None, "f")
+
+
+def t(df, size=None, ctx=None, device=None):
+    """Student's t samples (reference `_npi_student_t`)."""
+    dtype = onp.float32
+    shp = _size(size, df)
+
+    def fun(key, d):
+        return jax.random.t(key, jnp.asarray(d, dtype), shape=shp,
+                            dtype=dtype)
+
+    return _sample(fun, (df,), size, dtype, ctx, device, None, "t")
+
+
+def geometric(p, size=None, ctx=None, device=None):
+    """Geometric samples counting trials until first success, support
+    {1, 2, ...} (numpy semantics)."""
+    dtype = onp.float32
+    shp = _size(size, p)
+
+    def fun(key, pp):
+        u = jax.random.uniform(key, shp or jnp.shape(pp), dtype,
+                               minval=1e-7, maxval=1.0)
+        return jnp.ceil(jnp.log1p(-u) / jnp.log1p(-jnp.asarray(pp, dtype)))
+
+    return _sample(fun, (p,), size, dtype, ctx, device, None, "geometric")
+
+
+def negative_binomial(n, p, size=None, ctx=None, device=None):
+    """Negative-binomial samples via the gamma-Poisson mixture."""
+    dtype = onp.float32
+    shp = _size(size, n, p)
+
+    def fun(key, nn_, pp):
+        k1, k2 = jax.random.split(key)
+        nn_ = jnp.asarray(nn_, dtype)
+        pp = jnp.asarray(pp, dtype)
+        lam = jax.random.gamma(k1, jnp.broadcast_to(nn_, shp or jnp.shape(nn_)),
+                               dtype=dtype) * (1 - pp) / pp
+        return jax.random.poisson(k2, lam).astype(dtype)
+
+    return _sample(fun, (n, p), size, dtype, ctx, device, None,
+                   "negative_binomial")

@@ -26,7 +26,7 @@ __all__ = [
     "leaky_relu", "log_softmax", "masked_softmax", "masked_log_softmax",
     "one_hot", "pick", "pooling", "relu", "sigmoid", "smooth_l1", "softmax",
     "topk", "batch_dot", "sequence_mask", "sequence_last", "sequence_reverse",
-    "reshape_like", "arange_like", "gamma", "gammaln", "erf", "erfinv",
+    "reshape_like", "arange_like", "gamma", "gamma_fn", "gelu", "gammaln", "erf", "erfinv",
     "adaptive_avg_pool2d", "l2_normalization", "waitall", "cpu", "gpu", "tpu",
     "num_gpus", "num_tpus", "current_context", "save", "load", "seed",
     "foreach", "while_loop", "cond",
@@ -69,6 +69,14 @@ smooth_l1 = _op(_nn.smooth_l1, "smooth_l1")
 reshape_like = _op(_nn.reshape_like, "reshape_like")
 arange_like = _op(_nn.arange_like, "arange_like", differentiable=False)
 gamma = _op(_nn.gamma_fn, "gamma")
+gamma_fn = gamma
+
+
+def gelu(data, approximation="erf"):
+    """GELU activation: exact erf form or tanh approximation (the same
+    lowerings `leaky_relu` act_type='gelu'/'gelu_tanh' uses)."""
+    act = "gelu" if approximation in ("erf", "none", None) else "gelu_tanh"
+    return leaky_relu(data, act_type=act)
 gammaln = _op(_nn.gammaln, "gammaln")
 erf = _op(_nn.erf, "erf")
 erfinv = _op(_nn.erfinv, "erfinv")

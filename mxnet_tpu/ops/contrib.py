@@ -22,7 +22,8 @@ from jax import lax
 from .invoke import invoke
 
 __all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
-           "boolean_mask", "allclose", "index_copy", "index_array"]
+           "multibox_detection", "boolean_mask", "allclose", "index_copy",
+           "index_array"]
 
 
 def _corner(boxes, fmt):
@@ -231,6 +232,55 @@ def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
 
         return jax.vmap(one_roi)(batch_idx, ys, xs)
     return invoke(f, (data, rois), name="roi_align")
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD detection decode + per-class NMS (reference
+    `_contrib_MultiBoxDetection`, `src/operator/contrib/multibox_detection.cc`).
+
+    cls_prob: (B, num_classes+1, N) softmax scores (class 0 = background);
+    loc_pred: (B, N*4) box regressions; anchor: (1, N, 4) corner priors.
+    Returns (B, N, 6) rows of [class_id, score, x1, y1, x2, y2], invalid
+    rows -1 — the exact layout `box_nms` emits.
+    """
+    vx, vy, vw, vh = variances
+
+    def decode(d):
+        cp, lp, an = d
+        b = cp.shape[0]
+        n = an.shape[1]
+        lp = lp.reshape(b, n, 4)
+        # anchors corner -> center
+        aw = an[..., 2] - an[..., 0]
+        ah = an[..., 3] - an[..., 1]
+        ax = (an[..., 0] + an[..., 2]) / 2
+        ay = (an[..., 1] + an[..., 3]) / 2
+        cx = lp[..., 0] * vx * aw + ax
+        cy = lp[..., 1] * vy * ah + ay
+        w = jnp.exp(lp[..., 2] * vw) * aw / 2
+        h = jnp.exp(lp[..., 3] * vh) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best FOREGROUND class per anchor, invalidated only by the score
+        # threshold (multibox_detection.cc:110-122: the background score
+        # itself never vetoes a detection)
+        scores = cp[:, 1:, :]                      # (B, C, N)
+        cls_id = jnp.argmax(scores, axis=1).astype(boxes.dtype)
+        score = jnp.max(scores, axis=1)
+        keep = score >= threshold
+        cls_id = jnp.where(keep, cls_id, -1.0)
+        score = jnp.where(keep, score, -1.0)
+        return jnp.concatenate(
+            [cls_id[..., None], score[..., None], boxes], axis=-1)
+
+    decoded = invoke(decode, ((cls_prob, loc_pred, anchor),),
+                     name="multibox_decode")
+    return box_nms(decoded, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)
 
 
 def boolean_mask(data, index, axis=0):

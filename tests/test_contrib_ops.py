@@ -152,6 +152,50 @@ def test_allclose_and_index_ops():
     assert idx[1, 2].tolist() == [1, 2]
 
 
+def test_multibox_detection():
+    # 3 anchors, 2 foreground classes; anchor 0 scores high for class 0,
+    # anchor 2 for class 1; anchor 1 is background
+    anchors = onp.array([[[0.1, 0.1, 0.3, 0.3],
+                          [0.4, 0.4, 0.6, 0.6],
+                          [0.7, 0.7, 0.9, 0.9]]], dtype="float32")
+    cls_prob = onp.array([[[0.1, 0.9, 0.2],     # background
+                           [0.8, 0.05, 0.1],    # class 0
+                           [0.1, 0.05, 0.7]]],  # class 1
+                         dtype="float32")
+    loc_pred = onp.zeros((1, 12), dtype="float32")  # no regression offset
+    # threshold 0.1 drops the background anchor (best fg score 0.1 vs 0.05);
+    # the reference never vetoes on the background score itself
+    out = contrib.multibox_detection(
+        mx.np.array(cls_prob), mx.np.array(loc_pred),
+        mx.np.array(anchors), threshold=0.2).asnumpy()
+    assert out.shape == (1, 3, 6)
+    live = out[0][out[0, :, 1] > 0]
+    assert len(live) == 2
+    # highest score first: class 0 @ anchor 0 (0.8), class 1 @ anchor 2 (0.7)
+    assert live[0, 0] == 0.0 and live[0, 1] == pytest.approx(0.8)
+    assert onp.allclose(live[0, 2:], anchors[0, 0], atol=1e-5)
+    assert live[1, 0] == 1.0 and live[1, 1] == pytest.approx(0.7)
+    assert onp.allclose(live[1, 2:], anchors[0, 2], atol=1e-5)
+
+
+def test_new_random_and_np_fns():
+    s = mx.np.random.t(5.0, size=(500,))
+    assert s.shape == (500,)
+    g = mx.np.random.geometric(0.5, size=(1000,)).asnumpy()
+    assert g.min() >= 1 and 1.5 < g.mean() < 2.5
+    nb = mx.np.random.negative_binomial(5, 0.5, size=(1000,)).asnumpy()
+    assert 4 < nb.mean() < 6  # mean n(1-p)/p = 5
+    dst = mx.np.zeros(3)
+    mx.np.copyto(dst, mx.np.array([1.0, 2.0, 3.0]))
+    assert dst.asnumpy().tolist() == [1.0, 2.0, 3.0]
+    mx.np.copyto(dst, 7.0)  # scalar broadcasts, as numpy does
+    assert dst.asnumpy().tolist() == [7.0, 7.0, 7.0]
+    assert mx.np.random.t(5.0).shape == ()
+    x = mx.np.array([-1.0, 0.0, 2.0])
+    got = mx.npx.gelu(x).asnumpy()
+    assert got[1] == 0.0 and got[2] > 1.9 and -0.2 < got[0] < 0.0
+
+
 def test_roi_align_gradient_flows():
     from mxnet_tpu import autograd
     img = mx.np.array(onp.random.rand(1, 2, 6, 6).astype("float32"))
