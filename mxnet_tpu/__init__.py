@@ -48,6 +48,7 @@ from . import lr_scheduler
 from . import kvstore as kv
 from . import kvstore
 from . import io
+from . import image
 from . import contrib
 from . import gluon
 from . import models

@@ -17,7 +17,13 @@ from .ndarray.ndarray import NDArray
 from . import numpy as mxnp
 
 __all__ = ["imread", "imdecode", "imencode", "imresize", "resize_short",
-           "center_crop", "random_crop", "fixed_crop", "color_normalize"]
+           "center_crop", "random_crop", "fixed_crop", "color_normalize",
+           "Augmenter", "SequentialAug", "RandomOrderAug", "ResizeAug",
+           "ForceResizeAug", "RandomCropAug", "CenterCropAug",
+           "RandomSizedCropAug", "HorizontalFlipAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "HueJitterAug",
+           "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+           "RandomGrayAug", "CastAug", "CreateAugmenter", "ImageIter"]
 
 
 def _pil():
@@ -56,7 +62,7 @@ def imdecode(buf, flag=1, to_rgb=True):
 
 def imencode(img, img_fmt=".jpg", quality=95):
     Image = _pil()
-    arr = img.asnumpy() if isinstance(img, NDArray) else onp.asarray(img)
+    arr = _as_np(img)
     if arr.shape[-1] == 1:
         arr = arr[:, :, 0]
     pil = Image.fromarray(arr)
@@ -68,12 +74,12 @@ def imencode(img, img_fmt=".jpg", quality=95):
 
 def imresize(src, w, h, interp=1):
     from .gluon.data.vision.transforms import _resize_hwc
-    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    arr = _as_np(src)
     return mxnp.array(_resize_hwc(arr, (w, h)))
 
 
 def resize_short(src, size, interp=1):
-    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    arr = _as_np(src)
     h, w = arr.shape[:2]
     if h > w:
         new_w, new_h = size, int(size * h / w)
@@ -83,7 +89,7 @@ def resize_short(src, size, interp=1):
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
-    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    arr = _as_np(src)
     out = arr[y0:y0 + h, x0:x0 + w]
     if size is not None and (w, h) != size:
         return imresize(out, size[0], size[1], interp)
@@ -91,7 +97,7 @@ def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
 
 
 def center_crop(src, size, interp=1):
-    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    arr = _as_np(src)
     h, w = arr.shape[:2]
     new_w, new_h = size
     x0 = (w - new_w) // 2
@@ -100,7 +106,7 @@ def center_crop(src, size, interp=1):
 
 
 def random_crop(src, size, interp=1):
-    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    arr = _as_np(src)
     h, w = arr.shape[:2]
     new_w, new_h = size
     x0 = onp.random.randint(0, w - new_w + 1)
@@ -114,3 +120,403 @@ def color_normalize(src, mean, std=None):
     if std is not None:
         src = src / std
     return src
+
+
+# --------------------------------------------------------------------------
+# Augmenters (reference `python/mxnet/image/image.py` Augmenter zoo).
+# These run on host numpy inside DataLoader/iterator workers — the TPU only
+# sees the batched, normalized tensors.
+# --------------------------------------------------------------------------
+
+def _as_np(src):
+    """Coerce NDArray/array-like to a host numpy array."""
+    return src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+
+
+class Augmenter:
+    """Image augmenter base (reference image.py Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        order = onp.random.permutation(len(self.ts))
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    """Resize shorter edge to `size`."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    """Force resize to (w, h)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    """Random area+aspect crop resized to `size` (Inception-style)."""
+
+    def __init__(self, size, area=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        if isinstance(area, (int, float)):
+            area = (area, 1.0)
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        arr = _as_np(src)
+        h, w = arr.shape[:2]
+        src_area = h * w
+        for _ in range(10):
+            target_area = onp.random.uniform(*self.area) * src_area
+            log_ratio = (onp.log(self.ratio[0]), onp.log(self.ratio[1]))
+            aspect = onp.exp(onp.random.uniform(*log_ratio))
+            new_w = int(round(onp.sqrt(target_area * aspect)))
+            new_h = int(round(onp.sqrt(target_area / aspect)))
+            if new_w <= w and new_h <= h:
+                x0 = onp.random.randint(0, w - new_w + 1)
+                y0 = onp.random.randint(0, h - new_h + 1)
+                return fixed_crop(arr, x0, y0, new_w, new_h, self.size,
+                                  self.interp)
+        # fallback: short edge to max(size) so both dims cover the crop
+        return CenterCropAug(self.size, self.interp)(
+            ResizeAug(max(self.size))(arr))
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if onp.random.rand() < self.p:
+            arr = _as_np(src)
+            return mxnp.array(onp.ascontiguousarray(arr[:, ::-1]))
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.brightness, self.brightness)
+        arr = _as_np(src)
+        return mxnp.array(arr.astype(onp.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = onp.array([[[0.299, 0.587, 0.114]]], onp.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.contrast, self.contrast)
+        arr = _as_np(src).astype(onp.float32)
+        gray = (arr * self._coef).sum(-1, keepdims=True)
+        return mxnp.array(arr * alpha + gray.mean() * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = ContrastJitterAug._coef
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.saturation, self.saturation)
+        arr = _as_np(src).astype(onp.float32)
+        gray = (arr * self._coef).sum(-1, keepdims=True)
+        return mxnp.array(arr * alpha + gray * (1 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = onp.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], onp.float32)
+        self.ityiq = onp.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], onp.float32)
+
+    def __call__(self, src):
+        alpha = onp.random.uniform(-self.hue, self.hue)
+        u, w_ = onp.cos(alpha * onp.pi), onp.sin(alpha * onp.pi)
+        bt = onp.array([[1.0, 0.0, 0.0], [0.0, u, -w_], [0.0, w_, u]],
+                       onp.float32)
+        t = self.ityiq @ bt @ self.tyiq
+        arr = _as_np(src).astype(onp.float32)
+        return mxnp.array(arr @ t.T)
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = onp.asarray(eigval, onp.float32)
+        self.eigvec = onp.asarray(eigvec, onp.float32)
+
+    def __call__(self, src):
+        alpha = onp.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        arr = _as_np(src).astype(onp.float32)
+        return mxnp.array(arr + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = onp.asarray(mean, onp.float32)
+        self.std = None if std is None else onp.asarray(std, onp.float32)
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _coef = ContrastJitterAug._coef
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if onp.random.rand() < self.p:
+            arr = _as_np(src).astype(onp.float32)
+            gray = (arr * self._coef).sum(-1, keepdims=True)
+            return mxnp.array(onp.broadcast_to(gray, arr.shape).copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        arr = _as_np(src)
+        return mxnp.array(arr.astype(self.typ))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter list (reference `CreateAugmenter`,
+    image.py) for `ImageIter(aug_list=...)`."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, interp=inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(
+            pca_noise,
+            [55.46, 4.794, 1.148],
+            [[-0.5675, 0.7192, 0.4009],
+             [-0.5808, -0.0045, -0.8140],
+             [-0.5836, -0.6948, 0.4203]]))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Classic image iterator over a RecordIO pack or an image list
+    (reference `mx.image.ImageIter` driving `ImageRecordIter`'s role):
+    decodes, augments, and yields NCHW float batches with labels.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, label_width=1, data_name="data",
+                 label_name="softmax_label", last_batch_handle="pad"):
+        assert (path_imgrec is None) != (path_imglist is None), \
+            "pass exactly one of path_imgrec / path_imglist"
+        assert len(data_shape) == 3 and data_shape[0] in (1, 3)
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.aug_list = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self.label_width = label_width
+        self._rec = None
+        self._items = None
+        self.path_root = path_root
+        if path_imgrec is not None:
+            from .recordio import MXIndexedRecordIO
+            import os as _os
+            idx = _os.path.splitext(path_imgrec)[0] + ".idx"
+            self._rec = MXIndexedRecordIO(idx, path_imgrec, "r")
+            self._keys = list(self._rec.keys)
+        else:
+            self._items = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    label = [float(x) for x in parts[1:-1]]
+                    self._items.append((parts[-1], label))
+            self._keys = list(range(len(self._items)))
+        self.shuffle = shuffle
+        if last_batch_handle not in ("pad", "discard"):
+            raise NotImplementedError(
+                f"last_batch_handle={last_batch_handle!r}: ImageIter "
+                "supports 'pad' and 'discard'")
+        self.last_batch_handle = last_batch_handle
+        from .io import DataDesc
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape)]
+        self.provide_label = [DataDesc(label_name,
+                                       (batch_size, label_width)
+                                       if label_width > 1 else (batch_size,))]
+        self.reset()
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        self._order = list(range(len(self._keys)))
+        if self.shuffle:
+            onp.random.shuffle(self._order)
+        self._cursor = 0
+
+    def _read_one(self, i):
+        from .recordio import unpack_img
+        if self._rec is not None:
+            header, img = unpack_img(self._rec.read_idx(self._keys[i]),
+                                     iscolor=1 if self.data_shape[0] == 3
+                                     else 0)
+            label = header.label
+            # flag-packed labels arrive as arrays; match provide_label
+            if isinstance(label, onp.ndarray) and self.label_width == 1:
+                label = float(label.ravel()[0])
+        else:
+            import os as _os
+            path, label = self._items[i]
+            img = imread(_os.path.join(self.path_root, path),
+                         flag=1 if self.data_shape[0] == 3 else 0)
+            label = label[0] if len(label) == 1 else onp.asarray(label)
+        for aug in self.aug_list:
+            img = aug(img)
+        arr = _as_np(img)
+        return arr.astype(onp.float32).transpose(2, 0, 1), label
+
+    def next(self):
+        n = len(self._order)
+        if self._cursor >= n:
+            raise StopIteration
+        idxs = [self._order[(self._cursor + j) % n]
+                for j in range(self.batch_size)]
+        pad = max(0, self._cursor + self.batch_size - n)
+        if pad and self.last_batch_handle == "discard":
+            raise StopIteration
+        self._cursor += self.batch_size
+        datas, labels = zip(*(self._read_one(i) for i in idxs))
+        from .io import DataBatch
+        data = mxnp.array(onp.stack(datas))
+        label = mxnp.array(onp.asarray(labels, onp.float32))
+        return DataBatch([data], [label], pad=pad)
+
+    def __next__(self):
+        return self.next()

@@ -8,6 +8,7 @@ under the legacy names users expect (`mx.nd.array`, `mx.nd.waitall`,
 from __future__ import annotations
 
 from .ndarray import NDArray, array, empty, from_jax, waitall
+from . import sparse
 
 
 def _lazy_np():

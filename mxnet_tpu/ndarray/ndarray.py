@@ -373,12 +373,13 @@ class NDArray:
                       (self,), name="norm")
 
     def tostype(self, stype):
-        if stype != "default":
-            raise NotImplementedError(
-                "sparse storage types are not implemented on TPU (XLA has no "
-                "sparse buffers); see SURVEY.md §7 'sparse row_sparse/csr'"
-            )
-        return self
+        """Convert storage type (reference `cast_storage`): 'csr' /
+        'row_sparse' produce the host-side containers in `mx.nd.sparse`
+        (XLA has no sparse buffers; compute stays dense on TPU)."""
+        if stype == "default":
+            return self
+        from . import sparse as _sparse
+        return _sparse.array(self, stype=stype)
 
     @property
     def stype(self):

@@ -1,0 +1,205 @@
+"""Sparse NDArray types: ``row_sparse`` and ``csr``.
+
+Reference: `include/mxnet/ndarray.h` storage types (`kRowSparseStorage`,
+`kCSRStorage`) + `python/mxnet/ndarray/sparse.py` (`CSRNDArray`,
+`RowSparseNDArray`, `csr_matrix`, `row_sparse_array`, `dot`, `retain`,
+`tostype`).
+
+TPU-native stance (SURVEY.md §7): XLA has no sparse buffer type, and on
+the MXU dense gather/scatter is the fast path, so sparse arrays here are
+host-side index/value containers for data interchange (the reference's
+main uses: CTR-style CSR datasets and row_sparse gradients for wide
+embeddings).  Compute (`dot`) lowers through `jax.experimental.sparse`
+BCOO, which XLA compiles to gather/scatter-matmul; converting `tostype
+('default')` materializes a dense NDArray on device.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as onp
+
+from .ndarray import NDArray
+
+
+@jax.jit
+def _dot_jit(s, d):
+    return s @ d
+
+
+@jax.jit
+def _dot_t_jit(s, d):
+    return s.T @ d
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
+           "row_sparse_array", "dot", "retain", "zeros", "array"]
+
+
+class _SparseNDArray:
+    """Common container behavior (shape/dtype/context/tostype)."""
+
+    stype = None
+
+    def __init__(self, shape, dtype):
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = onp.dtype(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self._shape} "
+                f"stype={self.stype}>")
+
+    def asnumpy(self):
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return NDArray(self.asnumpy())
+        raise ValueError(
+            f"cannot convert {self.stype} directly to {stype!r}")
+
+
+class CSRNDArray(_SparseNDArray):
+    """Compressed sparse row matrix (reference `CSRNDArray`)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape, dtype=None):
+        data = onp.asarray(data)
+        super().__init__(shape, dtype or data.dtype)
+        assert len(self._shape) == 2, "csr is 2-D"
+        self.data = data.astype(self._dtype)
+        self.indices = onp.asarray(indices, onp.int32)
+        self.indptr = onp.asarray(indptr, onp.int32)
+        assert self.indptr.shape == (self._shape[0] + 1,)
+        assert self.data.shape == self.indices.shape
+
+    @property
+    def nnz(self):
+        return int(self.data.shape[0])
+
+    def _row_indices(self):
+        return onp.repeat(onp.arange(self._shape[0], dtype=onp.int32),
+                          onp.diff(self.indptr))
+
+    def asnumpy(self):
+        out = onp.zeros(self._shape, self._dtype)
+        out[self._row_indices(), self.indices] = self.data
+        return out
+
+    def _to_bcoo(self):
+        from jax.experimental import sparse as jsparse
+        idx = onp.stack([self._row_indices(), self.indices], axis=1)
+        return jsparse.BCOO((self.data, idx), shape=self._shape)
+
+    def __getitem__(self, r):
+        lo, hi = self.indptr[r], self.indptr[r + 1]
+        out = onp.zeros((self._shape[1],), self._dtype)
+        out[self.indices[lo:hi]] = self.data[lo:hi]
+        return NDArray(out)
+
+
+class RowSparseNDArray(_SparseNDArray):
+    """First-dim-sparse tensor (reference `RowSparseNDArray`): `data`
+    holds only the rows listed in `indices`."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, dtype=None):
+        data = onp.asarray(data)
+        super().__init__(shape, dtype or data.dtype)
+        self.data = data.astype(self._dtype)
+        self.indices = onp.asarray(indices, onp.int32)
+        assert self.data.shape[0] == self.indices.shape[0]
+        assert self.data.shape[1:] == self._shape[1:]
+
+    def asnumpy(self):
+        out = onp.zeros(self._shape, self._dtype)
+        out[self.indices] = self.data
+        return out
+
+
+def csr_matrix(arg1, shape=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr) or a dense source
+    (reference `sparse.csr_matrix`)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            # infer as the reference does: rows from indptr, cols from the
+            # largest column index
+            indices_arr = onp.asarray(indices, onp.int32)
+            shape = (len(indptr) - 1,
+                     int(indices_arr.max()) + 1 if indices_arr.size else 0)
+        return CSRNDArray(data, indices, indptr, shape, dtype)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else onp.asarray(arg1)
+    assert dense.ndim == 2
+    rows, cols = onp.nonzero(dense)
+    indptr = onp.zeros(dense.shape[0] + 1, onp.int32)
+    onp.cumsum(onp.bincount(rows, minlength=dense.shape[0]), out=indptr[1:])
+    return CSRNDArray(dense[rows, cols], cols, indptr,
+                      shape or dense.shape, dtype)
+
+
+def row_sparse_array(arg1, shape=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices) or a dense source."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if shape is None:
+            data_arr = onp.asarray(arg1[0])
+            indices_arr = onp.asarray(indices, onp.int32)
+            rows = int(indices_arr.max()) + 1 if indices_arr.size else 0
+            shape = (rows,) + data_arr.shape[1:]
+        return RowSparseNDArray(data, indices, shape, dtype)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else onp.asarray(arg1)
+    nz_rows = onp.nonzero(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
+    return RowSparseNDArray(dense[nz_rows], nz_rows, shape or dense.shape,
+                            dtype)
+
+
+def array(source, stype="csr", **kwargs):
+    if stype == "csr":
+        return csr_matrix(source, **kwargs)
+    if stype == "row_sparse":
+        return row_sparse_array(source, **kwargs)
+    raise ValueError(f"unknown stype {stype!r}")
+
+
+def zeros(stype, shape, dtype="float32"):
+    if stype == "csr":
+        return CSRNDArray(onp.zeros((0,), dtype), [], onp.zeros(
+            (shape[0] + 1,), onp.int32), shape, dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(onp.zeros((0,) + tuple(shape[1:]), dtype),
+                                [], shape, dtype)
+    raise ValueError(f"unknown stype {stype!r}")
+
+
+def dot(lhs, rhs, transpose_a=False):
+    """Sparse-dense matmul (reference `sparse.dot` with `FComputeEx`
+    kernels): csr @ dense or csr.T @ dense via a BCOO contraction compiled
+    by XLA."""
+    if not isinstance(lhs, CSRNDArray):
+        raise TypeError("sparse.dot expects a CSR lhs")
+    bcoo = lhs._to_bcoo()
+    rhs_data = rhs._data if isinstance(rhs, NDArray) else onp.asarray(rhs)
+    fn = _dot_t_jit if transpose_a else _dot_jit
+    return NDArray(fn(bcoo, rhs_data))
+
+
+def retain(rs, indices):
+    """Keep only the listed rows of a row_sparse array (reference
+    `sparse.retain`)."""
+    if not isinstance(rs, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    want = onp.asarray(indices, onp.int32)
+    mask = onp.isin(rs.indices, want)
+    return RowSparseNDArray(rs.data[mask], rs.indices[mask], rs.shape,
+                            rs.dtype)

@@ -1,0 +1,94 @@
+"""Image augmenter + ImageIter tests (reference test_image.py strategy:
+property checks on shapes/ranges rather than pixel-exact values)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as mximg
+
+
+def _img(h=32, w=48):
+    return onp.random.randint(0, 255, (h, w, 3), dtype=onp.uint8)
+
+
+def test_create_augmenter_pipeline():
+    augs = mximg.CreateAugmenter((3, 24, 24), resize=28, rand_crop=True,
+                                 rand_mirror=True, brightness=0.2,
+                                 contrast=0.2, saturation=0.2, hue=0.1,
+                                 pca_noise=0.1, rand_gray=0.2, mean=True,
+                                 std=True)
+    out = _img()
+    for a in augs:
+        out = a(out)
+    arr = out.asnumpy()
+    assert arr.shape == (24, 24, 3)
+    assert arr.dtype == onp.float32
+    # normalized: roughly centered
+    assert abs(arr.mean()) < 3.0
+
+
+def test_individual_augmenters():
+    img = _img(40, 40)
+    assert mximg.ResizeAug(20)(img).shape[0] == 20
+    assert mximg.ForceResizeAug((10, 16))(img).shape[:2] == (16, 10)
+    assert mximg.CenterCropAug((24, 24))(img).shape[:2] == (24, 24)
+    assert mximg.RandomCropAug((24, 24))(img).shape[:2] == (24, 24)
+    assert mximg.RandomSizedCropAug((24, 24))(img).shape[:2] == (24, 24)
+    flipped = mximg.HorizontalFlipAug(1.0)(img).asnumpy()
+    assert onp.array_equal(flipped, img[:, ::-1])
+    gray = mximg.RandomGrayAug(1.0)(img).asnumpy()
+    assert onp.allclose(gray[..., 0], gray[..., 1])
+    jit = mximg.ColorJitterAug(0.3, 0.3, 0.3)(img)
+    assert jit.shape == img.shape
+    hue = mximg.HueJitterAug(0.2)(img)
+    assert hue.shape == img.shape
+    cast = mximg.CastAug()(img)
+    assert cast.dtype == onp.float32
+
+
+def _make_rec(tmp_path, n=10):
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "x.rec")
+    idx = str(tmp_path / "x.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(n):
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), _img()))
+    w.close()
+    return rec
+
+
+def test_imageiter_rec(tmp_path):
+    rec = _make_rec(tmp_path, 10)
+    it = mximg.ImageIter(4, (3, 24, 24), path_imgrec=rec, shuffle=True)
+    batches = list(it)
+    assert len(batches) == 3  # 10 imgs, pad mode wraps the tail
+    assert batches[0].data[0].shape == (4, 3, 24, 24)
+    assert batches[0].label[0].shape == (4,)
+    assert batches[2].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_imageiter_imglist(tmp_path):
+    from PIL import Image
+    root = tmp_path / "imgs"
+    root.mkdir()
+    lst = tmp_path / "train.lst"
+    with open(lst, "w") as f:
+        for i in range(6):
+            Image.fromarray(_img()).save(root / f"{i}.png")
+            f.write(f"{i}\t{float(i % 2)}\t{i}.png\n")
+    it = mximg.ImageIter(3, (3, 16, 16), path_imglist=str(lst),
+                         path_root=str(root))
+    b = next(it)
+    assert b.data[0].shape == (3, 3, 16, 16)
+    labels = sorted(b.label[0].asnumpy().tolist())
+    assert set(labels) <= {0.0, 1.0}
+
+
+def test_imageiter_discard(tmp_path):
+    rec = _make_rec(tmp_path, 10)
+    it = mximg.ImageIter(4, (3, 24, 24), path_imgrec=rec,
+                         last_batch_handle="discard")
+    assert len(list(it)) == 2

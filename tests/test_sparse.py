@@ -1,0 +1,88 @@
+"""Sparse NDArray tests (reference `tests/python/unittest/test_sparse_ndarray.py`
+strategy: round-trip vs dense + dot vs dense matmul oracle)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_csr_dense(m=6, n=8, density=0.3):
+    onp.random.seed(1)
+    dense = onp.random.rand(m, n).astype("float32")
+    dense[onp.random.rand(m, n) > density] = 0
+    return dense
+
+
+def test_csr_roundtrip():
+    dense = _rand_csr_dense()
+    c = sparse.csr_matrix(dense)
+    assert c.stype == "csr"
+    assert c.nnz == int((dense != 0).sum())
+    assert onp.allclose(c.asnumpy(), dense)
+    back = c.tostype("default")
+    assert back.stype == "default"
+    assert onp.allclose(back.asnumpy(), dense)
+    # row access
+    assert onp.allclose(c[2].asnumpy(), dense[2])
+
+
+def test_csr_from_components():
+    c = sparse.csr_matrix((onp.array([1.0, 2.0, 3.0]), [0, 2, 1],
+                           [0, 2, 2, 3]), shape=(3, 4))
+    expect = onp.zeros((3, 4), "float32")
+    expect[0, 0], expect[0, 2], expect[2, 1] = 1, 2, 3
+    assert onp.allclose(c.asnumpy(), expect)
+
+
+def test_row_sparse_roundtrip():
+    dense = onp.zeros((10, 4), "float32")
+    dense[3] = 1.0
+    dense[7] = 2.0
+    rs = sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    assert rs.indices.tolist() == [3, 7]
+    assert onp.allclose(rs.asnumpy(), dense)
+    rs2 = sparse.row_sparse_array(
+        (onp.ones((2, 4), "float32"), [1, 5]), shape=(8, 4))
+    assert rs2.asnumpy()[1].tolist() == [1, 1, 1, 1]
+
+
+def test_ndarray_tostype():
+    dense = mx.np.array(_rand_csr_dense())
+    c = dense.tostype("csr")
+    assert c.stype == "csr"
+    assert onp.allclose(c.asnumpy(), dense.asnumpy())
+    assert dense.tostype("default") is dense
+
+
+def test_sparse_dot_matches_dense():
+    dense = _rand_csr_dense(5, 7)
+    rhs = onp.random.rand(7, 3).astype("float32")
+    c = sparse.csr_matrix(dense)
+    out = sparse.dot(c, mx.np.array(rhs))
+    assert onp.allclose(out.asnumpy(), dense @ rhs, atol=1e-5)
+    out_t = sparse.dot(c, mx.np.array(onp.random.rand(5, 2).astype("float32")),
+                       transpose_a=True)
+    assert out_t.shape == (7, 2)
+
+
+def test_shape_inference_from_components():
+    c = sparse.csr_matrix((onp.array([1.0, 2.0]), [0, 4], [0, 1, 2]))
+    assert c.shape == (2, 5)
+    rs = sparse.row_sparse_array((onp.ones((2, 3), "float32"), [2, 6]))
+    assert rs.shape == (7, 3)
+
+
+def test_retain_and_zeros():
+    rs = sparse.row_sparse_array(
+        (onp.arange(8, dtype="float32").reshape(4, 2), [1, 3, 5, 7]),
+        shape=(10, 2))
+    kept = sparse.retain(rs, [3, 7])
+    assert kept.indices.tolist() == [3, 7]
+    assert onp.allclose(kept.asnumpy()[3], [2, 3])
+
+    z = sparse.zeros("csr", (4, 5))
+    assert z.nnz == 0 and z.asnumpy().sum() == 0
+    zr = sparse.zeros("row_sparse", (4, 5))
+    assert zr.asnumpy().shape == (4, 5)
