@@ -56,6 +56,8 @@ from . import parallel
 from . import amp
 from . import profiler
 from .runtime import Features, feature_list
+from . import callback
+from . import model
 from . import rtc
 from . import visualization
 from . import visualization as viz

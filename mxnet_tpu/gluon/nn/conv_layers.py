@@ -7,9 +7,12 @@ reference's NCHW family; pass layout='NHWC' for the TPU-preferred layout
 """
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import numpy as onp
 
 from ... import numpy_extension as npx
+from ...ops.invoke import invoke
 from ..block import HybridBlock
 from ..parameter import Parameter
 from .basic_layers import Activation, _resolve_init
@@ -19,7 +22,8 @@ __all__ = [
     "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D",
     "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D", "GlobalMaxPool2D",
     "GlobalMaxPool3D", "GlobalAvgPool1D", "GlobalAvgPool2D",
-    "GlobalAvgPool3D", "ReflectionPad2D",
+    "GlobalAvgPool3D", "ReflectionPad2D", "PixelShuffle1D", "PixelShuffle2D",
+    "PixelShuffle3D", "DeformableConvolution",
 ]
 
 
@@ -277,3 +281,182 @@ class ReflectionPad2D(HybridBlock):
         else:
             pads = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3]))
         return mxnp.pad(x, pads, mode="reflect")
+
+
+class PixelShuffle1D(HybridBlock):
+    """Upsample by rearranging channels into length (reference
+    `gluon/nn/conv_layers.py` PixelShuffle1D): (N, C*f, W) -> (N, C, W*f)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        self._factor = int(factor)
+
+    def forward(self, x):
+        f = self._factor
+
+        def fn(a):
+            n, cf, w = a.shape
+            c = cf // f
+            return a.reshape(n, c, f, w).transpose(0, 1, 3, 2) \
+                .reshape(n, c, w * f)
+        return invoke(fn, (x,), name="pixel_shuffle1d")
+
+    def __repr__(self):
+        return f"PixelShuffle1D(factor={self._factor})"
+
+
+class PixelShuffle2D(HybridBlock):
+    """(N, C*fh*fw, H, W) -> (N, C, H*fh, W*fw)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        if isinstance(factor, (tuple, list)):
+            self._fh, self._fw = (int(f) for f in factor)
+        else:
+            self._fh = self._fw = int(factor)
+
+    def forward(self, x):
+        fh, fw = self._fh, self._fw
+
+        def fn(a):
+            n, cff, h, w = a.shape
+            c = cff // (fh * fw)
+            a = a.reshape(n, c, fh, fw, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)       # n c h fh w fw
+            return a.reshape(n, c, h * fh, w * fw)
+        return invoke(fn, (x,), name="pixel_shuffle2d")
+
+    def __repr__(self):
+        return f"PixelShuffle2D(factor=({self._fh}, {self._fw}))"
+
+
+class PixelShuffle3D(HybridBlock):
+    """(N, C*fd*fh*fw, D, H, W) -> (N, C, D*fd, H*fh, W*fw)."""
+
+    def __init__(self, factor):
+        super().__init__()
+        if isinstance(factor, (tuple, list)):
+            self._fd, self._fh, self._fw = (int(f) for f in factor)
+        else:
+            self._fd = self._fh = self._fw = int(factor)
+
+    def forward(self, x):
+        fd, fh, fw = self._fd, self._fh, self._fw
+
+        def fn(a):
+            n, cf, d, h, w = a.shape
+            c = cf // (fd * fh * fw)
+            a = a.reshape(n, c, fd, fh, fw, d, h, w)
+            a = a.transpose(0, 1, 5, 2, 6, 3, 7, 4)  # n c d fd h fh w fw
+            return a.reshape(n, c, d * fd, h * fh, w * fw)
+        return invoke(fn, (x,), name="pixel_shuffle3d")
+
+    def __repr__(self):
+        return (f"PixelShuffle3D(factor=({self._fd}, {self._fh}, "
+                f"{self._fw}))")
+
+
+class DeformableConvolution(HybridBlock):
+    """Deformable convolution v1 (reference `contrib/nn`
+    DeformableConvolution over `src/operator/contrib/deformable_convolution
+    .cc`): a regular conv branch predicts per-position sampling offsets,
+    and the main conv samples its receptive field at those deformed
+    positions via bilinear interpolation.
+
+    TPU-native formulation: instead of the reference's per-sample CUDA
+    im2col kernel, the deformed im2col is built with vectorized gathers
+    (one (N, C, K*K, H, W) tensor), then contracted with the weight on the
+    MXU — XLA fuses the interpolation arithmetic around the gathers.
+    """
+
+    def __init__(self, channels, kernel_size=(3, 3), strides=(1, 1),
+                 padding=(1, 1), num_deformable_group=1, in_channels=0,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", activation=None):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        if isinstance(strides, int):
+            strides = (strides, strides)
+        if isinstance(padding, int):
+            padding = (padding, padding)
+        if num_deformable_group != 1:
+            raise ValueError("num_deformable_group>1 is not supported")
+        self._channels = channels
+        self._kernel = tuple(kernel_size)
+        self._strides = tuple(strides)
+        self._padding = tuple(padding)
+        kh, kw = self._kernel
+        self.offset = Conv2D(2 * kh * kw, kernel_size=self._kernel,
+                             strides=self._strides, padding=self._padding,
+                             in_channels=in_channels,
+                             weight_initializer=offset_weight_initializer,
+                             bias_initializer=offset_bias_initializer)
+        self.weight = Parameter("weight",
+                                shape=(channels, in_channels, kh, kw),
+                                init=_resolve_init(weight_initializer),
+                                allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(channels,),
+                              init=_resolve_init(bias_initializer),
+                              allow_deferred_init=True) if use_bias else None
+        self.act = Activation(activation) if activation else None
+
+    def forward(self, x):
+        offsets = self.offset(x)
+        if self.weight.shape[1] == 0:
+            self.weight.shape = (self._channels, x.shape[1]) + self._kernel
+            self.weight.finish_deferred_init()
+        if self.bias is not None and self.bias._data is None:
+            self.bias.finish_deferred_init()
+        kh, kw = self._kernel
+        sh, sw = self._strides
+        ph, pw = self._padding
+
+        def fn(a, off, wgt, b):
+            n, c, h, w = a.shape
+            oh, ow = off.shape[2], off.shape[3]
+            # base sampling grid: output position * stride - pad + kernel tap
+            oy = jnp.arange(oh) * sh - ph
+            ox = jnp.arange(ow) * sw - pw
+            ky, kx = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw),
+                                  indexing="ij")
+            # (K, OH, OW) absolute positions + predicted offsets
+            off = off.reshape(n, kh * kw, 2, oh, ow)
+            ys = (oy[None, :, None] + ky.reshape(-1, 1, 1)) + off[:, :, 0]
+            xs = (ox[None, None, :] + kx.reshape(-1, 1, 1)) + off[:, :, 1]
+            y0 = jnp.floor(ys)
+            x0 = jnp.floor(xs)
+            wy = ys - y0
+            wx = xs - x0
+
+            def gather(img, yy, xx):
+                # img (C,H,W); yy/xx (K,OH,OW) int -> (C,K,OH,OW), zeros OOB
+                valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+                yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+                xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+                vals = img[:, yc, xc]
+                return jnp.where(valid[None], vals, 0.0)
+
+            def sample_one(img, y0_, x0_, wy_, wx_):
+                v00 = gather(img, y0_, x0_)
+                v01 = gather(img, y0_, x0_ + 1)
+                v10 = gather(img, y0_ + 1, x0_)
+                v11 = gather(img, y0_ + 1, x0_ + 1)
+                top = v00 * (1 - wx_) + v01 * wx_
+                bot = v10 * (1 - wx_) + v11 * wx_
+                return top * (1 - wy_) + bot * wy_   # (C, K, OH, OW)
+
+            cols = jax.vmap(sample_one)(a, y0.astype(jnp.int32),
+                                        x0.astype(jnp.int32), wy, wx)
+            out = jnp.einsum("nckhw,ock->nohw", cols,
+                             wgt.reshape(wgt.shape[0], c, kh * kw))
+            if b is not None:
+                out = out + b[None, :, None, None]
+            return out
+
+        args = (x, offsets, self.weight.data(),
+                None if self.bias is None else self.bias.data())
+        out = invoke(fn, args, name="deformable_convolution")
+        return self.act(out) if self.act is not None else out

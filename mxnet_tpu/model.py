@@ -1,0 +1,58 @@
+"""Legacy model checkpoint helpers.
+
+Reference: `python/mxnet/model.py` `save_checkpoint`/`load_checkpoint` —
+the `prefix-symbol.json` + `prefix-NNNN.params` format used by
+`do_checkpoint` and classic deployment tools.  Parameters are stored in
+the NDArray-list container (`utils/serialization.py`, magic 0x112
+analogue) with the reference's `arg:`/`aux:` name prefixes.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .utils.serialization import save_ndarrays, load_ndarrays
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params"]
+
+
+def save_checkpoint(prefix, epoch, symbol=None, arg_params=None,
+                    aux_params=None, remove_amp_cast=True):
+    """Save `{prefix}-symbol.json` (if a symbol/graph repr is given) and
+    `{prefix}-{epoch:04d}.params` (reference `model.py save_checkpoint`)."""
+    if symbol is not None:
+        payload = symbol if isinstance(symbol, str) else json.dumps(
+            symbol, default=str)
+        with open(f"{prefix}-symbol.json", "w") as f:
+            f.write(payload)
+    save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+    save_dict.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    save_ndarrays(param_name, save_dict)
+    return param_name
+
+
+def load_params(prefix, epoch):
+    """Load `{prefix}-{epoch:04d}.params` into (arg_params, aux_params)."""
+    loaded = load_ndarrays("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """Returns (symbol_json_or_None, arg_params, aux_params) (reference
+    `model.py load_checkpoint`)."""
+    sym_file = f"{prefix}-symbol.json"
+    symbol = None
+    if os.path.exists(sym_file):
+        with open(sym_file) as f:
+            symbol = f.read()
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

@@ -49,11 +49,13 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     pad = tuple((p, p) for p in pad)
     dn = lax.conv_dimension_numbers(data.shape, weight.shape,
                                     _conv_dimension_numbers(layout))
+    # no preferred_element_type here: the conv transpose (weight gradient)
+    # rejects the resulting mixed f32-cotangent/bf16-operand conv, and the
+    # MXU accumulates bf16 convolutions in f32 natively anyway
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilate, dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=_acc_type(data.dtype),
     ).astype(data.dtype)
     if bias is not None:
         c_axis = layout.index("C")
@@ -97,7 +99,6 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None,
         data, w, window_strides=(1,) * nsp, padding=pads,
         lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=_acc_type(data.dtype),
     ).astype(data.dtype)
     if bias is not None:
         c_axis = layout.index("C")
