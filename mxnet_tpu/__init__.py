@@ -58,6 +58,7 @@ from . import profiler
 from .runtime import Features, feature_list
 from . import callback
 from . import model
+from . import monitor
 from . import rtc
 from . import visualization
 from . import visualization as viz

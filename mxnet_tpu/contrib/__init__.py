@@ -1,13 +1,14 @@
 """Contrib namespace (reference: `python/mxnet/contrib/` and the
 `_contrib_*` op family in `src/operator/contrib/`)."""
 from ..ops.contrib import (box_iou, box_nms, bipartite_matching, roi_align,
-                           multibox_detection, boolean_mask, allclose,
-                           index_copy, index_array)
+                           multibox_prior, multibox_detection, boolean_mask,
+                           allclose, index_copy, index_array)
 
 # reference CamelCase aliases (mx.nd.contrib.ROIAlign)
 ROIAlign = roi_align
 MultiBoxDetection = multibox_detection
+MultiBoxPrior = multibox_prior
 
 __all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
-           "ROIAlign", "multibox_detection", "MultiBoxDetection",
+           "ROIAlign", "multibox_prior", "MultiBoxPrior", "multibox_detection", "MultiBoxDetection",
            "boolean_mask", "allclose", "index_copy", "index_array"]

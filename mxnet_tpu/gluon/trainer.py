@@ -220,11 +220,12 @@ class Trainer:
         if fn is None:
             def fused(ws, gs, states, lrs, wds, ts, rescale, clip):
                 new_ws, new_states = [], []
-                for w, g, st, lr, wd, t in zip(ws, gs, states, lrs, wds, ts):
+                for k, (w, g, st) in enumerate(zip(ws, gs, states)):
                     g = g * rescale
                     if clip is not None:
                         g = jnp.clip(g, -clip, clip)
-                    nw, nst = optimizer.update_math(w, g, st, lr, wd, t)
+                    nw, nst = optimizer.update_math(w, g, st, lrs[k], wds[k],
+                                                    ts[k])
                     new_ws.append(nw)
                     new_states.append(nst)
                 return new_ws, new_states
@@ -239,9 +240,16 @@ class Trainer:
         lrs, wds, ts = [], [], []
         for i in idxs:
             optimizer._update_count(i)
-            lrs.append(jnp.float32(optimizer._get_lr(i)))
-            wds.append(jnp.float32(optimizer._get_wd(i)))
-            ts.append(jnp.float32(optimizer._index_update_count[i]))
+            lrs.append(optimizer._get_lr(i))
+            wds.append(optimizer._get_wd(i))
+            ts.append(optimizer._index_update_count[i])
+        # ship per-param scalars as three packed arrays: one host->device
+        # transfer each, not 3*n_params tiny ones (they cross an RPC link
+        # when the chip is remote)
+        import numpy as onp
+        lrs = jnp.asarray(onp.asarray(lrs, onp.float32))
+        wds = jnp.asarray(onp.asarray(wds, onp.float32))
+        ts = jnp.asarray(onp.asarray(ts, onp.float32))
         new_ws, new_states = fn(ws, gs, states, lrs, wds, ts,
                                 jnp.float32(optimizer.rescale_grad),
                                 optimizer.clip_gradient)

@@ -1,0 +1,90 @@
+"""Training monitor for debugging intermediate values.
+
+Reference: `python/mxnet/monitor.py` — `Monitor` installs output hooks and
+prints per-tensor statistics every N batches.  Here it hooks Gluon blocks
+(`register_forward_hook`) instead of executor callbacks; the default
+statistic is the same |x|/size norm the reference uses.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as onp
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def stat_func(x):  # reference default: mean |x|
+                return onp.abs(x).sum() / x.size
+        self.stat_func = stat_func
+        self.interval = interval
+        self.sort = sort
+        self.pattern = re.compile(pattern)
+        self.queue = []
+        self.step = 0
+        self.activated = False
+        self._handles = []
+
+    def install(self, block, root_name=""):
+        """Hook every sub-block's outputs (reference `install_monitor` on
+        executors).
+
+        With a hybridized block, inner sub-blocks execute only during the
+        one-time jit trace (where values are abstract and unobservable), so
+        only the top-level output is monitored — hybridize() trades inner
+        visibility for speed, exactly like the reference's fused graphs.
+        """
+        import jax
+
+        def hook(blk, inputs, output, _name):
+            if not self.activated:
+                return
+            outs = output if isinstance(output, (list, tuple)) else [output]
+            for i, o in enumerate(outs):
+                key = f"{_name}_output{i}" if len(outs) > 1 \
+                    else f"{_name}_output"
+                if not self.pattern.match(key) or not hasattr(o, "asnumpy"):
+                    continue
+                if isinstance(getattr(o, "_data", None), jax.core.Tracer):
+                    continue  # inside a jit trace: value is abstract
+                self.queue.append(
+                    (self.step, key, self.stat_func(o.asnumpy())))
+
+        def walk(b, name):
+            self._handles.append(b.register_forward_hook(
+                lambda blk, ins, out, _n=name: hook(blk, ins, out, _n)))
+            for cname, child in b._children.items():
+                walk(child, f"{name}.{cname}" if name else cname)
+        walk(block, root_name or type(block).__name__)
+        return self
+
+    def tic(self):
+        """Start collecting for this batch if the interval hits."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting; returns [(step, name, stat)] (reference toc)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = list(self.queue)
+        self.queue = []
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, stat)
+
+    def uninstall(self):
+        for h in self._handles:
+            h.detach()
+        self._handles = []

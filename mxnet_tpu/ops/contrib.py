@@ -22,8 +22,8 @@ from jax import lax
 from .invoke import invoke
 
 __all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
-           "multibox_detection", "boolean_mask", "allclose", "index_copy",
-           "index_array"]
+           "multibox_prior", "multibox_detection", "boolean_mask",
+           "allclose", "index_copy", "index_array"]
 
 
 def _corner(boxes, fmt):
@@ -232,6 +232,45 @@ def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
 
         return jax.vmap(one_roi)(batch_idx, ys, xs)
     return invoke(f, (data, rois), name="roi_align")
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """SSD anchor-box generation (reference `_contrib_MultiBoxPrior`,
+    `src/operator/contrib/multibox_prior.cc`): for a (B, C, H, W) feature
+    map, emit (1, H*W*(len(sizes)+len(ratios)-1), 4) corner-format anchors
+    in normalized coordinates.  Pure index arithmetic — XLA folds it into
+    constants for static shapes."""
+    sizes = tuple(float(s) for s in sizes)
+    ratios = tuple(float(r) for r in ratios)
+
+    def f(d):
+        h, w = d.shape[2], d.shape[3]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / h
+        step_x = steps[1] if steps[1] > 0 else 1.0 / w
+        cy = (jnp.arange(h) + offsets[0]) * step_y
+        cx = (jnp.arange(w) + offsets[1]) * step_x
+        # anchor shapes: (s_i, r_0) for all sizes + (s_0, r_j) for j>0
+        ws, hs = [], []
+        for s in sizes:
+            ws.append(s * jnp.sqrt(ratios[0]))
+            hs.append(s / jnp.sqrt(ratios[0]))
+        for r in ratios[1:]:
+            ws.append(sizes[0] * jnp.sqrt(r))
+            hs.append(sizes[0] / jnp.sqrt(r))
+        aw = jnp.asarray(ws)
+        ah = jnp.asarray(hs)
+        k = aw.shape[0]
+        cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")     # (H, W)
+        cyg = cyg[..., None]
+        cxg = cxg[..., None]
+        boxes = jnp.stack([cxg - aw / 2, cyg - ah / 2,
+                           cxg + aw / 2, cyg + ah / 2], axis=-1)
+        boxes = boxes.reshape(h * w * k, 4)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        return boxes[None]
+    return invoke(f, (data,), name="multibox_prior", differentiable=False)
 
 
 def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,

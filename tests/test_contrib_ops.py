@@ -178,6 +178,26 @@ def test_multibox_detection():
     assert onp.allclose(live[1, 2:], anchors[0, 2], atol=1e-5)
 
 
+def test_multibox_prior():
+    feat = mx.np.zeros((1, 8, 4, 6))
+    anchors = contrib.multibox_prior(feat, sizes=(0.5, 0.25),
+                                     ratios=(1, 2)).asnumpy()
+    # k = len(sizes) + len(ratios) - 1 = 3 anchors per position
+    assert anchors.shape == (1, 4 * 6 * 3, 4)
+    # first anchor at cell (0,0): centered at (0.5/6, 0.5/4), square 0.5
+    a0 = anchors[0, 0]
+    assert a0[0] == pytest.approx(0.5 / 6 - 0.25, abs=1e-5)
+    assert a0[1] == pytest.approx(0.5 / 4 - 0.25, abs=1e-5)
+    # widths/heights: sizes then extra ratios
+    w = anchors[0, :3, 2] - anchors[0, :3, 0]
+    h = anchors[0, :3, 3] - anchors[0, :3, 1]
+    assert onp.allclose(w, [0.5, 0.25, 0.5 * onp.sqrt(2)], atol=1e-5)
+    assert onp.allclose(h, [0.5, 0.25, 0.5 / onp.sqrt(2)], atol=1e-5)
+    clipped = contrib.multibox_prior(feat, sizes=(0.9,), clip=True).asnumpy()
+    assert clipped.min() >= 0 and clipped.max() <= 1
+
+
+
 def test_new_random_and_np_fns():
     s = mx.np.random.t(5.0, size=(500,))
     assert s.shape == (500,)

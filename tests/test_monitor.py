@@ -1,0 +1,52 @@
+"""mx.monitor tests (reference `python/mxnet/monitor.py` Monitor)."""
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.monitor import Monitor
+
+
+def _net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    return net
+
+
+def test_monitor_collects_stats():
+    net = _net()
+    mon = Monitor(interval=2).install(net)
+    stats = []
+    for step in range(4):
+        mon.tic()
+        net(mx.np.ones((1, 3)))
+        stats.append(mon.toc())
+    assert len(stats[0]) > 0 and len(stats[2]) > 0  # interval hits
+    assert stats[1] == [] and stats[3] == []
+    names = [n for _s, n, _v in stats[0]]
+    # natural names, no stray separators (sub-blocks as <root>.<child>_output)
+    assert any(n.endswith("0_output") for n in names), names
+    mon.uninstall()
+    mon.tic()
+    net(mx.np.ones((1, 3)))
+    assert mon.toc() == []
+
+
+def test_monitor_pattern_filter():
+    net = _net()
+    mon = Monitor(interval=1, pattern=r".*\.1_output$").install(net)
+    mon.tic()
+    net(mx.np.ones((1, 3)))
+    names = [n for _s, n, _v in mon.toc()]
+    assert names and all(n.endswith(".1_output") for n in names)
+
+
+def test_monitor_survives_hybridize():
+    """Under hybridize, inner values are abstract during the trace: the
+    monitor must not crash, and still reports the top-level output."""
+    net = _net()
+    net.hybridize()
+    mon = Monitor(interval=1).install(net)
+    for _ in range(2):  # trace call + cached call
+        mon.tic()
+        net(mx.np.ones((1, 3)))
+        stats = mon.toc()
+    assert any("HybridSequential_output" in n for _s, n, _v in stats)
