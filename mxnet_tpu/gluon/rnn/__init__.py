@@ -4,7 +4,9 @@ from .rnn_cell import (
     RecurrentCell, RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
     DropoutCell, ZoneoutCell, ResidualCell, BidirectionalCell,
 )
+from .conv_rnn_cell import ConvRNNCell, ConvLSTMCell, ConvGRUCell
 
 __all__ = ["RNN", "LSTM", "GRU", "RecurrentCell", "RNNCell", "LSTMCell",
            "GRUCell", "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
-           "ResidualCell", "BidirectionalCell"]
+           "ResidualCell", "BidirectionalCell", "ConvRNNCell",
+           "ConvLSTMCell", "ConvGRUCell"]

@@ -1,0 +1,142 @@
+"""Convolutional RNN cells.
+
+Reference: `python/mxnet/gluon/rnn/conv_rnn_cell.py` — `ConvRNNCell`,
+`ConvLSTMCell`, `ConvGRUCell`: recurrent cells whose input-to-hidden and
+hidden-to-hidden projections are convolutions over spatial state maps
+(Shi et al., "Convolutional LSTM").  The convolutions ride the same XLA
+conv lowering as gluon.nn layers; when stepped under `lax.scan`
+(`RecurrentCell.unroll` or `npx.foreach`) the whole sequence fuses into
+one compiled loop.
+"""
+from __future__ import annotations
+
+from ... import numpy as mxnp
+from ... import numpy_extension as npx
+from ..parameter import Parameter
+from .rnn_cell import RecurrentCell
+from ..nn.basic_layers import _resolve_init
+from ..nn.conv_layers import _pair
+
+__all__ = ["ConvRNNCell", "ConvLSTMCell", "ConvGRUCell"]
+
+
+class _BaseConvRNNCell(RecurrentCell):
+    def __init__(self, input_shape, hidden_channels, num_gates,
+                 i2h_kernel, h2h_kernel, i2h_pad=(0, 0), activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 conv_layout="NCHW"):
+        super().__init__()
+        assert conv_layout == "NCHW", "only NCHW is supported"
+        self._input_shape = tuple(input_shape)  # (C, H, W)
+        self._hc = hidden_channels
+        self._ng = num_gates
+        self._i2h_kernel = _pair(i2h_kernel, 2)
+        self._h2h_kernel = _pair(h2h_kernel, 2)
+        assert all(k % 2 == 1 for k in self._h2h_kernel), \
+            "h2h_kernel must be odd to preserve the state shape"
+        self._i2h_pad = _pair(i2h_pad, 2)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        self._activation = activation
+
+        in_c = self._input_shape[0]
+        ng = num_gates
+        self.i2h_weight = Parameter(
+            "i2h_weight", shape=(ng * hidden_channels, in_c) +
+            self._i2h_kernel,
+            init=_resolve_init(i2h_weight_initializer))
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(ng * hidden_channels, hidden_channels) +
+            self._h2h_kernel,
+            init=_resolve_init(h2h_weight_initializer))
+        self.i2h_bias = Parameter(
+            "i2h_bias", shape=(ng * hidden_channels,),
+            init=_resolve_init(i2h_bias_initializer))
+        self.h2h_bias = Parameter(
+            "h2h_bias", shape=(ng * hidden_channels,),
+            init=_resolve_init(h2h_bias_initializer))
+
+    def _state_shape(self):
+        _c, h, w = self._input_shape
+        kh, kw = self._i2h_kernel
+        ph, pw = self._i2h_pad
+        oh = h + 2 * ph - kh + 1
+        ow = w + 2 * pw - kw + 1
+        return (self._hc, oh, ow)
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size,) + self._state_shape()
+        return [{"shape": shape, "__layout__": "NCHW"}
+                for _ in range(len(self._state_names))]
+
+    def _proj(self, x, states):
+        i2h = npx.convolution(x, self.i2h_weight.data(),
+                              self.i2h_bias.data(),
+                              kernel=self._i2h_kernel, pad=self._i2h_pad,
+                              num_filter=self._ng * self._hc)
+        h2h = npx.convolution(states[0], self.h2h_weight.data(),
+                              self.h2h_bias.data(),
+                              kernel=self._h2h_kernel, pad=self._h2h_pad,
+                              num_filter=self._ng * self._hc)
+        return i2h, h2h
+
+    def _act(self, x):
+        if self._activation in ("relu", "tanh", "sigmoid", "softrelu"):
+            return npx.activation(x, act_type=self._activation)
+        return getattr(npx, self._activation)(x)
+
+
+class ConvRNNCell(_BaseConvRNNCell):
+    _state_names = ["h"]
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), i2h_pad=(1, 1), activation="tanh",
+                 **kwargs):
+        super().__init__(input_shape, hidden_channels, 1, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, **kwargs)
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._proj(inputs, states)
+        out = self._act(i2h + h2h)
+        return out, [out]
+
+
+class ConvLSTMCell(_BaseConvRNNCell):
+    _state_names = ["h", "c"]
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), i2h_pad=(1, 1), activation="tanh",
+                 **kwargs):
+        super().__init__(input_shape, hidden_channels, 4, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, **kwargs)
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._proj(inputs, states)
+        gates = i2h + h2h
+        hc = self._hc
+        i = npx.sigmoid(gates[:, :hc])
+        f = npx.sigmoid(gates[:, hc:2 * hc])
+        c_in = self._act(gates[:, 2 * hc:3 * hc])
+        o = npx.sigmoid(gates[:, 3 * hc:])
+        next_c = f * states[1] + i * c_in
+        next_h = o * self._act(next_c)
+        return next_h, [next_h, next_c]
+
+
+class ConvGRUCell(_BaseConvRNNCell):
+    _state_names = ["h"]
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), i2h_pad=(1, 1), activation="tanh",
+                 **kwargs):
+        super().__init__(input_shape, hidden_channels, 3, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, **kwargs)
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._proj(inputs, states)
+        hc = self._hc
+        r = npx.sigmoid(i2h[:, :hc] + h2h[:, :hc])
+        z = npx.sigmoid(i2h[:, hc:2 * hc] + h2h[:, hc:2 * hc])
+        n = self._act(i2h[:, 2 * hc:] + r * h2h[:, 2 * hc:])
+        next_h = (1 - z) * n + z * states[0]
+        return next_h, [next_h]

@@ -43,17 +43,28 @@ class RecurrentCell(HybridBlock):
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         axis = layout.find("T")
-        batch_axis = layout.find("N")
-        batch_size = inputs.shape[batch_axis]
+        if isinstance(inputs, (list, tuple)):
+            # list of per-step (N, ...) tensors (reference _format_sequence)
+            assert len(inputs) == length, \
+                f"unroll length {length} != len(inputs) {len(inputs)}"
+            steps = list(inputs)
+            batch_size = steps[0].shape[0]
+            ctx = steps[0].ctx
+        else:
+            batch_axis = layout.find("N")
+            batch_size = inputs.shape[batch_axis]
+            ctx = inputs.ctx
+            steps = [
+                mxnp.squeeze(
+                    mxnp.take(inputs, mxnp.array([i], dtype="int32"),
+                              axis=axis), axis=axis)
+                for i in range(length)
+            ]
         if begin_state is None:
-            begin_state = self.begin_state(batch_size=batch_size,
-                                           ctx=inputs.ctx)
+            begin_state = self.begin_state(batch_size=batch_size, ctx=ctx)
         states = begin_state
         outputs = []
-        for i in range(length):
-            step_input = mxnp.squeeze(
-                mxnp.take(inputs, mxnp.array([i], dtype="int32"), axis=axis),
-                axis=axis)
+        for step_input in steps:
             out, states = self(step_input, states)
             outputs.append(out)
         if valid_length is not None:
@@ -61,7 +72,11 @@ class RecurrentCell(HybridBlock):
             stacked = npx.sequence_mask(stacked, valid_length,
                                         use_sequence_length=True, axis=0)
             outputs = [stacked[i] for i in range(length)]
-        if merge_outputs is None or merge_outputs:
+        # merge_outputs=None follows the input format (reference
+        # _format_sequence: list in -> list out, tensor in -> tensor out)
+        merge = merge_outputs if merge_outputs is not None else \
+            not isinstance(inputs, (list, tuple))
+        if merge:
             merged = mxnp.stack(outputs, axis=axis)
             return merged, states
         return outputs, states
@@ -295,6 +310,13 @@ class BidirectionalCell(RecurrentCell):
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
+        in_was_list = isinstance(inputs, (list, tuple))
+        if in_was_list:
+            # normalize to a tensor (reference _format_sequence)
+            axis0 = layout.find("T")
+            inputs = mxnp.stack(list(inputs), axis=axis0)
+            if merge_outputs is None:
+                merge_outputs = False
         axis = layout.find("T")
         batch_size = inputs.shape[layout.find("N")]
         if begin_state is None:
@@ -317,4 +339,7 @@ class BidirectionalCell(RecurrentCell):
         if axis != 0:
             r_out_seq = r_out_seq.swapaxes(0, axis)
         out = mxnp.concatenate([l_out, r_out_seq], axis=-1)
+        if merge_outputs is False:
+            out = [mxnp.squeeze(s, axis=axis)
+                   for s in mxnp.split(out, length, axis=axis)]
         return out, l_states + r_states

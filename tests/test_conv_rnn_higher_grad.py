@@ -1,0 +1,109 @@
+"""Conv RNN cells + higher-order gradient tests.
+
+Reference: `tests/python/unittest/test_gluon_rnn.py` (conv cells) and
+`test_higher_order_grad.py` (grad-of-grad vs analytic derivatives).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import rnn
+
+
+def test_conv_rnn_cells_shapes():
+    x = mx.np.array(onp.random.rand(2, 3, 8, 8).astype("float32"))
+    for cls, n_states in [(rnn.ConvRNNCell, 1), (rnn.ConvLSTMCell, 2),
+                          (rnn.ConvGRUCell, 1)]:
+        cell = cls((3, 8, 8), hidden_channels=4)
+        cell.initialize()
+        states = cell.begin_state(batch_size=2)
+        out, new_states = cell(x, states)
+        assert out.shape == (2, 4, 8, 8), cls.__name__
+        assert len(new_states) == n_states
+
+
+def test_conv_lstm_unroll_and_train():
+    seq = [mx.np.array(onp.random.rand(2, 3, 6, 6).astype("float32"))
+           for _ in range(4)]
+    cell = rnn.ConvLSTMCell((3, 6, 6), hidden_channels=2)
+    cell.initialize()
+    from mxnet_tpu import gluon
+    tr = gluon.Trainer(cell.collect_params(), "adam")
+    with autograd.record():
+        outputs, states = cell.unroll(4, seq, merge_outputs=False,
+                                      layout="TNC")
+        loss = sum(o.sum() for o in outputs) * 0.01
+    loss.backward()
+    tr.step(2)
+    assert outputs[0].shape == (2, 2, 6, 6)
+    g = cell.i2h_weight.grad()
+    assert float(abs(g).asnumpy().max()) > 0
+
+
+def test_conv_rnn_state_shape_with_valid_conv():
+    # i2h 3x3 without padding shrinks the spatial state map
+    cell = rnn.ConvRNNCell((3, 8, 8), hidden_channels=4, i2h_kernel=(3, 3),
+                           i2h_pad=(0, 0))
+    info = cell.state_info(batch_size=2)
+    assert info[0]["shape"] == (2, 4, 6, 6)
+
+
+def test_unroll_list_in_list_out():
+    """merge_outputs=None follows the input format (reference
+    _format_sequence): list in -> list out, tensor in -> tensor out."""
+    cell = rnn.LSTMCell(5, input_size=3)
+    cell.initialize()
+    seq = [mx.np.ones((2, 3)) for _ in range(4)]
+    outs, _ = cell.unroll(4, seq)
+    assert isinstance(outs, list) and len(outs) == 4
+    assert outs[0].shape == (2, 5)
+    tens, _ = cell.unroll(4, mx.np.ones((2, 4, 3)))  # NTC tensor
+    assert tens.shape == (2, 4, 5)
+
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(5, input_size=3),
+                               rnn.LSTMCell(5, input_size=3))
+    bi.initialize()
+    bouts, _ = bi.unroll(4, seq)
+    assert isinstance(bouts, list) and len(bouts) == 4
+    assert bouts[0].shape == (2, 10)  # l/r concatenated
+
+
+def _second_derivative(fn, x0):
+    """d2/dx2 via two nested autograd passes (reference
+    test_higher_order_grad.py pattern)."""
+    x = mx.np.array(x0)
+    x.attach_grad()
+    with autograd.record():
+        y = fn(x)
+        (dy,) = autograd.grad(y, [x], create_graph=True)
+        z = dy.sum()
+    z.backward()
+    return x.grad.asnumpy()
+
+
+def test_higher_order_grad_analytic():
+    x0 = onp.array([0.3, -0.7, 1.2], "float32")
+    # d2/dx2 sin(x) = -sin(x)
+    assert onp.allclose(_second_derivative(lambda x: mx.np.sin(x).sum(), x0),
+                        -onp.sin(x0), atol=1e-5)
+    # d2/dx2 x^3 = 6x
+    assert onp.allclose(
+        _second_derivative(lambda x: (x ** 3).sum(), x0), 6 * x0, atol=1e-4)
+    # d2/dx2 exp(x) = exp(x)
+    assert onp.allclose(
+        _second_derivative(lambda x: mx.np.exp(x).sum(), x0),
+        onp.exp(x0), atol=1e-4)
+
+
+def test_third_order_grad():
+    x = mx.np.array([0.5, 1.5])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        (d1,) = autograd.grad(y, [x], create_graph=True)
+        (d2,) = autograd.grad(d1.sum(), [x], create_graph=True)
+        z = d2.sum()
+    z.backward()
+    # d3/dx3 x^4 = 24x
+    assert onp.allclose(x.grad.asnumpy(), 24 * x.asnumpy(), atol=1e-3)
