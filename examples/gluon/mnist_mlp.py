@@ -1,0 +1,71 @@
+"""Classic Gluon training loop (reference `example/gluon/mnist.py` shape,
+BASELINE config 1): MLP on MNIST-like data with hybridize + Trainer.
+
+Uses the real MNIST via `gluon.data.vision.MNIST` when its files are
+present locally; otherwise falls back to a synthetic stand-in so the
+script runs anywhere (no network egress in this environment).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def get_data(batch_size):
+    try:
+        train = gluon.data.vision.MNIST(train=True).transform_first(
+            gluon.data.vision.transforms.ToTensor())
+        return gluon.data.DataLoader(train, batch_size, shuffle=True)
+    except Exception:
+        print("MNIST files not found; using synthetic data")
+        X = onp.random.rand(2048, 1, 28, 28).astype("float32")
+        y = onp.random.randint(0, 10, 2048)
+        ds = gluon.data.ArrayDataset(X, y.astype("float32"))
+        return gluon.data.DataLoader(ds, batch_size, shuffle=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args()
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = gluon.metric.Accuracy()
+
+    data = get_data(args.batch_size)
+    for epoch in range(args.epochs):
+        metric.reset()
+        for x, y in data:
+            x = x.reshape(x.shape[0], -1)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update(y, out)
+        name, acc = metric.get()
+        print(f"epoch {epoch}: {name}={acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Word-level LSTM language model (reference `example/rnn/word_lm`,
+BASELINE config 5): bucketed corpus -> RNNModel -> perplexity.
+
+Reads a plain-text corpus with --data; otherwise trains on a synthetic
+token stream so the script runs anywhere.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import math
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.contrib import text
+from mxnet_tpu.io import BucketSentenceIter
+from mxnet_tpu.models import RNNModel
+
+
+def load_corpus(path, vocab_size):
+    if path:
+        with open(path) as f:
+            raw = f.read()
+        counter = text.utils.count_tokens_from_str(raw, to_lower=True)
+        vocab = text.Vocabulary(counter, most_freq_count=vocab_size - 1)
+        sentences = [
+            [vocab.to_indices(t) for t in line.lower().split()]
+            for line in raw.splitlines() if line.strip()
+        ]
+        return sentences, len(vocab)
+    onp.random.seed(0)
+    sentences = [list(onp.random.randint(1, vocab_size,
+                                         onp.random.randint(5, 30)))
+                 for _ in range(500)]
+    return sentences, vocab_size
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data", default=None, help="plain-text corpus")
+    p.add_argument("--vocab-size", type=int, default=200)
+    p.add_argument("--num-hidden", type=int, default=128)
+    p.add_argument("--num-embed", type=int, default=128)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=1.0)
+    p.add_argument("--tied", action="store_true")
+    args = p.parse_args()
+
+    sentences, vocab_size = load_corpus(args.data, args.vocab_size)
+    it = BucketSentenceIter(sentences, args.batch_size,
+                            buckets=[10, 20, 30], layout="TN")
+
+    model = RNNModel(vocab_size, num_embed=args.num_embed,
+                     num_hidden=args.num_hidden, num_layers=args.num_layers,
+                     tie_weights=args.tied, dropout=0.2)
+    model.initialize(init=mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        it.reset()
+        total, count = 0.0, 0
+        for batch in it:
+            with autograd.record():
+                logits = model(batch.data[0])
+                loss = loss_fn(logits, batch.label[0]).mean()
+            loss.backward()
+            gluon.utils.clip_global_norm(
+                [p.grad() for p in model.collect_params().values()
+                 if p.grad_req != "null"], 0.25)
+            trainer.step(args.batch_size)
+            total += float(loss.asnumpy())
+            count += 1
+        ppl = math.exp(total / max(count, 1))
+        print(f"epoch {epoch}: perplexity {ppl:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -3,6 +3,7 @@
 from ..ops.contrib import (box_iou, box_nms, bipartite_matching, roi_align,
                            multibox_prior, multibox_detection, boolean_mask,
                            allclose, index_copy, index_array)
+from . import text
 
 # reference CamelCase aliases (mx.nd.contrib.ROIAlign)
 ROIAlign = roi_align

@@ -1,0 +1,9 @@
+"""Text utilities (reference: `python/mxnet/contrib/text/`)."""
+from . import utils
+from .vocab import Vocabulary
+from .embedding import (TokenEmbedding, CustomEmbedding, CompositeEmbedding,
+                        register, create, get_pretrained_file_names)
+
+__all__ = ["utils", "Vocabulary", "TokenEmbedding", "CustomEmbedding",
+           "CompositeEmbedding", "register", "create",
+           "get_pretrained_file_names"]

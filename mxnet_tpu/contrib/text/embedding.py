@@ -1,0 +1,166 @@
+"""Token embeddings (reference `contrib/text/embedding.py`).
+
+`TokenEmbedding` holds an (V, D) matrix indexed by a `Vocabulary`-style
+token map; `CustomEmbedding` loads word-vector text files (the GloVe /
+fastText `.txt`/`.vec` format: token then D floats per line).  The
+reference's named pretrained downloads (`glove`, `fasttext`) register here
+too, but this environment has no network egress — `create()` for them
+raises with instructions to use `CustomEmbedding` on a local file.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...base import registry
+from ...ndarray.ndarray import NDArray
+from .vocab import Vocabulary
+
+__all__ = ["TokenEmbedding", "CustomEmbedding", "CompositeEmbedding",
+           "register", "create", "get_pretrained_file_names"]
+
+
+class TokenEmbedding(Vocabulary):
+    """Base embedding: vocabulary + idx_to_vec matrix."""
+
+    emb_registry = registry.get_registry("token_embedding")
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        idxs = [self._token_to_idx.get(t, 0) for t in toks]
+        vecs = self._idx_to_vec.asnumpy()[idxs]
+        out = NDArray(vecs[0] if single else vecs)
+        return out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        mat = self._idx_to_vec.asnumpy().copy()
+        new = new_vectors.asnumpy() if isinstance(new_vectors, NDArray) \
+            else onp.asarray(new_vectors)
+        new = new.reshape(len(toks), -1)
+        for t, v in zip(toks, new):
+            if t not in self._token_to_idx:
+                raise ValueError(f"token {t!r} is not in the embedding")
+            mat[self._token_to_idx[t]] = v
+        self._idx_to_vec = NDArray(mat)
+
+    def _load_embedding_txt(self, path, elem_delim=" ",
+                            init_unknown_vec=onp.zeros, encoding="utf8",
+                            restrict=False):
+        """Load a word-vector text file.  With ``restrict=True`` only
+        tokens already in the vocabulary get vectors (file-only tokens are
+        ignored); otherwise file tokens extend the vocabulary.  The matrix
+        is allocated once after the read (a 400k-line GloVe file must not
+        reallocate per token)."""
+        tokens, vecs = [], []
+        with open(path, encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if line_num == 0 and len(parts) == 2:
+                    continue  # fastText header: "<count> <dim>"
+                token, elems = parts[0], parts[1:]
+                if len(elems) <= 1:
+                    continue  # malformed line, as reference warns+skips
+                tokens.append(token)
+                vecs.append([float(e) for e in elems])
+        self._vec_len = len(vecs[0]) if vecs else 0
+        if not restrict:
+            for token in tokens:
+                if token not in self._token_to_idx:
+                    self._token_to_idx[token] = len(self._idx_to_token)
+                    self._idx_to_token.append(token)
+        mat = onp.zeros((len(self), self._vec_len), onp.float32)
+        mat[0] = init_unknown_vec(self._vec_len)
+        for token, vec in zip(tokens, vecs):
+            idx = self._token_to_idx.get(token)
+            if idx is not None:
+                mat[idx] = vec
+        self._idx_to_vec = NDArray(mat)
+
+
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a local word-vector text file (reference
+    `CustomEmbedding`): each line `token<delim>v1<delim>...<delim>vD`."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 init_unknown_vec=onp.zeros, vocabulary=None, **kwargs):
+        if vocabulary is not None:
+            kwargs.setdefault("counter", None)
+        super().__init__(**kwargs)
+        if vocabulary is not None:
+            # restrict to an existing vocabulary's tokens
+            self._idx_to_token = list(vocabulary.idx_to_token)
+            self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._load_embedding_txt(pretrained_file_path, elem_delim,
+                                 init_unknown_vec, encoding,
+                                 restrict=vocabulary is not None)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference
+    `CompositeEmbedding`)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        super().__init__()
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = [emb.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+                 for emb in token_embeddings]
+        mat = onp.concatenate(parts, axis=1)
+        self._vec_len = mat.shape[1]
+        self._idx_to_vec = NDArray(mat)
+
+
+def register(klass):
+    return registry.get_register_func(
+        TokenEmbedding, "token_embedding")(klass)
+
+
+_PRETRAINED = {
+    "glove": ["glove.6B.50d.txt", "glove.6B.100d.txt", "glove.6B.200d.txt",
+              "glove.6B.300d.txt", "glove.42B.300d.txt",
+              "glove.840B.300d.txt"],
+    "fasttext": ["wiki.en.vec", "wiki.simple.vec"],
+}
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Names of the reference's downloadable embedding files (reference
+    `get_pretrained_file_names`); files must be supplied locally here."""
+    if embedding_name is None:
+        return dict(_PRETRAINED)
+    if embedding_name not in _PRETRAINED:
+        raise KeyError(f"unknown embedding {embedding_name!r}")
+    return list(_PRETRAINED[embedding_name])
+
+
+def create(embedding_name, **kwargs):
+    """Create a named embedding.  Downloadable pretrained sets are not
+    available without network egress; load the file locally instead."""
+    klass = TokenEmbedding.emb_registry.find(embedding_name.lower())
+    if klass is not None:
+        return klass(**kwargs)
+    if embedding_name.lower() in _PRETRAINED:
+        raise RuntimeError(
+            f"pretrained {embedding_name!r} requires a download; fetch the "
+            "file yourself and use contrib.text.embedding.CustomEmbedding("
+            "path) instead")
+    raise KeyError(f"unknown embedding {embedding_name!r}")

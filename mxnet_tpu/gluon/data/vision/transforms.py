@@ -15,7 +15,8 @@ from ...block import Block
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
-           "RandomCrop"]
+           "RandomCrop", "RandomHue", "RandomColorJitter", "RandomLighting",
+           "RandomGray"]
 
 
 def _np(x):
@@ -231,3 +232,71 @@ class RandomSaturation(_RandomColorJitterBase):
         gray = xf.mean(axis=-1, keepdims=True)
         out = xf * alpha + gray * (1 - alpha)
         return onp.clip(out, 0, 255 if x.dtype == onp.uint8 else None).astype(x.dtype)
+
+
+class RandomHue(_RandomColorJitterBase):
+    """Random hue rotation via the YIQ transform (reference transforms
+    RandomHue / image.HueJitterAug)."""
+
+    def __init__(self, amount):
+        super().__init__(amount)
+        from ....image import HueJitterAug
+        self._aug = HueJitterAug(amount)
+
+    def forward(self, x):
+        out = self._aug(x).asnumpy()
+        return onp.clip(out, 0, 255 if x.dtype == onp.uint8 else None) \
+            .astype(x.dtype)
+
+
+class RandomColorJitter(_Transform):
+    """Random brightness/contrast/saturation/hue in random order
+    (reference transforms RandomColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness > 0:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast > 0:
+            self._ts.append(RandomContrast(contrast))
+        if saturation > 0:
+            self._ts.append(RandomSaturation(saturation))
+        if hue > 0:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        for i in onp.random.permutation(len(self._ts)):
+            x = self._ts[i].forward(x)
+        return x
+
+
+class RandomLighting(_Transform):
+    """AlexNet-style PCA lighting noise (reference transforms
+    RandomLighting)."""
+
+    def __init__(self, alpha):
+        super().__init__()
+        from ....image import LightingAug, PCA_EIGVAL, PCA_EIGVEC
+        self._aug = LightingAug(alpha, PCA_EIGVAL, PCA_EIGVEC)
+
+    def forward(self, x):
+        out = self._aug(x).asnumpy()
+        return onp.clip(out, 0, 255 if x.dtype == onp.uint8 else None) \
+            .astype(x.dtype)
+
+
+class RandomGray(_Transform):
+    """Random grayscale conversion (reference transforms RandomGray)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if onp.random.rand() < self._p:
+            gray = (x.astype(onp.float32)
+                    * onp.array([[[0.299, 0.587, 0.114]]])).sum(
+                -1, keepdims=True)
+            return onp.broadcast_to(gray, x.shape).astype(x.dtype)
+        return x

@@ -133,6 +133,14 @@ def _as_np(src):
     return src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
 
 
+# ImageNet PCA lighting eigen-decomposition (AlexNet; shared by
+# CreateAugmenter and transforms.RandomLighting)
+PCA_EIGVAL = [55.46, 4.794, 1.148]
+PCA_EIGVEC = [[-0.5675, 0.7192, 0.4009],
+              [-0.5808, -0.0045, -0.8140],
+              [-0.5836, -0.6948, 0.4203]]
+
+
 class Augmenter:
     """Image augmenter base (reference image.py Augmenter)."""
 
@@ -406,12 +414,7 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if hue:
         auglist.append(HueJitterAug(hue))
     if pca_noise > 0:
-        auglist.append(LightingAug(
-            pca_noise,
-            [55.46, 4.794, 1.148],
-            [[-0.5675, 0.7192, 0.4009],
-             [-0.5808, -0.0045, -0.8140],
-             [-0.5836, -0.6948, 0.4203]]))
+        auglist.append(LightingAug(pca_noise, PCA_EIGVAL, PCA_EIGVEC))
     if rand_gray > 0:
         auglist.append(RandomGrayAug(rand_gray))
     if mean is True:

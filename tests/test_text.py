@@ -1,0 +1,84 @@
+"""contrib.text tests (reference `tests/python/unittest/test_contrib_text.py`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+
+
+def test_count_tokens():
+    c = text.utils.count_tokens_from_str("a b  b\nc a a", to_lower=False)
+    assert c["a"] == 3 and c["b"] == 2 and c["c"] == 1
+
+
+def test_vocabulary_ordering_and_limits():
+    c = text.utils.count_tokens_from_str("d d d b b c c a")
+    v = text.Vocabulary(c, most_freq_count=2, min_freq=2,
+                        reserved_tokens=["<pad>"])
+    # 0=<unk>, 1=<pad>, then top-2 by freq (ties alphabetical)
+    assert v.idx_to_token == ["<unk>", "<pad>", "d", "b"]
+    assert v.to_indices("d") == 2
+    assert v.to_indices(["a", "d"]) == [0, 2]  # 'a' unknown
+    assert v.to_tokens([0, 3]) == ["<unk>", "b"]
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+
+
+def _write_vec(tmp_path, header=False):
+    p = tmp_path / "emb.txt"
+    lines = []
+    if header:
+        lines.append("3 4")
+    lines += ["hello 1 2 3 4", "world 0.5 0.5 0.5 0.5", "foo -1 0 1 0"]
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def test_custom_embedding(tmp_path):
+    emb = text.embedding.CustomEmbedding(_write_vec(tmp_path))
+    assert emb.vec_len == 4
+    assert onp.allclose(emb.get_vecs_by_tokens("hello").asnumpy(),
+                        [1, 2, 3, 4])
+    # unknown -> zeros (init_unknown_vec default)
+    assert onp.allclose(emb.get_vecs_by_tokens("nope").asnumpy(), 0)
+    vecs = emb.get_vecs_by_tokens(["world", "foo"]).asnumpy()
+    assert vecs.shape == (2, 4)
+    emb.update_token_vectors("world", mx.np.ones(4))
+    assert onp.allclose(emb.get_vecs_by_tokens("world").asnumpy(), 1)
+
+
+def test_custom_embedding_fasttext_header(tmp_path):
+    emb = text.embedding.CustomEmbedding(_write_vec(tmp_path, header=True))
+    assert emb.vec_len == 4
+    assert len(emb) == 4  # <unk> + 3 tokens
+
+
+def test_custom_embedding_with_vocabulary(tmp_path):
+    c = text.utils.count_tokens_from_str("hello hello unknownword")
+    vocab = text.Vocabulary(c)
+    emb = text.embedding.CustomEmbedding(_write_vec(tmp_path),
+                                         vocabulary=vocab)
+    # vocabulary tokens without file vectors stay at zeros
+    assert onp.allclose(
+        emb.get_vecs_by_tokens("unknownword").asnumpy()[:4], 0)
+    assert onp.allclose(emb.get_vecs_by_tokens("hello").asnumpy(),
+                        [1, 2, 3, 4])
+
+
+def test_composite_embedding(tmp_path):
+    emb = text.embedding.CustomEmbedding(_write_vec(tmp_path))
+    vocab = text.Vocabulary(
+        text.utils.count_tokens_from_str("hello world"))
+    comp = text.embedding.CompositeEmbedding(vocab, [emb, emb])
+    assert comp.vec_len == 8
+    v = comp.get_vecs_by_tokens("hello").asnumpy()
+    assert onp.allclose(v, [1, 2, 3, 4, 1, 2, 3, 4])
+
+
+def test_pretrained_names_and_create_gate():
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    with pytest.raises(RuntimeError, match="download"):
+        text.embedding.create("glove")
+    with pytest.raises(KeyError):
+        text.embedding.create("nonsense")
