@@ -136,6 +136,19 @@ def _attached(arr):
     return arr._node is not None or (arr._grad is not None and arr._grad_req != "null")
 
 
+def _profiler_hook():
+    """(clock, record_op) while the profiler runs, else None — per-op host
+    dispatch spans (the engine's ProfileOperator analogue; device-side
+    kernel timing comes from the XLA trace via
+    `profiler.set_config(xla_trace_dir=...)`)."""
+    from .. import profiler as _p
+
+    if not _p._running:
+        return None
+    import time as _t
+    return (lambda: _t.perf_counter() * 1e6, _p.record_op)
+
+
 def invoke(fun, args, kwargs=None, name=None, differentiable=True, wrap=True):
     """Dispatch ``fun`` (a pure function over jax arrays) imperatively.
 
@@ -160,9 +173,17 @@ def invoke(fun, args, kwargs=None, name=None, differentiable=True, wrap=True):
         and any(_attached(leaves[i]) and _is_float(datas[i]) for i in nd_idx)
     )
 
+    prof = _profiler_hook()
+
     if not record:
         a, kw = jax.tree_util.tree_unflatten(treedef, datas)
-        out = fun(*a, **kw)
+        if prof is not None:
+            t0 = prof[0]()
+            out = fun(*a, **kw)
+            prof[1](name or getattr(fun, "__name__", "op"), t0,
+                    prof[0]() - t0)
+        else:
+            out = fun(*a, **kw)
         return _wrap_out(out, ctx, None, name) if wrap else out
 
     diff_idx = [i for i in nd_idx if _attached(leaves[i]) and _is_float(datas[i])]
@@ -175,7 +196,12 @@ def invoke(fun, args, kwargs=None, name=None, differentiable=True, wrap=True):
         a, kw = jax.tree_util.tree_unflatten(treedef, full)
         return fun(*a, **kw)
 
-    out, vjp_fn = jax.vjp(flat_fun, *[datas[i] for i in diff_idx])
+    if prof is not None:
+        t0 = prof[0]()
+        out, vjp_fn = jax.vjp(flat_fun, *[datas[i] for i in diff_idx])
+        prof[1](name or getattr(fun, "__name__", "op"), t0, prof[0]() - t0)
+    else:
+        out, vjp_fn = jax.vjp(flat_fun, *[datas[i] for i in diff_idx])
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
     parents = [
         (leaves[i], leaves[i]._node, getattr(leaves[i], "_node_idx", 0))

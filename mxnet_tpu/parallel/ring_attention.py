@@ -22,7 +22,8 @@ from jax import shard_map
 __all__ = ["ring_attention", "ring_attention_local"]
 
 
-def ring_attention_local(q, k, v, axis_name, causal=False, scale=None):
+def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
+                         extra_vary_axes=()):
     """Per-shard body (runs under shard_map).
 
     q/k/v: (B, H, T_local, D) — the local sequence block.  Returns the exact
@@ -68,6 +69,12 @@ def ring_attention_local(q, k, v, axis_name, causal=False, scale=None):
     m0 = jnp.full((b, h, t_q), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, t_q), jnp.float32)
     acc0 = jnp.zeros((b, h, t_q, d), jnp.float32)
+    # fresh accumulators are device-invariant; mark them varying over the
+    # ring axis (and the batch axis, when sharded) so the scan carry types
+    # match the rotating k/v blocks
+    vary = (axis_name,) + tuple(extra_vary_axes)
+    m0, l0, acc0 = (lax.pcast(x, vary, to="varying")
+                    for x in (m0, l0, acc0))
     (m, l, acc, _k, _v), _ = lax.scan(
         step, (m0, l0, acc0, k, v), jnp.arange(axis_size))
     out = acc / jnp.maximum(l, 1e-30)[..., None]
@@ -83,13 +90,14 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
     from ..ops.invoke import invoke
 
     spec = P(batch_axis, None, axis_name, None)
+    extra = (batch_axis,) if batch_axis is not None else ()
     fn = shard_map(
         functools.partial(ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale,
+                          extra_vary_axes=extra),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
     )
     if isinstance(q, NDArray):
         return invoke(fn, (q, k, v), name="ring_attention")

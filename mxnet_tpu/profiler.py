@@ -77,16 +77,44 @@ def _emit(name, cat, ph, ts, args=None, dur=None):
         _events.append(ev)
 
 
+def record_op(name, ts, dur):
+    """Per-operator event hook (called by `ops.invoke` while profiling —
+    the analogue of the engine's ProfileOperator wrapping,
+    `src/engine/threaded_engine.h:83`)."""
+    _emit(name, "operator", "X", ts, dur=dur)
+
+
 def dumps(reset=False, format="table"):
-    payload = json.dumps({"traceEvents": list(_events)}, indent=1)
-    if reset:
-        _events.clear()
-    return payload
+    """format='json': chrome://tracing events; format='table': aggregate
+    per-name statistics (reference `AggregateStats`,
+    `src/profiler/aggregate_stats.cc`)."""
+    with _lock:
+        events = list(_events)
+        if reset:
+            _events.clear()
+    if format == "json":
+        return json.dumps({"traceEvents": events}, indent=1)
+    agg = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev["name"]
+        cnt, tot, mx_ = agg.get(name, (0, 0.0, 0.0))
+        dur = ev.get("dur", 0.0)
+        agg[name] = (cnt + 1, tot + dur, max(mx_, dur))
+    lines = [f"{'Name':<40}{'Count':>8}{'Total(us)':>14}{'Avg(us)':>12}"
+             f"{'Max(us)':>12}", "-" * 86]
+    for name, (cnt, tot, mx_) in sorted(agg.items(),
+                                        key=lambda kv: -kv[1][1]):
+        lines.append(f"{name[:39]:<40}{cnt:>8}{tot:>14.1f}"
+                     f"{tot / cnt:>12.1f}{mx_:>12.1f}")
+    return "\n".join(lines)
 
 
 def dump(finished=True, profile_process="worker"):
+    """Write the chrome://tracing JSON to the configured filename."""
     with open(_config["filename"], "w") as f:
-        f.write(dumps())
+        f.write(dumps(format="json"))
 
 
 class Domain:
