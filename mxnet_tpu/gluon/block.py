@@ -315,28 +315,9 @@ class HybridBlock(Block):
 
         def functional(param_datas, key, flat_inputs, treedef_id):
             # runs only at trace time (jit caches by shape after that)
-            params = block._cached_param_list
-            mapping = {}
-            for p, d in zip(params, param_datas):
-                nd = NDArray(d)
-                nd._param_ref = p
-                mapping[id(p)] = nd
-            treedef = _TREEDEFS[treedef_id]
-            wrapped = [NDArray(d) for d in flat_inputs]
-            args = jax.tree_util.tree_unflatten(treedef, wrapped)
-            prev_rec = set_recording(False)
-            prev_tr = set_training(training)
-            try:
-                with _param_override_scope(mapping), \
-                        _rng.key_stream_scope(key), \
-                        aux_update_scope() as aux:
-                    out = block.forward(*args)
-            finally:
-                set_recording(prev_rec)
-                set_training(prev_tr)
-            out_datas = jax.tree_util.tree_map(
-                lambda o: o._data if _is_nd(o) else o, out,
-                is_leaf=_is_nd)
+            out_datas, aux = _scoped_forward(
+                block, block._cached_param_list, param_datas, key,
+                flat_inputs, _TREEDEFS[treedef_id], training)
             holder.clear()
             holder.extend(getattr(a, "_param_ref", None)
                           for a, _v in aux.updates)
@@ -381,15 +362,102 @@ class HybridBlock(Block):
             return out
         return super().__call__(*args, **kwargs)
 
-    def export(self, path, epoch=0, remove_amp_cast=True):
-        """Serialize params for deployment (reference block.py:1300).  The
-        graph itself is XLA-compiled at load time; only params are stored."""
+    def export(self, path, epoch=0, remove_amp_cast=True, example_args=None):
+        """Serialize the model for deployment (reference block.py:1300:
+        symbol JSON + params).  The TPU-native graph format is serialized
+        StableHLO via ``jax.export``: ``{path}-symbol.bin`` holds the
+        compiled inference program, ``{path}-symbol.json`` its signature,
+        and ``{path}-{epoch:04d}.params`` the parameters —
+        `SymbolBlock.imports` reloads all three without the python class.
+
+        Exporting the program requires ``example_args`` (or a previously
+        traced call) to fix input shapes/dtypes, like the reference's
+        shape-specialized symbol graphs.
+        """
+        import json as _json
+
+        if example_args is not None:
+            self._ensure_shapes(*example_args)
         fname = f"{path}-{epoch:04d}.params"
         self.save_parameters(fname)
-        return fname, None
+
+        if example_args is None:
+            return fname, None
+        params = self.collect_params()
+        # only initialized params enter the graph (save_parameters skips
+        # the rest too; a registered-but-unused deferred param must not
+        # break export)
+        names = [k for k in sorted(params) if params[k]._data is not None]
+        plist = [params[k] for k in names]
+        block = self
+
+        flat_in, in_treedef = jax.tree_util.tree_flatten(
+            example_args, is_leaf=_is_nd)
+        if not all(_is_nd(a) for a in flat_in):
+            raise TypeError("example_args must contain only NDArrays "
+                            "(arbitrarily nested)")
+
+        def infer_fn(param_datas, *input_datas):
+            # deployment graph: predict mode, fixed key (dropout inactive)
+            out_datas, _aux = _scoped_forward(
+                block, plist, param_datas, jax.random.key(0),
+                list(input_datas), in_treedef, training=False)
+            return out_datas
+
+        from jax import export as jexport
+
+        param_specs = tuple(
+            jax.ShapeDtypeStruct(p.data()._data.shape, p.data()._data.dtype)
+            for p in plist)
+        input_specs = tuple(
+            jax.ShapeDtypeStruct(a._data.shape, a._data.dtype)
+            for a in flat_in)
+        # lower for both CPU and TPU so an artifact exported on a dev
+        # machine still runs on the deployment chip
+        exported = jexport.export(
+            jax.jit(infer_fn),
+            platforms=("cpu", "tpu"))(param_specs, *input_specs)
+        with open(f"{path}-symbol.bin", "wb") as f:
+            f.write(exported.serialize())
+        meta = {
+            "format": "mxnet_tpu-stablehlo-v1",
+            "param_names": names,
+            "inputs": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                       for a in flat_in],
+        }
+        with open(f"{path}-symbol.json", "w") as f:
+            _json.dump(meta, f, indent=1)
+        return fname, f"{path}-symbol.bin"
 
     def infer_shape(self, *args):
         self._ensure_shapes(*args)
+
+
+def _scoped_forward(block, plist, param_datas, key, flat_inputs, treedef,
+                    training):
+    """Run ``block.forward`` with parameters overridden by ``param_datas``
+    under the shared trace-scope protocol (override scope + key stream +
+    aux capture) — used by both the hybridize jit path and `export`.
+    Returns (out_datas, aux)."""
+    mapping = {}
+    for p, d in zip(plist, param_datas):
+        nd = NDArray(d)
+        nd._param_ref = p
+        mapping[id(p)] = nd
+    wrapped = [NDArray(d) for d in flat_inputs]
+    args = jax.tree_util.tree_unflatten(treedef, wrapped)
+    prev_rec = set_recording(False)
+    prev_tr = set_training(training)
+    try:
+        with _param_override_scope(mapping), _rng.key_stream_scope(key), \
+                aux_update_scope() as aux:
+            out = block.forward(*args)
+    finally:
+        set_recording(prev_rec)
+        set_training(prev_tr)
+    out_datas = jax.tree_util.tree_map(
+        lambda o: o._data if _is_nd(o) else o, out, is_leaf=_is_nd)
+    return out_datas, aux
 
 
 # treedefs are hashable but not weak-refable; intern them for static_argnums
@@ -402,19 +470,52 @@ def _intern_treedef(td):
     return key
 
 
-class SymbolBlock(HybridBlock):
-    """Reference `block.py:1500` — runs a serialized symbol graph.  The TPU
-    build has no symbol JSON format; model structure is python code.  Kept
-    as a loader for checkpoints saved by `HybridBlock.export`."""
+class SymbolBlock(Block):
+    """Reference `block.py:1500` — runs a serialized graph without its
+    python class.  The graph format is serialized StableHLO written by
+    `HybridBlock.export(..., example_args=...)`; `imports` reloads the
+    program and parameters and yields a callable block."""
 
-    def __init__(self, outputs=None, inputs=None, params=None):
-        raise NotImplementedError(
-            "symbol JSON graphs do not exist in the TPU build; instantiate "
-            "the python Block and use load_parameters() instead "
-            "(see HybridBlock.export)")
+    def __init__(self, exported, param_names, param_datas):
+        super().__init__()
+        self._exported = exported
+        self._param_names = param_names
+        self._param_datas = list(param_datas)
 
     @staticmethod
-    def imports(symbol_file, input_names, param_file=None, ctx=None):
-        raise NotImplementedError(
-            "symbol JSON import is not supported; rebuild the Block in "
-            "python and call load_parameters()")
+    def imports(symbol_file, input_names=None, param_file=None, ctx=None):
+        """Load `{prefix}-symbol.json` (+`.bin`) and params (reference
+        block.py:1532).  `symbol_file` may be the json path or the prefix."""
+        import json as _json
+
+        from jax import export as jexport
+
+        prefix = symbol_file
+        for suffix in ("-symbol.json", "-symbol.bin"):
+            if prefix.endswith(suffix):
+                prefix = prefix[: -len(suffix)]
+        with open(f"{prefix}-symbol.json") as f:
+            meta = _json.load(f)
+        if meta.get("format") != "mxnet_tpu-stablehlo-v1":
+            raise ValueError(f"unknown export format {meta.get('format')!r}")
+        with open(f"{prefix}-symbol.bin", "rb") as f:
+            exported = jexport.deserialize(f.read())
+        names = meta["param_names"]
+        if param_file is None:
+            import glob as _glob
+
+            cands = sorted(_glob.glob(f"{_glob.escape(prefix)}-*.params"))
+            if not cands:
+                raise FileNotFoundError(f"no params found for {prefix}")
+            param_file = cands[-1]
+        from ..utils.serialization import load_ndarrays
+
+        loaded = load_ndarrays(param_file)
+        datas = [loaded[n]._data for n in names]
+        return SymbolBlock(exported, names, datas)
+
+    def forward(self, *args):
+        flat, _treedef = jax.tree_util.tree_flatten(args, is_leaf=_is_nd)
+        datas = tuple(a._data if _is_nd(a) else a for a in flat)
+        out = self._exported.call(tuple(self._param_datas), *datas)
+        return jax.tree_util.tree_map(NDArray, out)

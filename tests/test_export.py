@@ -1,0 +1,93 @@
+"""HybridBlock.export / SymbolBlock.imports + AMP conversion tests
+(reference `test_gluon.py` export/imports round trip)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, gluon
+from mxnet_tpu.gluon import nn, SymbolBlock
+
+
+def _net():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.Activation("relu"),
+            nn.Dense(3))
+    net.initialize()
+    return net
+
+
+def test_export_imports_roundtrip(tmp_path):
+    net = _net()
+    x = mx.np.array(onp.random.rand(2, 1, 8, 8).astype("float32"))
+    expect = net(x).asnumpy()
+
+    prefix = str(tmp_path / "deploy")
+    params_file, symbol_file = net.export(prefix, epoch=3, example_args=(x,))
+    assert params_file.endswith("-0003.params")
+    assert symbol_file.endswith("-symbol.bin")
+
+    # reload WITHOUT the python class: serialized StableHLO + params
+    loaded = SymbolBlock.imports(prefix + "-symbol.json")
+    got = loaded(x).asnumpy()
+    assert onp.allclose(got, expect, atol=1e-5)
+
+
+def test_export_params_only(tmp_path):
+    net = _net()
+    x = mx.np.ones((1, 1, 8, 8))
+    net(x)
+    prefix = str(tmp_path / "p")
+    params_file, symbol_file = net.export(prefix)
+    assert symbol_file is None
+    net2 = _net()
+    net2.load_parameters(params_file)
+    assert onp.allclose(net2(x).asnumpy(), net(x).asnumpy(), atol=1e-6)
+
+
+def test_export_is_predict_mode(tmp_path):
+    """The exported graph freezes predict mode: dropout is a no-op, so the
+    loaded block matches the original's eager predict-mode output."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16), nn.Dropout(0.9), nn.Dense(4))
+    net.initialize()
+    x = mx.np.ones((2, 8))
+    expect = net(x).asnumpy()  # eager, not recording -> predict mode
+    prefix = str(tmp_path / "d")
+    net.export(prefix, example_args=(x,))
+    loaded = SymbolBlock.imports(prefix + "-symbol.json")
+    assert onp.allclose(loaded(x).asnumpy(), expect, atol=1e-5)
+
+
+def test_export_pytree_inputs(tmp_path):
+    """Blocks taking nested inputs (RNN-style state lists) export too."""
+    from mxnet_tpu.gluon import rnn
+    cell = rnn.LSTMCell(6, input_size=4)
+    cell.initialize()
+    x = mx.np.ones((2, 4))
+    states = cell.begin_state(batch_size=2)
+    expect, _ = cell(x, states)
+    prefix = str(tmp_path / "cell")
+    cell.export(prefix, example_args=(x, states))
+    loaded = SymbolBlock.imports(prefix + "-symbol.json")
+    got, new_states = loaded(x, states)
+    assert onp.allclose(got.asnumpy(), expect.asnumpy(), atol=1e-5)
+    assert len(new_states) == 2
+
+
+def test_amp_convert_hybrid_block(tmp_path):
+    net = _net()
+    x32 = mx.np.ones((1, 1, 8, 8))
+    net(x32)
+    amp.convert_hybrid_block(net, target_dtype="bfloat16")
+    out = net(x32.astype("bfloat16"))
+    assert str(out.dtype) == "bfloat16"
+    for p in net.collect_params().values():
+        assert str(p.data().dtype) == "bfloat16"
+
+
+def test_amp_loss_scaler_dynamic():
+    from mxnet_tpu.amp.loss_scaler import LossScaler
+    ls = LossScaler(init_scale=16.0, scale_factor=2.0, scale_window=2)
+    s0 = ls.loss_scale if hasattr(ls, "loss_scale") else ls._scale
+    ls.update_scale(overflow=True)
+    s1 = ls.loss_scale if hasattr(ls, "loss_scale") else ls._scale
+    assert s1 < s0  # backs off on overflow
