@@ -193,3 +193,79 @@ class NativeRecordWriter:
 
     def __del__(self):
         self.close()
+
+
+def parse_libsvm(path):
+    """Parse a LibSVM file through the C++ core: returns numpy
+    (labels, indptr, indices, values, num_cols).  Falls back to a pure
+    python parser when the native library is unavailable."""
+    import numpy as onp
+
+    L = lib()
+    if L is not None:
+        if not getattr(L, "_lsvm_ready", False):
+            L.lsvm_last_error.restype = ctypes.c_char_p
+            L.lsvm_open.restype = ctypes.c_void_p
+            L.lsvm_open.argtypes = [ctypes.c_char_p]
+            L.lsvm_close.argtypes = [ctypes.c_void_p]
+            L.lsvm_num_rows.restype = ctypes.c_int64
+            L.lsvm_num_rows.argtypes = [ctypes.c_void_p]
+            L.lsvm_nnz.restype = ctypes.c_int64
+            L.lsvm_nnz.argtypes = [ctypes.c_void_p]
+            L.lsvm_max_index.restype = ctypes.c_int32
+            L.lsvm_max_index.argtypes = [ctypes.c_void_p]
+            L.lsvm_copy.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_float)]
+            L._lsvm_ready = True
+        h = L.lsvm_open(path.encode())
+        if not h:
+            raise IOError(L.lsvm_last_error().decode())
+        try:
+            n = L.lsvm_num_rows(h)
+            nnz = L.lsvm_nnz(h)
+            labels = onp.empty(n, onp.float32)
+            indptr = onp.empty(n + 1, onp.int64)
+            indices = onp.empty(nnz, onp.int32)
+            values = onp.empty(nnz, onp.float32)
+            L.lsvm_copy(
+                h,
+                labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                indptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            ncols = int(L.lsvm_max_index(h)) + 1
+        finally:
+            L.lsvm_close(h)
+        return labels, indptr, indices, values, ncols
+
+    # pure-python fallback (raises IOError on corrupt rows, matching the
+    # native path's error contract)
+    labels, indptr, indices, values = [], [0], [], []
+    ncols = 0
+    with open(path) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            try:
+                parts = line.split()
+                labels.append(float(parts[0]))
+                for feat in parts[1:]:
+                    idx, val = feat.split(":")
+                    if int(idx) < 0:
+                        raise ValueError("negative feature index")
+                    indices.append(int(idx))
+                    values.append(float(val))
+                    ncols = max(ncols, int(idx) + 1)
+            except ValueError as e:
+                raise IOError(
+                    f"bad libsvm row at line {line_no}: {e}") from e
+            indptr.append(len(indices))
+    return (onp.asarray(labels, onp.float32),
+            onp.asarray(indptr, onp.int64),
+            onp.asarray(indices, onp.int32),
+            onp.asarray(values, onp.float32), ncols)

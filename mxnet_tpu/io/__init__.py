@@ -1,7 +1,7 @@
 """Legacy data iterators (reference: `python/mxnet/io/`)."""
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,
-                 ResizeIter, PrefetchingIter)
+                 LibSVMIter, ResizeIter, PrefetchingIter)
 from .bucket import BucketSentenceIter
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ResizeIter", "PrefetchingIter", "BucketSentenceIter"]
+           "LibSVMIter", "ResizeIter", "PrefetchingIter", "BucketSentenceIter"]

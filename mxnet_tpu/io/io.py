@@ -21,7 +21,7 @@ import numpy as onp
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ResizeIter", "PrefetchingIter"]
+           "LibSVMIter", "ResizeIter", "PrefetchingIter"]
 
 
 class DataDesc(collections.namedtuple("DataDesc", ["name", "shape"])):
@@ -521,3 +521,90 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+class LibSVMIter(DataIter):
+    """Iterate a LibSVM-format file as CSR batches (reference C++
+    `LibSVMIter`, `src/io/iter_libsvm.cc`): each batch yields a
+    `CSRNDArray` data block and a dense label vector.  Parsing runs in the
+    native C++ core (`mxnet_tpu/src/libsvm.cc`) when built."""
+
+    def __init__(self, data_libsvm, data_shape=None, label_libsvm=None,
+                 batch_size=1, round_batch=True, data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        from .._native import parse_libsvm
+        from ..ndarray import sparse
+
+        labels, indptr, indices, values, ncols = parse_libsvm(data_libsvm)
+        if data_shape is not None:
+            ncols = data_shape[0] if isinstance(data_shape, (tuple, list)) \
+                else int(data_shape)
+            if len(indices) and int(indices.max()) >= ncols:
+                raise ValueError(
+                    f"data_shape={ncols} is smaller than the largest "
+                    f"feature index {int(indices.max())} in {data_libsvm}")
+        self._sparse = sparse
+        self._csr = sparse.CSRNDArray(values, indices, indptr,
+                                      (len(labels), ncols))
+        if label_libsvm is not None:
+            ext_labels = parse_libsvm(label_libsvm)[0]
+            if len(ext_labels) != len(labels):
+                raise ValueError(
+                    f"label file has {len(ext_labels)} rows but data file "
+                    f"has {len(labels)}")
+            labels = ext_labels
+        self._labels = labels
+        self._ncols = ncols
+        self.num_data = len(labels)
+        assert self.num_data >= batch_size
+        self._round = round_batch
+        self.data_name = data_name
+        self.label_name = label_name
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size, self._ncols))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name, (self.batch_size,))]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _rows(self, idxs):
+        indptr = self._csr.indptr
+        data, indices, new_indptr = [], [], [0]
+        for r in idxs:
+            lo, hi = indptr[r], indptr[r + 1]
+            data.append(self._csr.data[lo:hi])
+            indices.append(self._csr.indices[lo:hi])
+            new_indptr.append(new_indptr[-1] + (hi - lo))
+        return self._sparse.CSRNDArray(
+            onp.concatenate(data), onp.concatenate(indices),
+            onp.asarray(new_indptr, onp.int64),
+            (len(idxs), self._ncols))
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        end = self.cursor + self.batch_size
+        idxs = list(range(self.cursor, min(end, self.num_data)))
+        pad = end - self.num_data if end > self.num_data else 0
+        if pad:
+            if not self._round:
+                raise StopIteration
+            idxs += list(range(pad))  # wrap to the head, reference-style
+        batch = DataBatch([self._rows(idxs)],
+                          [NDArray(self._labels[idxs])], pad=pad)
+        return batch
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        return end - self.num_data if end > self.num_data else 0

@@ -78,7 +78,8 @@ class CSRNDArray(_SparseNDArray):
         assert len(self._shape) == 2, "csr is 2-D"
         self.data = data.astype(self._dtype)
         self.indices = onp.asarray(indices, onp.int32)
-        self.indptr = onp.asarray(indptr, onp.int32)
+        # int64: a CTR-scale file can exceed 2^31 nonzeros
+        self.indptr = onp.asarray(indptr, onp.int64)
         assert self.indptr.shape == (self._shape[0] + 1,)
         assert self.data.shape == self.indices.shape
 
@@ -185,13 +186,15 @@ def zeros(stype, shape, dtype="float32"):
 def dot(lhs, rhs, transpose_a=False):
     """Sparse-dense matmul (reference `sparse.dot` with `FComputeEx`
     kernels): csr @ dense or csr.T @ dense via a BCOO contraction compiled
-    by XLA."""
+    by XLA.  Differentiable w.r.t. the dense operand (the sparse side is
+    data, as in the reference's CTR use)."""
     if not isinstance(lhs, CSRNDArray):
         raise TypeError("sparse.dot expects a CSR lhs")
+    from ..ops.invoke import invoke
+
     bcoo = lhs._to_bcoo()
-    rhs_data = rhs._data if isinstance(rhs, NDArray) else onp.asarray(rhs)
-    fn = _dot_t_jit if transpose_a else _dot_jit
-    return NDArray(fn(bcoo, rhs_data))
+    jit_fn = _dot_t_jit if transpose_a else _dot_jit
+    return invoke(lambda d: jit_fn(bcoo, d), (rhs,), name="sparse_dot")
 
 
 def retain(rs, indices):

@@ -1,0 +1,115 @@
+"""LibSVM parser + iterator tests (reference `tests/python/unittest/
+test_io.py` test_LibSVMIter pattern: deterministic file -> CSR values)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu._native import lib as native_lib, parse_libsvm
+from mxnet_tpu.io import LibSVMIter
+
+
+def _write(tmp_path, lines):
+    p = tmp_path / "data.svm"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def test_native_parse_matches_expected(tmp_path):
+    path = _write(tmp_path, [
+        "1 0:0.5 3:1.5",
+        "-1 1:2.0",
+        "0  # empty row with comment",
+        "2 0:1.0 2:3.0 4:4.0",
+    ])
+    labels, indptr, indices, values, ncols = parse_libsvm(path)
+    assert native_lib() is not None  # C++ core in use
+    assert labels.tolist() == [1.0, -1.0, 0.0, 2.0]
+    assert indptr.tolist() == [0, 2, 3, 3, 6]
+    assert indices.tolist() == [0, 3, 1, 0, 2, 4]
+    assert values.tolist() == [0.5, 1.5, 2.0, 1.0, 3.0, 4.0]
+    assert ncols == 5
+
+
+def test_native_and_python_parsers_agree(tmp_path):
+    onp.random.seed(0)
+    lines = []
+    for _ in range(50):
+        feats = sorted(onp.random.choice(20, onp.random.randint(1, 6),
+                                         replace=False))
+        lines.append(f"{onp.random.randint(-1, 2)} " + " ".join(
+            f"{i}:{onp.random.rand():.4f}" for i in feats))
+    path = _write(tmp_path, lines)
+    nat = parse_libsvm(path)
+
+    import mxnet_tpu._native as native
+    real_lib = native.lib
+    native.lib = lambda: None  # force the python fallback
+    try:
+        py = parse_libsvm(path)
+    finally:
+        native.lib = real_lib
+    for a, b in zip(nat[:4], py[:4]):
+        assert onp.allclose(a, b)
+    assert nat[4] == py[4]
+
+
+def test_parse_rejects_corrupt(tmp_path):
+    path = _write(tmp_path, ["1 0:0.5", "nonsense_label 1:2"])
+    with pytest.raises(IOError):
+        parse_libsvm(path)
+
+
+def test_libsvm_iter_batches(tmp_path):
+    path = _write(tmp_path, [
+        "1 0:1.0", "2 1:2.0", "3 2:3.0", "4 3:4.0", "5 0:5.0",
+    ])
+    it = LibSVMIter(path, batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3
+    b0 = batches[0]
+    assert b0.data[0].stype == "csr"
+    assert b0.data[0].shape == (2, 4)
+    assert b0.label[0].asnumpy().tolist() == [1.0, 2.0]
+    dense = b0.data[0].asnumpy()
+    assert dense[0, 0] == 1.0 and dense[1, 1] == 2.0
+    # last batch wraps (round_batch) with pad reported
+    b2 = batches[2]
+    assert b2.pad == 1
+    assert b2.label[0].asnumpy().tolist() == [5.0, 1.0]
+    # feeds sparse.dot directly
+    from mxnet_tpu.ndarray import sparse
+    out = sparse.dot(b0.data[0], mx.np.ones((4, 2)))
+    assert out.shape == (2, 2)
+
+
+def test_libsvm_iter_explicit_shape(tmp_path):
+    path = _write(tmp_path, ["1 0:1.0", "0 1:1.0"])
+    it = LibSVMIter(path, data_shape=(10,), batch_size=2)
+    assert next(it).data[0].shape == (2, 10)
+    # too-small shape is rejected at construction, not at use
+    with pytest.raises(ValueError, match="feature index"):
+        LibSVMIter(path, data_shape=(1,), batch_size=2)
+
+
+def test_libsvm_label_file_mismatch(tmp_path):
+    data = _write(tmp_path, ["1 0:1.0", "0 1:1.0"])
+    lbl = tmp_path / "l.svm"
+    lbl.write_text("1\n0\n1\n")
+    with pytest.raises(ValueError, match="rows"):
+        LibSVMIter(data, label_libsvm=str(lbl), batch_size=2)
+
+
+def test_sparse_dot_is_differentiable():
+    """sparse.dot participates in autograd w.r.t. the dense operand."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray import sparse
+    X = onp.zeros((4, 6), "float32")
+    X[0, 1] = 2.0
+    X[3, 5] = 1.0
+    csr = sparse.csr_matrix(X)
+    w = mx.np.ones((6, 1))
+    w.attach_grad()
+    with autograd.record():
+        loss = sparse.dot(csr, w).sum()
+    loss.backward()
+    assert onp.allclose(w.grad.asnumpy().ravel(), X.sum(0))
