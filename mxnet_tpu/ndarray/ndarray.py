@@ -89,6 +89,12 @@ class NDArray:
         self._version += 1
         return self
 
+    @property
+    def version(self):
+        """Mutation counter (reference `NDArray::version`,
+        `ndarray.h:401-410`): bumps on every in-place write/rebind."""
+        return self._version
+
     def wait_to_read(self):
         """Block until the buffer is defined (reference ``WaitToRead``);
         asynchronous execution errors are raised here, matching the

@@ -29,7 +29,7 @@ __all__ = [
     "reshape_like", "arange_like", "gamma", "gamma_fn", "gelu", "gammaln", "erf", "erfinv",
     "adaptive_avg_pool2d", "l2_normalization", "waitall", "cpu", "gpu", "tpu",
     "num_gpus", "num_tpus", "current_context", "save", "load", "seed",
-    "foreach", "while_loop", "cond",
+    "foreach", "while_loop", "cond", "flash_attention",
 ]
 
 seed = _rng.seed
@@ -70,6 +70,14 @@ reshape_like = _op(_nn.reshape_like, "reshape_like")
 arange_like = _op(_nn.arange_like, "arange_like", differentiable=False)
 gamma = _op(_nn.gamma_fn, "gamma")
 gamma_fn = gamma
+
+
+def flash_attention(*args, **kwargs):
+    """Blockwise (flash) attention Pallas kernel — lazy import so the core
+    namespace does not pay the jax.experimental.pallas import cost (see
+    `ops/pallas_kernels.py`)."""
+    from ..ops.pallas_kernels import flash_attention as _fa
+    return _fa(*args, **kwargs)
 
 
 def gelu(data, approximation="erf"):

@@ -1,0 +1,189 @@
+"""Hand-written Pallas TPU kernels for hot ops.
+
+The reference hand-writes CUDA for its hot paths (`src/operator/fusion/`,
+cuDNN bindings); here the analogous escape hatch is Pallas.  XLA's own
+fusion covers most of the op surface — these kernels exist for the few
+patterns where explicit blocking wins: flash attention keeps the (T, T)
+score matrix out of HBM entirely, streaming K/V blocks through VMEM with
+an online-softmax accumulator (single-chip analogue of
+`parallel/ring_attention.py`, which does the same blockwise math across
+chips).
+
+Kernels run in interpret mode off-TPU, so they are testable on the CPU
+mesh against dense oracles.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .invoke import invoke
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale, causal, block_q, block_k, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)          # (block_q, D)
+    k = k_ref[0].astype(jnp.float32)          # (block_k, D)
+    v = v_ref[0].astype(jnp.float32)
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+    m_prev = m_ref[...]                        # (block_q, 1)
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)                     # (block_q, block_k)
+    alpha = jnp.exp(m_prev - m_new)            # rescale of old mass
+    l_ref[...] = l_ref[...] * alpha + p.sum(axis=1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_ref[...] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(qd, kd, vd, causal, scale, block_q, block_k, interpret):
+    b, h, t, d = qd.shape
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    if t % bq or t % bk:
+        raise ValueError(
+            f"block sizes ({bq}, {bk}) must divide sequence length {t}; "
+            "pad and mask upstream")
+    nk = t // bk
+    sc = d ** -0.5 if scale is None else scale
+    interp = (jax.default_backend() != "tpu") if interpret is None \
+        else interpret
+
+    qr = qd.reshape(b * h, t, d)
+    kr = kd.reshape(b * h, t, d)
+    vr = vd.reshape(b * h, t, d)
+    kernel = functools.partial(
+        _flash_kernel, scale=sc, causal=causal, block_q=bq, block_k=bk,
+        nk=nk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // bq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), qd.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max
+            pltpu.VMEM((bq, 1), jnp.float32),   # running sum
+            pltpu.VMEM((bq, d), jnp.float32),   # output accumulator
+        ],
+        interpret=interp,
+    )(qr, kr, vr)
+    return out.reshape(b, h, t, d)
+
+
+def _blockwise_reference(qd, kd, vd, causal, scale, block_k):
+    """Pure-jnp blockwise attention (lax.scan over K/V blocks with online
+    softmax) — numerically identical to the kernel, used to derive the
+    backward pass (flash recompute strategy: trade FLOPs for never
+    materializing the (T, T) score matrix)."""
+    b, h, t, d = qd.shape
+    bk = min(block_k, t)
+    nk = t // bk
+    sc = d ** -0.5 if scale is None else scale
+    q32 = qd.astype(jnp.float32)
+    kb = kd.astype(jnp.float32).reshape(b, h, nk, bk, d)
+    vb = vd.astype(jnp.float32).reshape(b, h, nk, bk, d)
+    q_pos = jnp.arange(t)
+
+    # checkpoint each block step: differentiating the scan must NOT store
+    # per-step (T, block) probability residuals — recompute keeps backward
+    # memory at O(T * block), the whole point of the kernel
+    @jax.checkpoint
+    def step(carry, i):
+        m, l, acc = carry
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, kb[:, :, i]) * sc
+        if causal:
+            k_pos = i * bk + jnp.arange(bk)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(-1)
+        acc = acc * alpha[..., None] + \
+            jnp.einsum("bhqk,bhkd->bhqd", p, vb[:, :, i])
+        return (m_new, l, acc), None
+
+    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    acc0 = jnp.zeros((b, h, t, d), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(step, (m0, l0, acc0), jnp.arange(nk))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(qd.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(qd, kd, vd, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(qd, kd, vd, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(qd, kd, vd, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(qd, kd, vd, causal, scale, block_q, block_k,
+                         interpret)
+    return out, (qd, kd, vd)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, ct):
+    qd, kd, vd = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _blockwise_reference(q, k, v, causal, scale,
+                                             block_k), qd, kd, vd)
+    return vjp(ct)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Blockwise (flash) attention: q/k/v (B, H, T, D) -> (B, H, T, D).
+
+    Exact attention; the full score matrix is never materialized.  T must
+    be divisible by the block sizes (pad and mask upstream otherwise —
+    same contract as the reference's fused kernels).  The backward pass
+    recomputes blockwise (flash strategy), so memory stays O(T * block).
+    """
+    from ..ndarray.ndarray import NDArray
+
+    def f(qd, kd, vd):
+        return _flash(qd, kd, vd, causal, scale, block_q, block_k,
+                      interpret)
+
+    if any(isinstance(a, NDArray) for a in (q, k, v)):
+        return invoke(f, (q, k, v), name="flash_attention")
+    return f(q, k, v)

@@ -1,0 +1,81 @@
+"""Flash attention Pallas kernel vs dense oracle (interpret mode on CPU)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.pallas_kernels import flash_attention
+
+
+def _dense(q, k, v, causal=False, scale=None):
+    d = q.shape[-1]
+    sc = d ** -0.5 if scale is None else scale
+    s = onp.einsum("bhqd,bhkd->bhqk", q, k) * sc
+    if causal:
+        t = s.shape[-1]
+        mask = onp.tril(onp.ones((t, t), bool))
+        s = onp.where(mask, s, -1e30)
+    s = s - s.max(-1, keepdims=True)
+    p = onp.exp(s)
+    p /= p.sum(-1, keepdims=True)
+    return onp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(causal):
+    onp.random.seed(0)
+    b, h, t, d = 2, 3, 64, 16
+    q = onp.random.randn(b, h, t, d).astype(onp.float32)
+    k = onp.random.randn(b, h, t, d).astype(onp.float32)
+    v = onp.random.randn(b, h, t, d).astype(onp.float32)
+    out = flash_attention(mx.np.array(q), mx.np.array(k), mx.np.array(v),
+                          causal=causal, block_q=32, block_k=16)
+    expect = _dense(q, k, v, causal=causal)
+    assert onp.allclose(out.asnumpy(), expect, atol=2e-5), \
+        onp.abs(out.asnumpy() - expect).max()
+
+
+def test_flash_gradients_match_dense():
+    """The custom VJP (blockwise recompute) must equal dense-attention
+    gradients."""
+    onp.random.seed(1)
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import autograd
+    qn = onp.random.randn(1, 2, 32, 8).astype(onp.float32)
+    kn = onp.random.randn(1, 2, 32, 8).astype(onp.float32)
+    vn = onp.random.randn(1, 2, 32, 8).astype(onp.float32)
+    q, k, v = (mx.np.array(a) for a in (qn, kn, vn))
+    for a in (q, k, v):
+        a.attach_grad()
+    with autograd.record():
+        loss = (flash_attention(q, k, v, causal=True, block_q=16,
+                                block_k=16) ** 2).sum()
+    loss.backward()
+
+    def dense_loss(qj, kj, vj):
+        d = qj.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", qj, kj) * d ** -0.5
+        t = s.shape[-1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return (jnp.einsum("bhqk,bhkd->bhqd", p, vj) ** 2).sum()
+
+    gq, gk, gv = jax.grad(dense_loss, argnums=(0, 1, 2))(qn, kn, vn)
+    for got, expect in [(q.grad, gq), (k.grad, gk), (v.grad, gv)]:
+        assert onp.allclose(got.asnumpy(), onp.asarray(expect), atol=1e-3), \
+            onp.abs(got.asnumpy() - onp.asarray(expect)).max()
+
+
+def test_flash_rejects_indivisible_length():
+    q = mx.np.ones((1, 1, 50, 8))
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, q, q, block_q=32, block_k=32)
+
+
+def test_flash_small_sequence_blocks_clamp():
+    # T smaller than the default blocks: clamps to T
+    q = mx.np.ones((1, 1, 8, 4))
+    out = flash_attention(q, q, q)
+    assert out.shape == (1, 1, 8, 4)
