@@ -3,9 +3,10 @@ from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
 from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
                       IntervalSampler, FilterSampler)
 from .dataloader import DataLoader, default_batchify_fn
+from . import batchify
 from . import vision
 
 __all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
            "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
            "IntervalSampler", "FilterSampler", "DataLoader",
-           "default_batchify_fn", "vision"]
+           "default_batchify_fn", "batchify", "vision"]

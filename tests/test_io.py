@@ -138,3 +138,38 @@ def test_prefetchingiter_propagates_worker_error():
     with pytest.raises(ValueError, match="corrupt row"):
         next(iter(it))
     it.close()
+
+
+def test_batchify_stack_pad_group():
+    """gluon.data.batchify collate functions (reference batchify.py)."""
+    from mxnet_tpu.gluon.data import DataLoader, batchify
+    from mxnet_tpu.gluon.data.dataset import SimpleDataset
+
+    st = batchify.Stack()([onp.ones((2, 3)), onp.zeros((2, 3))])
+    assert st.shape == (2, 2, 3)  # numpy out: workers stay host-side
+
+    seqs = [onp.array([1, 2, 3]), onp.array([4]), onp.array([5, 6])]
+    padded, lengths = batchify.Pad(pad_val=-1, ret_length=True)(seqs)
+    assert padded.shape == (3, 3)
+    assert padded[1].tolist() == [4, -1, -1]
+    assert lengths.tolist() == [3, 1, 2]
+
+    # negative axis pads the right dimension
+    mats = [onp.ones((2, 3)), onp.ones((2, 5))]
+    pm = batchify.Pad(axis=-1)(mats)
+    assert pm.shape == (2, 2, 5)
+    assert pm[0, :, 3:].sum() == 0  # padded tail
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="fields"):
+        batchify.Group(batchify.Stack())([(1, 2)])
+
+    # Group: variable-length tokens + scalar label through a DataLoader
+    ds = SimpleDataset([(onp.arange(n + 1, dtype="float32"), float(n))
+                        for n in range(7)])
+    dl = DataLoader(ds, batch_size=3,
+                    batchify_fn=batchify.Group(batchify.Pad(pad_val=0),
+                                               batchify.Stack()))
+    tokens, labels = next(iter(dl))
+    assert tokens.shape == (3, 3)  # padded to the longest in batch
+    assert labels.shape == (3,)
