@@ -15,7 +15,8 @@ __all__ = [
     "EvalMetric", "create", "register", "CompositeEvalMetric", "Accuracy",
     "TopKAccuracy", "F1", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy",
     "NegativeLogLikelihood", "PearsonCorrelation", "Perplexity", "Loss",
-    "CustomMetric", "np",
+    "CustomMetric", "Fbeta", "BinaryAccuracy", "MeanPairwiseDistance",
+    "MeanCosineSimilarity", "PCC", "np",
 ]
 
 
@@ -79,6 +80,8 @@ register = registry.get_register_func(EvalMetric, "metric")
 
 
 def create(metric, *args, **kwargs):
+    if isinstance(metric, EvalMetric):
+        return metric  # reference create(): instances pass through
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
     if isinstance(metric, list):
@@ -210,11 +213,12 @@ class _BinaryClassificationCounts:
 @register
 class F1(EvalMetric):
     def __init__(self, name="f1", output_names=None, label_names=None,
-                 average="macro", threshold=0.5):
+                 average="macro", threshold=0.5, **kwargs):
         self.average = average
         self.threshold = threshold
         self._counts = _BinaryClassificationCounts()
-        super().__init__(name, output_names, label_names)
+        super().__init__(name, output_names, label_names, average=average,
+                         threshold=threshold, **kwargs)
 
     def update(self, labels, preds):
         labels, preds = _to_lists(labels, preds)
@@ -429,3 +433,133 @@ def np(numpy_feval, name="custom", allow_extra_outputs=False):
 
     feval.__name__ = getattr(numpy_feval, "__name__", "feval")
     return CustomMetric(feval, name, allow_extra_outputs)
+
+
+@register
+class Fbeta(F1):
+    """F-beta score (reference metric.py Fbeta): recall weighted beta^2
+    over precision."""
+
+    def __init__(self, name="fbeta", output_names=None, label_names=None,
+                 beta=1.0, threshold=0.5):
+        super().__init__(name, output_names, label_names, beta=beta,
+                         threshold=threshold)
+        self.beta = beta
+
+    def get(self):
+        if self._counts.total == 0:
+            return (self.name, float("nan"))
+        p, r = self._counts.precision, self._counts.recall
+        b2 = self.beta ** 2
+        d = b2 * p + r
+        return (self.name, (1 + b2) * p * r / d if d else 0.0)
+
+
+@register
+class BinaryAccuracy(EvalMetric):
+    """Accuracy over thresholded binary predictions (reference
+    metric.py BinaryAccuracy)."""
+
+    def __init__(self, name="binary_accuracy", output_names=None,
+                 label_names=None, threshold=0.5):
+        super().__init__(name, output_names, label_names,
+                         threshold=threshold)
+        self.threshold = threshold
+
+    def update(self, labels, preds):
+        labels, preds = _to_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).reshape(-1)
+            pred_label = (_as_numpy(pred).reshape(-1) > self.threshold)
+            self.sum_metric += float(
+                (pred_label == (label > 0.5)).sum())
+            self.num_inst += len(label)
+
+
+@register
+class MeanPairwiseDistance(EvalMetric):
+    """Mean p-norm distance between prediction and label vectors
+    (reference metric.py MeanPairwiseDistance)."""
+
+    def __init__(self, name="mpd", output_names=None, label_names=None,
+                 p=2):
+        super().__init__(name, output_names, label_names, p=p)
+        self.p = p
+
+    def update(self, labels, preds):
+        labels, preds = _to_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred).reshape(label.shape)
+            d = (onp.abs(pred - label) ** self.p).sum(-1) ** (1.0 / self.p)
+            self.sum_metric += float(d.sum())
+            self.num_inst += d.size
+
+
+@register
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis (reference metric.py
+    MeanCosineSimilarity)."""
+
+    def __init__(self, name="cos_sim", output_names=None, label_names=None,
+                 eps=1e-12):
+        super().__init__(name, output_names, label_names, eps=eps)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = _to_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred).reshape(label.shape)
+            num = (label * pred).sum(-1)
+            den = onp.linalg.norm(label, axis=-1) * \
+                onp.linalg.norm(pred, axis=-1)
+            sim = num / onp.maximum(den, self.eps)
+            self.sum_metric += float(sim.sum())
+            self.num_inst += sim.size
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation via the confusion matrix (reference
+    metric.py PCC — the k-category generalization of MCC)."""
+
+    def __init__(self, name="pcc", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        self._cm = None
+
+    def reset(self):
+        self._cm = None
+        super().reset()
+
+    def update(self, labels, preds):
+        labels, preds = _to_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).reshape(-1).astype(onp.int64)
+            pred = _as_numpy(pred)
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred_label = onp.argmax(pred, axis=-1).reshape(-1)
+            else:
+                pred_label = (pred.reshape(-1) > 0.5).astype(onp.int64)
+            k = int(max(label.max(), pred_label.max())) + 1
+            if self._cm is None:
+                self._cm = onp.zeros((k, k), onp.float64)
+            elif self._cm.shape[0] < k:
+                grown = onp.zeros((k, k), onp.float64)
+                grown[:self._cm.shape[0], :self._cm.shape[1]] = self._cm
+                self._cm = grown
+            onp.add.at(self._cm, (label, pred_label), 1)
+            self.num_inst = 1  # get() computes from the matrix
+
+    def get(self):
+        if self._cm is None:
+            return (self.name, float("nan"))
+        cm = self._cm
+        n = cm.sum()
+        t = cm.sum(axis=1)  # true counts
+        p = cm.sum(axis=0)  # predicted counts
+        c = onp.trace(cm)
+        num = c * n - (t * p).sum()
+        den = onp.sqrt(n * n - (p * p).sum()) * \
+            onp.sqrt(n * n - (t * t).sum())
+        return (self.name, float(num / den) if den else 0.0)

@@ -1,0 +1,63 @@
+"""Tests for the remaining metric classes (reference metric.py set)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import metric as M
+
+
+def test_fbeta_recovers_f1_and_weights_recall():
+    y = mx.np.array([1, 0, 1, 1])
+    p = mx.np.array([0.9, 0.8, 0.7, 0.2])  # tp=2 fp=1 fn=1
+    f1 = M.F1()
+    f1.update(y, p)
+    fb1 = M.Fbeta(beta=1.0)
+    fb1.update(y, p)
+    assert fb1.get()[1] == pytest.approx(f1.get()[1])
+    fb2 = M.Fbeta(beta=2.0)
+    fb2.update(y, p)
+    # precision == recall here (2/3), so any beta gives the same value
+    assert fb2.get()[1] == pytest.approx(2 / 3)
+
+
+def test_binary_accuracy():
+    m = M.BinaryAccuracy(threshold=0.6)
+    m.update(mx.np.array([1, 0, 1, 0]), mx.np.array([0.7, 0.2, 0.5, 0.9]))
+    assert m.get()[1] == pytest.approx(0.5)  # hits: idx0, idx1
+
+
+def test_mean_pairwise_distance_and_cosine():
+    a = onp.array([[1.0, 0.0], [0.0, 2.0]], "float32")
+    b = onp.array([[0.0, 0.0], [0.0, 2.0]], "float32")
+    mpd = M.MeanPairwiseDistance()
+    mpd.update(mx.np.array(a), mx.np.array(b))
+    assert mpd.get()[1] == pytest.approx(0.5)  # (1 + 0) / 2
+
+    cs = M.MeanCosineSimilarity()
+    cs.update(mx.np.array([[1.0, 0.0]]), mx.np.array([[1.0, 1.0]]))
+    assert cs.get()[1] == pytest.approx(1 / onp.sqrt(2), abs=1e-6)
+
+
+def test_pcc_multiclass_matches_mcc_binary():
+    y = mx.np.array([1, 0, 1, 1, 0, 1])
+    p = mx.np.array([[0.2, 0.8], [0.7, 0.3], [0.3, 0.7],
+                     [0.6, 0.4], [0.8, 0.2], [0.1, 0.9]])
+    mcc = M.MCC()
+    mcc.update(y, p)
+    pcc = M.PCC()
+    pcc.update(y, p)
+    assert pcc.get()[1] == pytest.approx(mcc.get()[1], abs=1e-6)
+    # 3-class case runs and is bounded
+    y3 = mx.np.array([0, 1, 2, 2, 1])
+    p3 = mx.np.array([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1], [0.1, 0.1, 0.8],
+                      [0.8, 0.1, 0.1], [0.1, 0.8, 0.1]])
+    pcc3 = M.PCC()
+    pcc3.update(y3, p3)
+    assert -1.0 <= pcc3.get()[1] <= 1.0
+
+
+def test_metric_registry_create():
+    for name in ["fbeta", "binaryaccuracy", "meanpairwisedistance",
+                 "meancosinesimilarity", "pcc"]:
+        m = M.create(name)
+        assert isinstance(m, M.EvalMetric), name
