@@ -48,12 +48,23 @@ class MultiHeadAttention(HybridBlock):
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
-                 dtype="float32"):
+                 dtype="float32", use_flash=False):
         super().__init__()
         assert units % num_heads == 0, "num_heads must divide units"
+        # opt-in Pallas flash kernel for sequences where the (T, T) score
+        # matrix is the memory wall; XLA's fused dense attention is faster
+        # at moderate T (see ops/pallas_kernels.py).  The kernel computes
+        # unmasked softmax over dense blocks, so it excludes attention
+        # masks and attention-dropout, and T must be <=128 or a multiple
+        # of 128.
+        if use_flash and dropout > 0:
+            raise ValueError(
+                "use_flash does not support attention dropout; set "
+                "dropout=0 (residual/FFN dropout is unaffected)")
         self._units = units
         self._num_heads = num_heads
         self._head_dim = units // num_heads
+        self._use_flash = use_flash
         init_std = init.Normal(0.02)
         self.query = nn.Dense(units, flatten=False, use_bias=use_bias,
                               weight_initializer=init_std, dtype=dtype)
@@ -71,6 +82,20 @@ class MultiHeadAttention(HybridBlock):
         q = self.query(x).reshape(b, t, h, d)
         k = self.key(x).reshape(b, t, h, d)
         v = self.value(x).reshape(b, t, h, d)
+        if self._use_flash:
+            if mask is not None:
+                raise ValueError(
+                    "use_flash=True cannot apply attention masks (the "
+                    "kernel softmaxes dense blocks); drop the mask or pad "
+                    "to full length upstream")
+            if t > 128 and t % 128:
+                raise ValueError(
+                    f"use_flash requires seq length <=128 or a multiple "
+                    f"of 128, got {t}")
+            out = npx.flash_attention(q.swapaxes(1, 2), k.swapaxes(1, 2),
+                                      v.swapaxes(1, 2))
+            out = out.swapaxes(1, 2).reshape(b, t, h * d)
+            return self.proj(out)
         scores = np.einsum("bthd,bshd->bhts", q, k) / math.sqrt(d)
         if mask is not None:
             # mask: (b, s) valid-token mask or (b, t, s) attention mask
@@ -106,10 +131,11 @@ class TransformerEncoderLayer(HybridBlock):
     """Post-norm (BERT-style) encoder layer."""
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 layer_norm_eps=1e-12, dtype="float32"):
+                 layer_norm_eps=1e-12, dtype="float32", use_flash=False):
         super().__init__()
-        self.attention = MultiHeadAttention(units, num_heads, dropout=dropout,
-                                            dtype=dtype)
+        self.attention = MultiHeadAttention(
+            units, num_heads, dropout=0.0 if use_flash else dropout,
+            dtype=dtype, use_flash=use_flash)
         self.attn_ln = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
         self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
                                    dtype=dtype)
@@ -124,7 +150,8 @@ class TransformerEncoderLayer(HybridBlock):
 
 class TransformerEncoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads,
-                 dropout=0.0, layer_norm_eps=1e-12, dtype="float32"):
+                 dropout=0.0, layer_norm_eps=1e-12, dtype="float32",
+                 use_flash=False):
         super().__init__()
         self._num_layers = num_layers
         for i in range(num_layers):
@@ -132,7 +159,8 @@ class TransformerEncoder(HybridBlock):
                     TransformerEncoderLayer(units, hidden_size, num_heads,
                                             dropout=dropout,
                                             layer_norm_eps=layer_norm_eps,
-                                            dtype=dtype))
+                                            dtype=dtype,
+                                            use_flash=use_flash))
 
     def forward(self, x, mask=None):
         for i in range(self._num_layers):
@@ -147,7 +175,7 @@ class BertModel(HybridBlock):
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
                  num_segments=2, dropout=0.1, layer_norm_eps=1e-12,
-                 dtype="float32"):
+                 dtype="float32", use_flash=False):
         super().__init__()
         self._units = units
         init_std = init.Normal(0.02)
@@ -164,7 +192,7 @@ class BertModel(HybridBlock):
         self.encoder = TransformerEncoder(num_layers, units, hidden_size,
                                           num_heads, dropout=dropout,
                                           layer_norm_eps=layer_norm_eps,
-                                          dtype=dtype)
+                                          dtype=dtype, use_flash=use_flash)
         self.pooler = nn.Dense(units, flatten=False, activation="tanh",
                                weight_initializer=init_std, dtype=dtype)
 

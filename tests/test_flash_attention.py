@@ -74,6 +74,24 @@ def test_flash_rejects_indivisible_length():
         flash_attention(q, q, q, block_q=32, block_k=32)
 
 
+def test_mha_use_flash_matches_einsum_path():
+    """MultiHeadAttention(use_flash=True) equals the einsum path."""
+    from mxnet_tpu.models import MultiHeadAttention
+    onp.random.seed(2)
+    x = mx.np.array(onp.random.randn(2, 32, 16).astype(onp.float32))
+    a = MultiHeadAttention(16, 4, dropout=0.0)
+    a.initialize()
+    b = MultiHeadAttention(16, 4, dropout=0.0, use_flash=True)
+    b.initialize()
+    a(x)  # materialize deferred shapes before copying weights
+    b(x)
+    for name, p in a.collect_params().items():
+        b.collect_params()[name].set_data(p.data())
+    ya = a(x).asnumpy()
+    yb = b(x).asnumpy()
+    assert onp.allclose(ya, yb, atol=2e-5), onp.abs(ya - yb).max()
+
+
 def test_flash_small_sequence_blocks_clamp():
     # T smaller than the default blocks: clamps to T
     q = mx.np.ones((1, 1, 8, 4))
