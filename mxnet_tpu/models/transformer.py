@@ -88,10 +88,8 @@ class MultiHeadAttention(HybridBlock):
                     "use_flash=True cannot apply attention masks (the "
                     "kernel softmaxes dense blocks); drop the mask or pad "
                     "to full length upstream")
-            if t > 128 and t % 128:
-                raise ValueError(
-                    f"use_flash requires seq length <=128 or a multiple "
-                    f"of 128, got {t}")
+            # length validation lives in the kernel (single source of
+            # truth: _flash_forward's divisibility check)
             out = npx.flash_attention(q.swapaxes(1, 2), k.swapaxes(1, 2),
                                       v.swapaxes(1, 2))
             out = out.swapaxes(1, 2).reshape(b, t, h * d)
@@ -133,9 +131,11 @@ class TransformerEncoderLayer(HybridBlock):
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
                  layer_norm_eps=1e-12, dtype="float32", use_flash=False):
         super().__init__()
-        self.attention = MultiHeadAttention(
-            units, num_heads, dropout=0.0 if use_flash else dropout,
-            dtype=dtype, use_flash=use_flash)
+        # dropout propagates unchanged: with use_flash MHA raises its
+        # explicit attention-dropout error rather than silently diverging
+        self.attention = MultiHeadAttention(units, num_heads,
+                                            dropout=dropout, dtype=dtype,
+                                            use_flash=use_flash)
         self.attn_ln = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
         self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
                                    dtype=dtype)

@@ -1,0 +1,138 @@
+"""Broad mx.np vs numpy oracle sweep.
+
+Reference strategy: `tests/python/unittest/test_numpy_op.py` — every op is
+checked against NumPy on random inputs.  One parametrized sweep covers the
+unary/binary/reduction surface; shape/broadcast behavior rides along.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+onp.random.seed(42)
+_X = onp.random.uniform(0.1, 2.0, (3, 4)).astype("float32")
+_Y = onp.random.uniform(0.1, 2.0, (3, 4)).astype("float32")
+_ROW = onp.random.uniform(0.1, 2.0, (4,)).astype("float32")
+_SIGNED = onp.random.uniform(-2.0, 2.0, (3, 4)).astype("float32")
+
+_UNARY = [
+    "sqrt", "square", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "sin", "cos", "tan", "tanh", "sinh", "cosh", "arctan", "arcsinh",
+    "cbrt", "reciprocal", "floor", "ceil", "trunc", "rint", "sign",
+    "negative", "abs", "degrees", "radians",
+]
+_BINARY = [
+    "add", "subtract", "multiply", "divide", "power", "maximum", "minimum",
+    "hypot", "arctan2", "logaddexp", "fmod", "copysign",
+]
+_REDUCE = ["sum", "prod", "mean", "std", "var", "max", "min", "median"]
+
+
+@pytest.mark.parametrize("name", _UNARY)
+def test_unary_matches_numpy(name):
+    x = _SIGNED if name in ("sign", "negative", "abs", "floor", "ceil",
+                            "trunc", "rint", "arctan", "arcsinh",
+                            "tanh", "sin", "cos", "tan") else _X
+    got = getattr(mx.np, name)(mx.np.array(x)).asnumpy()
+    expect = getattr(onp, name)(x)
+    assert onp.allclose(got, expect, rtol=2e-5, atol=2e-6), name
+
+
+@pytest.mark.parametrize("name", _BINARY)
+def test_binary_matches_numpy_with_broadcast(name):
+    got = getattr(mx.np, name)(mx.np.array(_X), mx.np.array(_ROW)).asnumpy()
+    expect = getattr(onp, name)(_X, _ROW)
+    assert onp.allclose(got, expect, rtol=2e-5, atol=2e-6), name
+    # scalar rhs
+    got_s = getattr(mx.np, name)(mx.np.array(_X), 1.5).asnumpy()
+    assert onp.allclose(got_s, getattr(onp, name)(_X, 1.5), rtol=2e-5), name
+
+
+@pytest.mark.parametrize("name", _REDUCE)
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_reductions_match_numpy(name, axis):
+    got = getattr(mx.np, name)(mx.np.array(_X), axis=axis).asnumpy()
+    expect = getattr(onp, name)(_X, axis=axis)
+    assert onp.allclose(got, expect, rtol=2e-5, atol=2e-6), (name, axis)
+    if axis is not None:
+        got_k = getattr(mx.np, name)(mx.np.array(_X), axis=axis,
+                                     keepdims=True).asnumpy()
+        assert got_k.shape == getattr(onp, name)(
+            _X, axis=axis, keepdims=True).shape
+
+
+def test_shape_manipulation_matches_numpy():
+    x = onp.arange(24, dtype="float32").reshape(2, 3, 4)
+    mxx = mx.np.array(x)
+    pairs = [
+        (mx.np.transpose(mxx), onp.transpose(x)),
+        (mx.np.swapaxes(mxx, 0, 2), onp.swapaxes(x, 0, 2)),
+        (mx.np.moveaxis(mxx, 0, -1), onp.moveaxis(x, 0, -1)),
+        (mx.np.flip(mxx, axis=1), onp.flip(x, axis=1)),
+        (mx.np.roll(mxx, 2, axis=2), onp.roll(x, 2, axis=2)),
+        (mx.np.tile(mxx, (1, 2, 1)), onp.tile(x, (1, 2, 1))),
+        (mx.np.repeat(mxx, 2, axis=1), onp.repeat(x, 2, axis=1)),
+        (mx.np.concatenate([mxx, mxx], axis=0),
+         onp.concatenate([x, x], axis=0)),
+        (mx.np.stack([mxx, mxx], axis=1), onp.stack([x, x], axis=1)),
+        (mx.np.squeeze(mxx[None]), onp.squeeze(x[None])),
+        (mx.np.pad(mxx, ((0, 0), (1, 1), (0, 2))),
+         onp.pad(x, ((0, 0), (1, 1), (0, 2)))),
+    ]
+    for got, expect in pairs:
+        assert onp.array_equal(got.asnumpy(), expect)
+
+
+def test_linalg_family_matches_numpy():
+    a = onp.random.rand(4, 4).astype("float32") + 4 * onp.eye(4, dtype="float32")
+    b = onp.random.rand(4, 2).astype("float32")
+    ma, mb = mx.np.array(a), mx.np.array(b)
+    assert onp.allclose(mx.np.linalg.solve(ma, mb).asnumpy(),
+                        onp.linalg.solve(a, b), atol=1e-4)
+    assert onp.allclose(mx.np.linalg.inv(ma).asnumpy(), onp.linalg.inv(a),
+                        atol=1e-4)
+    assert mx.np.linalg.det(ma).asnumpy() == pytest.approx(
+        onp.linalg.det(a), rel=1e-4)
+    q, r = mx.np.linalg.qr(ma)
+    assert onp.allclose((q.asnumpy() @ r.asnumpy()), a, atol=1e-4)
+    assert onp.allclose(
+        mx.np.einsum("ij,jk->ik", ma, mb).asnumpy(), a @ b, atol=1e-4)
+
+
+def test_sort_search_matches_numpy():
+    x = onp.random.rand(5, 6).astype("float32")
+    mxx = mx.np.array(x)
+    assert onp.array_equal(mx.np.sort(mxx, axis=1).asnumpy(),
+                           onp.sort(x, axis=1))
+    assert onp.array_equal(mx.np.argsort(mxx, axis=0).asnumpy(),
+                           onp.argsort(x, axis=0))
+    assert onp.array_equal(mx.np.argmax(mxx, axis=1).asnumpy(),
+                           onp.argmax(x, axis=1))
+    u = onp.array([3, 1, 3, 2, 1], "float32")
+    assert onp.array_equal(mx.np.unique(mx.np.array(u)).asnumpy(),
+                           onp.unique(u))
+    assert onp.array_equal(
+        mx.np.searchsorted(mx.np.array([1.0, 2, 3]),
+                           mx.np.array([1.5, 2.5])).asnumpy(),
+        onp.searchsorted(onp.array([1.0, 2, 3]), onp.array([1.5, 2.5])))
+
+
+def test_gradients_of_sampled_unary_ops():
+    """Autograd sanity across the generated op table (d/dx matches the
+    analytic derivative for a sample of ops)."""
+    from mxnet_tpu import autograd
+    cases = [
+        ("exp", lambda x: onp.exp(x)),
+        ("log", lambda x: 1 / x),
+        ("sqrt", lambda x: 0.5 / onp.sqrt(x)),
+        ("tanh", lambda x: 1 - onp.tanh(x) ** 2),
+        ("square", lambda x: 2 * x),
+    ]
+    for name, dfn in cases:
+        x = mx.np.array(_X.copy())
+        x.attach_grad()
+        with autograd.record():
+            y = getattr(mx.np, name)(x).sum()
+        y.backward()
+        assert onp.allclose(x.grad.asnumpy(), dfn(_X), rtol=1e-4,
+                            atol=1e-5), name
