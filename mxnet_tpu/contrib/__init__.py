@@ -1,15 +1,17 @@
 """Contrib namespace (reference: `python/mxnet/contrib/` and the
 `_contrib_*` op family in `src/operator/contrib/`)."""
 from ..ops.contrib import (box_iou, box_nms, bipartite_matching, roi_align,
-                           multibox_prior, multibox_detection, boolean_mask,
-                           allclose, index_copy, index_array)
+                           multibox_prior, multibox_target,
+                           multibox_detection, boolean_mask, allclose,
+                           index_copy, index_array)
 from . import text
 
 # reference CamelCase aliases (mx.nd.contrib.ROIAlign)
 ROIAlign = roi_align
 MultiBoxDetection = multibox_detection
 MultiBoxPrior = multibox_prior
+MultiBoxTarget = multibox_target
 
 __all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
-           "ROIAlign", "multibox_prior", "MultiBoxPrior", "multibox_detection", "MultiBoxDetection",
+           "ROIAlign", "multibox_prior", "MultiBoxPrior", "multibox_target", "MultiBoxTarget", "multibox_detection", "MultiBoxDetection",
            "boolean_mask", "allclose", "index_copy", "index_array"]

@@ -22,8 +22,8 @@ from jax import lax
 from .invoke import invoke
 
 __all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
-           "multibox_prior", "multibox_detection", "boolean_mask",
-           "allclose", "index_copy", "index_array"]
+           "multibox_prior", "multibox_target", "multibox_detection",
+           "boolean_mask", "allclose", "index_copy", "index_array"]
 
 
 def _corner(boxes, fmt):
@@ -271,6 +271,91 @@ def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
             boxes = jnp.clip(boxes, 0.0, 1.0)
         return boxes[None]
     return invoke(f, (data,), name="multibox_prior", differentiable=False)
+
+
+def multibox_target(anchor, label, cls_pred=None, overlap_threshold=0.5,
+                    ignore_label=-1, negative_mining_ratio=-1,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training-target assignment (reference `_contrib_MultiBoxTarget`,
+    `src/operator/contrib/multibox_target.cc`).
+
+    anchor: (1, N, 4) corner priors; label: (B, M, 5) rows of
+    [class_id, x1, y1, x2, y2] with -1 padding rows.  Returns
+    (loc_target (B, N*4), loc_mask (B, N*4), cls_target (B, N)) where
+    cls_target is 0 for background, class_id+1 for matched anchors.
+
+    Matching is the reference's two-stage rule: ground truths claim
+    anchors by greedy bipartite matching on IoU (each GT gets its best
+    still-free anchor), then every anchor whose best-GT IoU exceeds
+    `overlap_threshold` joins.  Hard negative mining and `ignore_label`
+    are loss-side sampling concerns on TPU (mask in the loss instead) —
+    both parameters are accepted for API parity and unused.
+    """
+    del cls_pred, negative_mining_ratio, ignore_label  # loss-side on TPU
+    vx, vy, vw, vh = variances
+
+    def one(an, lb):
+        n = an.shape[0]
+        m = lb.shape[0]
+        valid_gt = lb[:, 0] >= 0                       # (M,)
+        iou = _pairwise_iou(an, lb[:, 1:5])            # (N, M)
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+
+        # stage 1: greedy bipartite matching (reference MultiBoxTarget):
+        # repeatedly take the globally best still-free (anchor, gt) pair,
+        # so two GTs sharing a best anchor both get matched and pad rows
+        # can never clobber a claim
+        iou_m = jnp.where(valid_gt[None, :], iou, -2.0)
+
+        def claim_step(carry, _):
+            claimed_c, mat = carry
+            flat = jnp.argmax(mat)
+            i, j = flat // m, flat % m
+            ok = mat[i, j] > -1.5  # a valid gt column remains
+            claimed_c = jnp.where(ok, claimed_c.at[i].set(j), claimed_c)
+            mat = mat.at[i, :].set(-2.0).at[:, j].set(-2.0)
+            return (claimed_c, mat), None
+
+        (claimed, _), _ = lax.scan(
+            claim_step, (jnp.zeros(n, jnp.int32) - 1, iou_m), None,
+            length=m)
+        # stage 2: anchors above the overlap threshold join their best gt
+        best_gt = jnp.argmax(iou, axis=1)              # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        matched_gt = jnp.where(claimed >= 0, claimed,
+                               jnp.where(best_iou > overlap_threshold,
+                                         best_gt, -1))
+
+        gt = lb[jnp.clip(matched_gt, 0, max(m - 1, 0))]
+        is_fg = matched_gt >= 0
+        cls_target = jnp.where(is_fg, gt[:, 0] + 1, 0.0)
+
+        # encode regression targets against the matched anchor (center
+        # form); clamp so degenerate zero-area anchors cannot emit inf/nan
+        aw = jnp.maximum(an[:, 2] - an[:, 0], 1e-12)
+        ah = jnp.maximum(an[:, 3] - an[:, 1], 1e-12)
+        ax = (an[:, 0] + an[:, 2]) / 2
+        ay = (an[:, 1] + an[:, 3]) / 2
+        gw = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-12)
+        gh = jnp.maximum(gt[:, 4] - gt[:, 2], 1e-12)
+        gx = (gt[:, 1] + gt[:, 3]) / 2
+        gy = (gt[:, 2] + gt[:, 4]) / 2
+        tx = (gx - ax) / aw / vx
+        ty = (gy - ay) / ah / vy
+        tw = jnp.log(gw / aw) / vw
+        th = jnp.log(gh / ah) / vh
+        loc = jnp.stack([tx, ty, tw, th], axis=1)      # (N, 4)
+        loc = jnp.where(is_fg[:, None], loc, 0.0).reshape(-1)
+        mask = jnp.where(is_fg[:, None],
+                         jnp.ones((n, 4), loc.dtype), 0.0).reshape(-1)
+        return loc, mask, cls_target
+
+    def f(an, lb):
+        an2 = an[0] if an.ndim == 3 else an
+        locs, masks, cls_ts = jax.vmap(lambda l: one(an2, l))(lb)
+        return locs, masks, cls_ts
+    return invoke(f, (anchor, label), name="multibox_target",
+                  differentiable=False)
 
 
 def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,

@@ -228,3 +228,56 @@ def test_roi_align_gradient_flows():
     g = img.grad.asnumpy()
     assert g.shape == img.shape
     assert g.sum() > 0  # gradient lands on sampled pixels
+
+
+def test_multibox_target():
+    anchors = onp.array([[[0.0, 0.0, 0.2, 0.2],
+                          [0.0, 0.0, 0.4, 0.4],
+                          [0.5, 0.5, 0.9, 0.9],
+                          [0.6, 0.6, 0.8, 0.8]]], dtype="float32")
+    # one gt overlapping anchors 0/1, one overlapping 2/3, one pad row
+    label = onp.array([[[1, 0.0, 0.0, 0.38, 0.38],
+                        [0, 0.55, 0.55, 0.85, 0.85],
+                        [-1, 0, 0, 0, 0]]], dtype="float32")
+    loc_t, loc_mask, cls_t = contrib.multibox_target(
+        mx.np.array(anchors), mx.np.array(label), overlap_threshold=0.5)
+    cls = cls_t.asnumpy()[0]
+    assert cls.shape == (4,)
+    assert cls[1] == 2.0  # anchor 1 matches gt0 (class 1 -> target 2)
+    assert cls[2] == 1.0  # anchor 2 matches gt1 (class 0 -> target 1)
+    assert cls[0] == 0.0  # low-iou anchor stays background
+    mask = loc_mask.asnumpy()[0].reshape(4, 4)
+    assert mask[1].sum() == 4 and mask[0].sum() == 0
+    # encoded offsets invert back to the gt box for a matched anchor
+    t = loc_t.asnumpy()[0].reshape(4, 4)[1]
+    aw = ah = 0.4
+    ax = ay = 0.2
+    cx = t[0] * 0.1 * aw + ax
+    gw = onp.exp(t[2] * 0.2) * aw
+    assert cx == pytest.approx(0.19, abs=1e-5)
+    assert gw == pytest.approx(0.38, abs=1e-5)
+
+
+def test_multibox_target_pad_rows_and_shared_best_anchor():
+    # pad row must not clobber a claim on anchor 0, and two GTs whose best
+    # anchor coincides must BOTH get matched (bipartite stage 1)
+    anchors = onp.array([[[0.0, 0.0, 0.5, 0.5],
+                          [0.9, 0.9, 1.0, 1.0]]], dtype="float32")
+    label = onp.array([[[1, 0.1, 0.1, 0.2, 0.2],
+                        [0, 0.3, 0.3, 0.45, 0.45],
+                        [-1, 0, 0, 0, 0]]], dtype="float32")
+    _lt, _lm, cls_t = contrib.multibox_target(
+        mx.np.array(anchors), mx.np.array(label), overlap_threshold=0.9)
+    cls = sorted(cls_t.asnumpy()[0].tolist())
+    # both GTs matched (classes 1 and 2 as targets 1+1=2 and 0+1=1)
+    assert cls == [1.0, 2.0], cls
+
+
+def test_multibox_target_every_gt_gets_an_anchor():
+    # a gt with IoU below threshold against everything still claims its best
+    anchors = onp.array([[[0.0, 0.0, 0.1, 0.1],
+                          [0.9, 0.9, 1.0, 1.0]]], dtype="float32")
+    label = onp.array([[[3, 0.4, 0.4, 0.6, 0.6]]], dtype="float32")
+    _lt, _lm, cls_t = contrib.multibox_target(
+        mx.np.array(anchors), mx.np.array(label), overlap_threshold=0.5)
+    assert (cls_t.asnumpy()[0] == 4.0).sum() == 1  # stage-1 claim
