@@ -1,0 +1,123 @@
+"""CTC loss vs brute-force oracle, RNN modifier cells, example smoke runs."""
+import itertools
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ctc_bruteforce(logits_tnc, label, blank=0):
+    """Enumerate all T-step paths; collapse repeats then drop blanks."""
+    t, c = logits_tnc.shape
+    p = onp.exp(logits_tnc - logits_tnc.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    total = 0.0
+    for path in itertools.product(range(c), repeat=t):
+        collapsed = [k for k, _g in itertools.groupby(path)]
+        collapsed = [k for k in collapsed if k != blank]
+        if collapsed == list(label):
+            prob = 1.0
+            for step, k in enumerate(path):
+                prob *= p[step, k]
+            total += prob
+    return -onp.log(max(total, 1e-300))
+
+
+@pytest.mark.parametrize("t,label", [(1, [1]), (3, [1]), (4, [1, 2]),
+                                     (4, [2, 2])])
+def test_ctc_matches_bruteforce(t, label):
+    onp.random.seed(hash((t, tuple(label))) % 2 ** 31)
+    c = 3
+    logits = onp.random.randn(1, t, c).astype("float32")
+    lab = onp.asarray([label + [0] * (3 - len(label))], "float32")
+    loss_fn = gluon.loss.CTCLoss(layout="NTC")
+    got = float(loss_fn(
+        mx.np.array(logits), mx.np.array(lab), None,
+        mx.np.array([len(label)], dtype="int32")).asnumpy()[0])
+    expect = _ctc_bruteforce(logits[0], label)
+    assert got == pytest.approx(expect, rel=1e-4), (got, expect)
+
+
+def test_ctc_gradient_flows():
+    logits = mx.np.array(onp.random.randn(2, 5, 4).astype("float32"))
+    logits.attach_grad()
+    labels = mx.np.array([[1.0, 2.0], [3.0, 0.0]])
+    loss_fn = gluon.loss.CTCLoss()
+    with autograd.record():
+        loss = loss_fn(logits, labels, None,
+                       mx.np.array([2, 1], dtype="int32")).mean()
+    loss.backward()
+    assert float(abs(logits.grad).asnumpy().max()) > 0
+
+
+def test_modifier_cells():
+    base = rnn.LSTMCell(6, input_size=4)
+    x = mx.np.ones((2, 4))
+
+    res = rnn.ResidualCell(rnn.RNNCell(4, input_size=4))
+    res.initialize()
+    out, _ = res(x, res.base_cell.begin_state(batch_size=2))
+    inner, _ = res.base_cell(x, res.base_cell.begin_state(batch_size=2))
+    assert onp.allclose(out.asnumpy(), (inner + x).asnumpy())
+
+    drop = rnn.DropoutCell(0.9)
+    out, _ = drop(x, [])
+    assert onp.allclose(out.asnumpy(), x.asnumpy())  # predict mode: no-op
+    # training mode: dropout actually zeroes (and rescales) entries
+    big = mx.np.ones((64, 64))
+    big.attach_grad()
+    with autograd.record():
+        dout = rnn.DropoutCell(0.5)(big, [])[0]
+    arr = dout.asnumpy()
+    zeros = (arr == 0).mean()
+    assert 0.3 < zeros < 0.7, zeros
+    assert onp.allclose(arr[arr != 0], 2.0)  # inverted-dropout rescale
+
+    zo = rnn.ZoneoutCell(base, zoneout_states=0.5)
+    zo.initialize()
+    out, states = zo(x, base.begin_state(batch_size=2))
+    assert out.shape == (2, 6) and len(states) == 2
+    # training mode: states are a stochastic mix of previous and new
+    xb = mx.np.ones((128, 4))
+    prev = [mx.np.zeros((128, 6)), mx.np.zeros((128, 6))]
+    with autograd.record():
+        _o, zstates = zo(xb, prev)
+        new_h, _ = base(xb, prev)
+    zh = zstates[0].asnumpy()
+    # per-element mask: ~rate of entries zoned out to the (zero) prev state
+    zeroed = (zh == 0).mean()
+    assert 0.3 < zeroed < 0.7, zeroed
+    kept = zh != 0
+    assert onp.allclose(zh[kept], new_h.asnumpy()[kept], atol=1e-6)
+
+    seq = rnn.SequentialRNNCell()
+    seq.add(rnn.LSTMCell(5, input_size=4))
+    seq.add(rnn.GRUCell(3, input_size=5))
+    seq.initialize()
+    states = seq.begin_state(batch_size=2)
+    out, new_states = seq(x, states)
+    assert out.shape == (2, 3)
+    assert len(new_states) == len(states)
+
+
+@pytest.mark.parametrize("script,args", [
+    ("examples/gluon/mnist_mlp.py", ["--epochs", "1", "--batch-size", "256"]),
+    ("examples/rnn/word_lm.py", ["--epochs", "1", "--batch-size", "16",
+                                 "--num-hidden", "32", "--num-embed", "32",
+                                 "--num-layers", "1"]),
+    ("examples/image-classification/train_imagenet.py",
+     ["--model", "squeezenet1_1", "--batch-size", "4", "--iters", "2"]),
+])
+def test_examples_run(script, args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run([sys.executable, os.path.join(REPO, script)] + args,
+                       capture_output=True, text=True, env=env, timeout=500)
+    assert r.returncode == 0, r.stderr[-2000:]
