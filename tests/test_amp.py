@@ -1,0 +1,70 @@
+"""AMP tests (reference `tests/python/gpu/test_amp.py` strategy, bf16).
+
+amp.init() patches op namespaces globally, so it runs in a subprocess to
+keep the test session's namespaces clean.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_amp_init_casts_compute_ops_subprocess():
+    code = textwrap.dedent("""
+        import sys
+        sys.path.insert(0, %r)
+        import mxnet_tpu as mx
+        from mxnet_tpu import amp
+        amp.init()  # patch matmul-class ops to bf16
+        a = mx.np.ones((8, 8), dtype='float32')
+        out = mx.npx.fully_connected(a, mx.np.ones((4, 8)), None,
+                                     num_hidden=4)
+        assert str(out.dtype) == 'bfloat16', out.dtype
+        # elementwise ops keep f32 (only the curated list casts)
+        assert str((a + a).dtype) == 'float32'
+        # idempotent
+        amp.init()
+        print('AMP_SUBPROCESS_OK')
+    """) % (REPO,)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "AMP_SUBPROCESS_OK" in r.stdout
+
+
+def test_loss_scaler_dynamics():
+    from mxnet_tpu.amp.loss_scaler import LossScaler
+    ls = LossScaler(init_scale=256.0, scale_factor=2.0, scale_window=2)
+    s0 = ls.loss_scale
+    ls.update_scale(True)   # overflow halves
+    s1 = ls.loss_scale
+    assert s1 == s0 / 2
+    ls.update_scale(False)
+    ls.update_scale(False)  # window of clean steps doubles
+    assert ls.loss_scale == s1 * 2
+
+
+def test_scale_loss_context():
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    scaler = trainer._amp_loss_scaler
+    scaler.loss_scale = 8.0  # make scaling observable
+    x = mx.np.ones((4, 3))
+    with autograd.record():
+        out = net(x).sum()
+        with amp.scale_loss(out, trainer) as scaled:
+            pass
+    # the scaled loss is loss * current scale
+    assert float(scaled.asnumpy()) == \
+        __import__("pytest").approx(float(out.asnumpy()) * 8.0)
