@@ -35,17 +35,24 @@ def make_mesh(axes=None, devices=None):
     n = len(devices)
     if -1 in sizes:
         known = 1
-        for s in sizes:
-            if s != -1:
-                known *= s
+        for sz in sizes:
+            if sz != -1:
+                known *= sz
+        if known > n or n % known:
+            raise ValueError(
+                f"mesh {dict(zip(names, sizes))}: the explicit axes "
+                f"({known}) must divide the device count ({n}) for -1 to "
+                "absorb the remainder")
         sizes[sizes.index(-1)] = n // known
     total = 1
     for s in sizes:
         total *= s
-    if total != n:
+    if total > n:
         raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} "
                          f"devices, have {n}")
-    dev_array = onp.asarray(devices).reshape(sizes)
+    # a mesh may use a subset of devices (e.g. a 4-stage pipeline on an
+    # 8-device host); take the first `total`
+    dev_array = onp.asarray(devices[:total]).reshape(sizes)
     return Mesh(dev_array, tuple(names))
 
 
