@@ -14,6 +14,8 @@ from ..base import MXNetError
 from ..context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from ..ndarray.ndarray import NDArray, waitall
 from ..ops import nn as _nn
+from ..ops import spatial as _spatial
+from ..ops import tensor_extra as _tex
 from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
 from ..ops.invoke import invoke, is_recording, is_training
 from ..ops.aux_scope import apply_aux_update
@@ -30,6 +32,10 @@ __all__ = [
     "adaptive_avg_pool2d", "l2_normalization", "waitall", "cpu", "gpu", "tpu",
     "num_gpus", "num_tpus", "current_context", "save", "load", "seed",
     "foreach", "while_loop", "cond", "flash_attention",
+    "gather_nd", "scatter_nd", "broadcast_like", "slice_like", "khatri_rao",
+    "ravel_multi_index", "unravel_index", "make_loss", "multi_all_finite",
+    "reset_arrays", "grid_generator", "bilinear_sampler",
+    "spatial_transformer", "roi_pooling", "im2col", "col2im",
 ]
 
 seed = _rng.seed
@@ -70,6 +76,42 @@ reshape_like = _op(_nn.reshape_like, "reshape_like")
 arange_like = _op(_nn.arange_like, "arange_like", differentiable=False)
 gamma = _op(_nn.gamma_fn, "gamma")
 gamma_fn = gamma
+
+
+# structural/indexing ops (reference `src/operator/tensor/indexing_op.cc`,
+# `ravel.cc`, `contrib/krprod.cc`, `make_loss.cc`, `contrib/multi_all_finite.cc`)
+gather_nd = _op(_tex.gather_nd, "gather_nd")
+scatter_nd = _op(_tex.scatter_nd, "scatter_nd")
+broadcast_like = _op(_tex.broadcast_like, "broadcast_like")
+slice_like = _op(_tex.slice_like, "slice_like")
+khatri_rao = _op(_tex.khatri_rao, "khatri_rao")
+ravel_multi_index = _op(_tex.ravel_multi_index, "ravel_multi_index",
+                        differentiable=False)
+make_loss = _op(_tex.make_loss, "make_loss")
+multi_all_finite = _op(_tex.multi_all_finite, "multi_all_finite",
+                       differentiable=False)
+
+
+unravel_index = _op(_tex.unravel_index, "unravel_index",
+                    differentiable=False)
+
+
+def reset_arrays(*arrays, num_arrays=None):
+    """Zero each array in place (reference `contrib/reset_arrays.cc`,
+    used to clear gradient buffers between iterations)."""
+    for a in arrays:
+        a[:] = 0
+
+
+# spatial transformer family (reference `grid_generator.cc`,
+# `bilinear_sampler.cc`, `spatial_transformer.cc`, `roi_pooling.cc`,
+# `nn/im2col.h`)
+grid_generator = _op(_spatial.grid_generator, "grid_generator")
+bilinear_sampler = _op(_spatial.bilinear_sampler, "bilinear_sampler")
+spatial_transformer = _op(_spatial.spatial_transformer, "spatial_transformer")
+roi_pooling = _op(_spatial.roi_pooling, "roi_pooling")
+im2col = _op(_spatial.im2col, "im2col")
+col2im = _op(_spatial.col2im, "col2im")
 
 
 def flash_attention(*args, **kwargs):

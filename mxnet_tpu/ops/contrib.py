@@ -435,6 +435,16 @@ def index_copy(old_tensor, index_vector, new_tensor):
                   name="index_copy")
 
 
+def index_add(old_tensor, index_vector, new_tensor):
+    """Accumulate rows of new_tensor into old_tensor at index_vector
+    (reference `_contrib_index_add`, index_add.cc) — functional on TPU:
+    returns the updated tensor (duplicate indices accumulate)."""
+    def f(old, idx, new):
+        return old.at[idx.astype(jnp.int32)].add(new)
+    return invoke(f, (old_tensor, index_vector, new_tensor),
+                  name="index_add")
+
+
 def index_array(data, axes=None):
     """Per-element N-d indices (reference `_contrib_index_array`)."""
     def f(d):

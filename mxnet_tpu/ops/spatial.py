@@ -1,0 +1,162 @@
+"""Spatial-transform operator family.
+
+Reference: `src/operator/grid_generator.cc`, `bilinear_sampler.cc`,
+`spatial_transformer.cc` (STN, Jaderberg et al.), `roi_pooling.cc`,
+`src/operator/nn/im2col.h` (im2col/col2im).
+
+TPU-native design: the samplers are expressed as static-shaped gathers with
+corner masks (XLA gather on the VPU) instead of the reference's per-pixel
+CUDA kernels; ROI pooling becomes a scatter-max over bin assignments (one
+XLA scatter, no data-dependent loop bounds); im2col rides
+`lax.conv_general_dilated_patches` and col2im is its transpose via vjp, so
+the pair stays exactly adjoint as the reference's CPU implementations are.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def grid_generator(data, transform_type="affine", target_shape=None):
+    """Generate a normalized sampling grid (B, 2, H, W) with x=out[:,0],
+    y=out[:,1] in [-1, 1].
+
+    affine: data is (B, 6) row-major 2x3 matrices applied to homogeneous
+    target coords; warp: data is a (B, 2, H, W) pixel-space flow added to the
+    regular grid then normalized (reference `grid_generator.cc`).
+    """
+    if transform_type == "affine":
+        if target_shape is None:
+            raise ValueError("affine grid_generator needs target_shape")
+        h, w = target_shape
+        theta = data.reshape(-1, 2, 3)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        ys = jnp.linspace(-1.0, 1.0, h)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        coords = jnp.stack(
+            [gx.ravel(), gy.ravel(), jnp.ones(h * w, data.dtype)])
+        grid = theta.astype(coords.dtype) @ coords  # (B, 2, H*W)
+        return grid.reshape(-1, 2, h, w).astype(data.dtype)
+    if transform_type == "warp":
+        b, two, h, w = data.shape
+        gx = jnp.arange(w, dtype=data.dtype)
+        gy = jnp.arange(h, dtype=data.dtype)
+        yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+        x = (data[:, 0] + xx) * (2.0 / max(w - 1, 1)) - 1.0
+        y = (data[:, 1] + yy) * (2.0 / max(h - 1, 1)) - 1.0
+        return jnp.stack([x, y], axis=1)
+    raise ValueError(f"unknown transform_type {transform_type!r}")
+
+
+def bilinear_sampler(data, grid):
+    """Sample data (B, C, H, W) at grid (B, 2, Ho, Wo) locations with
+    bilinear interpolation and zero padding outside [-1, 1]
+    (reference `bilinear_sampler.cc`; torch grid_sample align_corners=True
+    semantics)."""
+    b, c, h, w = data.shape
+    x = (grid[:, 0] + 1.0) * (w - 1) / 2.0  # (B, Ho, Wo) in pixel coords
+    y = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+
+    out = 0.0
+    for dy in (0, 1):
+        for dx in (0, 1):
+            cx = x0 + dx
+            cy = y0 + dy
+            wgt = (1.0 - jnp.abs(x - cx)) * (1.0 - jnp.abs(y - cy))
+            valid = (cx >= 0) & (cx <= w - 1) & (cy >= 0) & (cy <= h - 1)
+            ix = jnp.clip(cx, 0, w - 1).astype(jnp.int32)
+            iy = jnp.clip(cy, 0, h - 1).astype(jnp.int32)
+            # gather per batch: data[b, :, iy[b], ix[b]]
+            vals = jax.vmap(lambda d, yy, xx: d[:, yy, xx])(data, iy, ix)
+            out = out + vals * (wgt * valid)[:, None]
+    return out.astype(data.dtype)
+
+
+def spatial_transformer(data, loc, target_shape, transform_type="affine",
+                        sampler_type="bilinear"):
+    """STN forward: loc (B, 6) → affine grid over target_shape → bilinear
+    sample (reference `spatial_transformer.cc`)."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise ValueError("only affine/bilinear spatial_transformer supported")
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)
+
+
+def roi_pooling(data, rois, pooled_size, spatial_scale=1.0):
+    """Max-pool each ROI into a fixed (ph, pw) output.
+
+    data (B, C, H, W); rois (R, 5) rows [batch_idx, x1, y1, x2, y2] in image
+    coords scaled by ``spatial_scale`` (reference `roi_pooling.cc`).  Bin i
+    covers rows [floor(i*rh/ph), ceil((i+1)*rh/ph)) — consecutive bins
+    OVERLAP when rh/ph is fractional, so instead of a one-bin-per-pixel
+    scatter, each bin takes a masked max over rows then columns: two
+    static-shaped VPU reductions per ROI, vmapped over the ROI batch.
+    """
+    ph, pw = (pooled_size, pooled_size) if isinstance(pooled_size, int) \
+        else tuple(pooled_size)
+    b, c, h, w = data.shape
+    neg = jnp.finfo(data.dtype).min
+
+    def one_roi(roi):
+        batch = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        img = lax.dynamic_index_in_dim(data, batch, axis=0, keepdims=False)
+
+        def bin_masks(n_bins, lo, extent, size):
+            i = jnp.arange(n_bins, dtype=data.dtype)[:, None]
+            coords = jnp.arange(size, dtype=data.dtype)[None, :]
+            start = jnp.floor(i * extent / n_bins) + lo
+            end = jnp.ceil((i + 1) * extent / n_bins) + lo
+            return (coords >= jnp.maximum(start, 0)) & \
+                   (coords < jnp.minimum(end, size))      # (n_bins, size)
+
+        my = bin_masks(ph, y1, rh, h)
+        mx_ = bin_masks(pw, x1, rw, w)
+        # rows: (C, H, W) -> (ph, C, W), then cols -> (pw, ph, C)
+        rowmax = jnp.where(my[:, None, :, None], img[None], neg).max(axis=2)
+        out = jnp.where(mx_[:, None, None, :], rowmax[None], neg).max(axis=3)
+        out = jnp.transpose(out, (2, 1, 0))               # (C, ph, pw)
+        # empty bins produce 0 like the reference (is_empty → output 0)
+        return jnp.where(out == neg, 0.0, out).astype(data.dtype)
+
+    return jax.vmap(one_roi)(rois.astype(data.dtype))
+
+
+def _im2col_patches(data, kernel, stride, dilate, pad):
+    kh, kw = kernel
+    patches = lax.conv_general_dilated_patches(
+        data, filter_shape=(kh, kw), window_strides=tuple(stride),
+        padding=tuple((p, p) for p in pad), rhs_dilation=tuple(dilate),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches  # (N, C*kh*kw, out_h, out_w)
+
+
+def im2col(data, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    """Unfold sliding windows into columns: (N, C, H, W) →
+    (N, C*kh*kw, L) with L = out_h*out_w (reference `nn/im2col.h`)."""
+    patches = _im2col_patches(data, kernel, stride, dilate, pad)
+    n, ck, oh, ow = patches.shape
+    return patches.reshape(n, ck, oh * ow)
+
+
+def col2im(col, output_size, kernel, stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0)):
+    """Adjoint of im2col: overlap-add columns back into (N, C, H, W)
+    (reference `nn/im2col.h` col2im).  Implemented as the vjp of im2col so
+    the pair is exactly adjoint."""
+    h, w = output_size
+    n = col.shape[0]
+    kh, kw = kernel
+    c = col.shape[1] // (kh * kw)
+    zeros = jnp.zeros((n, c, h, w), col.dtype)
+    _, vjp = jax.vjp(
+        lambda d: im2col(d, kernel, stride, dilate, pad), zeros)
+    return vjp(col)[0]

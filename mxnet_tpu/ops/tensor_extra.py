@@ -1,0 +1,117 @@
+"""Structural / indexing ops from the reference's legacy tensor surface.
+
+Reference: `src/operator/tensor/indexing_op.cc` (gather_nd/scatter_nd),
+`src/operator/tensor/broadcast_reduce_op_value.cc` (broadcast_like),
+`src/operator/slice_channel.cc` / `matrix_op.cc` (slice_like),
+`src/operator/contrib/krprod.cc` (khatri_rao),
+`src/operator/tensor/ravel.cc` (ravel_multi_index/unravel_index),
+`src/operator/make_loss.cc`, `src/operator/contrib/multi_all_finite.cc`.
+
+TPU-native design: each op is a static-shaped composition of `jnp`/`lax`
+primitives; gather/scatter lower to XLA gather/scatter which TPU executes
+natively, and the scatter-add gradient of `gather_nd` falls out of the
+functional formulation via vjp instead of a hand-written `_backward_gather_nd`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def gather_nd(data, indices):
+    """out[y...] = data[indices[0, y...], ..., indices[M-1, y...]].
+
+    ``indices`` has shape (M, Y0, ..., Yk); output shape is
+    (Y0, ..., Yk) + data.shape[M:] (reference `indexing_op.cc` GatherND).
+    """
+    indices = indices.astype(jnp.int32)
+    m = indices.shape[0]
+    idx = tuple(indices[i] for i in range(m))
+    return data[idx]
+
+
+def scatter_nd(data, indices, shape):
+    """Inverse of gather_nd: scatter ``data`` into zeros of ``shape``.
+
+    The reference leaves duplicate-index behavior undefined; here the last
+    write wins (XLA scatter).  ``indices`` shape (M, Y0..Yk), ``data`` shape
+    (Y0..Yk) + shape[M:].
+    """
+    indices = indices.astype(jnp.int32)
+    m = indices.shape[0]
+    idx = tuple(indices[i] for i in range(m))
+    return jnp.zeros(shape, data.dtype).at[idx].set(data)
+
+
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    """Broadcast lhs to the shape of rhs (reference `broadcast_like`).
+
+    With axes given, only those axes take rhs's extent; other axes keep
+    lhs's extent (which lets non-1 axes differ between the operands).
+    """
+    if lhs_axes is None and rhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    lhs_axes = tuple(lhs_axes) if lhs_axes is not None else tuple(range(lhs.ndim))
+    rhs_axes = tuple(rhs_axes) if rhs_axes is not None else tuple(range(rhs.ndim))
+    if len(lhs_axes) != len(rhs_axes):
+        raise ValueError("lhs_axes and rhs_axes must have equal length")
+    target = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        target[la % lhs.ndim] = rhs.shape[ra % rhs.ndim]
+    return jnp.broadcast_to(lhs, tuple(target))
+
+
+def slice_like(data, shape_like, axes=None):
+    """Slice data to shape_like's extents along ``axes`` (default: all axes
+    up to shape_like.ndim), reference `matrix_op.cc` SliceLike."""
+    if axes is None or axes == ():
+        axes = tuple(range(min(data.ndim, shape_like.ndim)))
+    slc = [slice(None)] * data.ndim
+    for ax in axes:
+        slc[ax % data.ndim] = slice(0, shape_like.shape[ax % shape_like.ndim])
+    return data[tuple(slc)]
+
+
+def khatri_rao(*matrices):
+    """Column-wise Kronecker product: inputs (n_i, k) → output (prod n_i, k)
+    (reference `src/operator/contrib/krprod.cc`)."""
+    if not matrices:
+        raise ValueError("khatri_rao needs at least one matrix")
+    out = matrices[0]
+    for m in matrices[1:]:
+        k = out.shape[1]
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, k)
+    return out
+
+
+def ravel_multi_index(data, shape):
+    """data (M, N) of per-dim indices → flat indices (N,) under row-major
+    ``shape`` (reference `ravel.cc`)."""
+    data = data.astype(jnp.int64) if data.dtype == jnp.int64 else data.astype(jnp.int32)
+    strides = []
+    acc = 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= s
+    strides = jnp.asarray(list(reversed(strides)), data.dtype)
+    return (data * strides[:, None]).sum(axis=0)
+
+
+def unravel_index(data, shape):
+    """Row-major inverse of ravel_multi_index → (len(shape), N) int array
+    (reference `ravel.cc`)."""
+    return jnp.stack(jnp.unravel_index(data, shape)).astype(jnp.int32)
+
+
+def make_loss(data):
+    """Identity marking a head node (reference `make_loss.cc`); the gradient
+    of the output w.r.t. itself is ones, which vjp supplies naturally."""
+    return data * 1
+
+
+def multi_all_finite(*arrays):
+    """1 if every element of every input is finite, else 0
+    (reference `contrib/multi_all_finite.cc`, used by AMP loss scaling)."""
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.isfinite(a.astype(jnp.float32)).all())
+    return ok.astype(jnp.float32)

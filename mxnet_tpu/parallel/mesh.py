@@ -33,6 +33,9 @@ def make_mesh(axes=None, devices=None):
     names = list(axes)
     sizes = list(axes.values())
     n = len(devices)
+    if sizes.count(-1) > 1:
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))}: at most one axis may be -1")
     if -1 in sizes:
         known = 1
         for sz in sizes:

@@ -1,0 +1,267 @@
+"""Spatial-transform + structural op tests.
+
+Oracles: torch (grid_sample / affine_grid / unfold with align_corners=True
+matching the reference semantics, reference `bilinear_sampler.cc` docstring
+cites the same STN paper torch implements) and brute-force numpy.
+Reference strategy: `tests/python/unittest/test_operator.py`
+(test_spatial_transformer / test_bilinear_sampler / test_roipooling /
+test_gather_nd / test_ravel).
+"""
+import numpy as onp
+import pytest
+import torch
+import torch.nn.functional as F
+
+import mxnet_tpu as mx
+from mxnet_tpu import npx
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+# ---------------------------------------------------------------------------
+# bilinear sampler / grid generator / STN vs torch
+# ---------------------------------------------------------------------------
+def test_bilinear_sampler_matches_torch():
+    onp.random.seed(0)
+    data = onp.random.randn(2, 3, 5, 7).astype(onp.float32)
+    grid = onp.random.uniform(-1.3, 1.3, (2, 2, 4, 6)).astype(onp.float32)
+
+    got = npx.bilinear_sampler(mx.np.array(data), mx.np.array(grid)).asnumpy()
+
+    tgrid = torch.from_numpy(grid).permute(0, 2, 3, 1)  # (B,Ho,Wo,2) [x,y]
+    want = F.grid_sample(torch.from_numpy(data), tgrid, mode="bilinear",
+                         padding_mode="zeros", align_corners=True).numpy()
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_grid_generator_affine_matches_torch():
+    onp.random.seed(1)
+    theta = onp.random.randn(3, 6).astype(onp.float32) * 0.3
+    got = npx.grid_generator(mx.np.array(theta), "affine",
+                             target_shape=(4, 5)).asnumpy()
+    want = F.affine_grid(torch.from_numpy(theta.reshape(3, 2, 3)),
+                         [3, 1, 4, 5], align_corners=True).numpy()
+    # torch grid is (B,H,W,2) [x,y]; ours (B,2,H,W)
+    assert_almost_equal(got[:, 0], want[..., 0], rtol=1e-5, atol=1e-5)
+    assert_almost_equal(got[:, 1], want[..., 1], rtol=1e-5, atol=1e-5)
+
+
+def test_grid_generator_warp_identity_flow():
+    # zero flow → the regular normalized grid
+    flow = onp.zeros((1, 2, 3, 4), onp.float32)
+    got = npx.grid_generator(mx.np.array(flow), "warp").asnumpy()
+    xs = onp.linspace(-1, 1, 4, dtype=onp.float32)
+    ys = onp.linspace(-1, 1, 3, dtype=onp.float32)
+    assert_almost_equal(got[0, 0], onp.broadcast_to(xs, (3, 4)), atol=1e-6)
+    assert_almost_equal(got[0, 1], onp.broadcast_to(ys[:, None], (3, 4)),
+                        atol=1e-6)
+
+
+def test_spatial_transformer_matches_torch():
+    onp.random.seed(2)
+    data = onp.random.randn(2, 2, 6, 6).astype(onp.float32)
+    theta = (onp.tile(onp.array([1, 0, 0, 0, 1, 0], onp.float32), (2, 1))
+             + onp.random.randn(2, 6).astype(onp.float32) * 0.1)
+    got = npx.spatial_transformer(mx.np.array(data), mx.np.array(theta),
+                                  target_shape=(4, 4)).asnumpy()
+    tgrid = F.affine_grid(torch.from_numpy(theta.reshape(2, 2, 3)),
+                          [2, 2, 4, 4], align_corners=True)
+    want = F.grid_sample(torch.from_numpy(data), tgrid, mode="bilinear",
+                         padding_mode="zeros", align_corners=True).numpy()
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_bilinear_sampler_grad():
+    onp.random.seed(3)
+    data = mx.np.array(onp.random.randn(1, 2, 4, 4).astype(onp.float32))
+    g = onp.random.uniform(-0.8, 0.8, (1, 2, 3, 3)).astype(onp.float32)
+    # keep sample points away from integer pixel coords: the interpolation
+    # weight has a floor kink there, where finite differences are invalid
+    px = (g + 1) * 1.5
+    g = onp.where(onp.abs(px - onp.round(px)) < 5e-3, g + 0.02, g)
+    grid = mx.np.array(g)
+    check_numeric_gradient(lambda d, g: npx.bilinear_sampler(d, g).sum(),
+                           [data, grid], rtol=1e-2, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# roi_pooling vs brute force
+# ---------------------------------------------------------------------------
+def _np_roi_pool(data, rois, psize, scale):
+    b, c, h, w = data.shape
+    ph, pw = psize
+    out = onp.zeros((len(rois), c, ph, pw), data.dtype)
+    for r, roi in enumerate(rois):
+        bi = int(roi[0])
+        x1, y1, x2, y2 = [int(round(v * scale)) for v in roi[1:]]
+        rh = max(y2 - y1 + 1, 1)
+        rw = max(x2 - x1 + 1, 1)
+        for i in range(ph):
+            for j in range(pw):
+                hs = int(onp.floor(i * rh / ph)) + y1
+                he = int(onp.ceil((i + 1) * rh / ph)) + y1
+                ws = int(onp.floor(j * rw / pw)) + x1
+                we = int(onp.ceil((j + 1) * rw / pw)) + x1
+                hs, he = max(hs, 0), min(he, h)
+                ws, we = max(ws, 0), min(we, w)
+                if he > hs and we > ws:
+                    out[r, :, i, j] = data[bi, :, hs:he, ws:we].max(
+                        axis=(1, 2))
+    return out
+
+
+def test_roi_pooling_matches_bruteforce():
+    onp.random.seed(4)
+    data = onp.random.randn(2, 3, 12, 16).astype(onp.float32)
+    rois = onp.array([[0, 0, 0, 7, 7],
+                      [1, 2, 3, 15, 11],
+                      [0, 4, 4, 6, 10]], onp.float32)
+    got = npx.roi_pooling(mx.np.array(data), mx.np.array(rois),
+                          pooled_size=(3, 3), spatial_scale=1.0).asnumpy()
+    want = _np_roi_pool(data, rois, (3, 3), 1.0)
+    # bin-boundary conventions differ on empty/degenerate bins; interior
+    # bins of well-formed rois must agree exactly
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_roi_pooling_scale_and_grad():
+    onp.random.seed(5)
+    data = mx.np.array(onp.random.randn(1, 2, 8, 8).astype(onp.float32))
+    rois = mx.np.array(onp.array([[0, 0, 0, 15, 15]], onp.float32))
+    out = npx.roi_pooling(data, rois, pooled_size=2, spatial_scale=0.5)
+    assert out.shape == (1, 2, 2, 2)
+    with mx.autograd.record():
+        data.attach_grad()
+        with mx.autograd.record():
+            loss = npx.roi_pooling(data, rois, pooled_size=2,
+                                   spatial_scale=0.5).sum()
+        loss.backward()
+    # max pooling routes gradient to argmax cells; total grad mass = #bins*C
+    assert data.grad.asnumpy().sum() == pytest.approx(2 * 4, abs=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# im2col / col2im vs torch unfold/fold
+# ---------------------------------------------------------------------------
+def test_im2col_matches_torch_unfold():
+    onp.random.seed(6)
+    data = onp.random.randn(2, 3, 7, 8).astype(onp.float32)
+    got = npx.im2col(mx.np.array(data), kernel=(3, 2), stride=(2, 1),
+                     dilate=(1, 2), pad=(1, 0)).asnumpy()
+    want = F.unfold(torch.from_numpy(data), kernel_size=(3, 2),
+                    stride=(2, 1), dilation=(1, 2), padding=(1, 0)).numpy()
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_col2im_matches_torch_fold():
+    onp.random.seed(7)
+    col = onp.random.randn(2, 3 * 6, 24).astype(onp.float32)
+    got = npx.col2im(mx.np.array(col), output_size=(7, 8), kernel=(3, 2),
+                     stride=(2, 1), dilate=(1, 2), pad=(1, 0)).asnumpy()
+    want = F.fold(torch.from_numpy(col), output_size=(7, 8),
+                  kernel_size=(3, 2), stride=(2, 1), dilation=(1, 2),
+                  padding=(1, 0)).numpy()
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# structural ops
+# ---------------------------------------------------------------------------
+def test_gather_nd_scatter_nd_roundtrip():
+    onp.random.seed(8)
+    data = onp.random.randn(4, 5, 6).astype(onp.float32)
+    idx = onp.stack([onp.random.randint(0, 4, 7),
+                     onp.random.randint(0, 5, 7)])
+    got = npx.gather_nd(mx.np.array(data), mx.np.array(idx)).asnumpy()
+    want = data[idx[0], idx[1]]
+    assert_almost_equal(got, want, atol=0)
+
+    back = npx.scatter_nd(mx.np.array(want), mx.np.array(idx),
+                          shape=(4, 5, 6)).asnumpy()
+    ref = onp.zeros((4, 5, 6), onp.float32)
+    ref[idx[0], idx[1]] = want  # last write wins, same order
+    assert_almost_equal(back, ref, atol=0)
+
+
+def test_gather_nd_grad_accumulates_duplicates():
+    data = mx.np.array(onp.ones((3, 2), onp.float32))
+    idx = mx.np.array(onp.array([[1, 1, 0]], onp.int32))
+    data.attach_grad()
+    with mx.autograd.record():
+        out = npx.gather_nd(data, idx).sum()
+    out.backward()
+    # rows: row1 gathered twice → grad 2, row0 once → 1, row2 never → 0
+    assert_almost_equal(data.grad.asnumpy(),
+                        onp.array([[1, 1], [2, 2], [0, 0]], onp.float32),
+                        atol=1e-6)
+
+
+def test_broadcast_like_and_slice_like():
+    a = mx.np.array(onp.arange(3, dtype=onp.float32).reshape(3, 1))
+    b = mx.np.array(onp.zeros((3, 4), onp.float32))
+    assert npx.broadcast_like(a, b).shape == (3, 4)
+
+    c = mx.np.array(onp.arange(24, dtype=onp.float32).reshape(4, 6))
+    d = mx.np.array(onp.zeros((2, 3), onp.float32))
+    got = npx.slice_like(c, d).asnumpy()
+    assert_almost_equal(got, onp.arange(24).reshape(4, 6)[:2, :3], atol=0)
+    got2 = npx.slice_like(c, d, axes=(1,)).asnumpy()
+    assert got2.shape == (4, 3)
+
+    # axis-mapped broadcast_like (reference test_broadcast_like)
+    e = mx.np.array(onp.zeros((1, 5), onp.float32))
+    f = mx.np.array(onp.zeros((7, 3), onp.float32))
+    assert npx.broadcast_like(e, f, lhs_axes=(0,), rhs_axes=(0,)).shape == (7, 5)
+
+
+def test_khatri_rao():
+    a = onp.random.randn(3, 4).astype(onp.float32)
+    b = onp.random.randn(5, 4).astype(onp.float32)
+    got = npx.khatri_rao(mx.np.array(a), mx.np.array(b)).asnumpy()
+    want = onp.vstack([onp.kron(a[:, k], b[:, k]) for k in range(4)]).T
+    assert_almost_equal(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (4, 5, 6)
+    onp.random.seed(9)
+    multi = onp.stack([onp.random.randint(0, s, 10) for s in shape])
+    flat = npx.ravel_multi_index(mx.np.array(multi), shape=shape).asnumpy()
+    want = onp.ravel_multi_index(tuple(multi), shape)
+    assert (flat == want).all()
+    back = npx.unravel_index(mx.np.array(flat.astype(onp.int32)),
+                             shape=shape).asnumpy()
+    assert (back == multi).all()
+
+
+def test_make_loss_and_multi_all_finite():
+    x = mx.np.array(onp.array([1.0, 2.0], onp.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        loss = npx.make_loss(x * 3).sum()
+    loss.backward()
+    assert_almost_equal(x.grad.asnumpy(), onp.full(2, 3.0, onp.float32),
+                        atol=1e-6)
+
+    good = mx.np.array(onp.ones(4, onp.float32))
+    bad = mx.np.array(onp.array([1.0, onp.inf], onp.float32))
+    assert float(npx.multi_all_finite(good, good).asnumpy()) == 1.0
+    assert float(npx.multi_all_finite(good, bad).asnumpy()) == 0.0
+
+
+def test_reset_arrays_zeroes_in_place():
+    a = mx.np.array(onp.ones((2, 3), onp.float32))
+    b = mx.np.array(onp.full((4,), 7.0, onp.float32))
+    npx.reset_arrays(a, b, num_arrays=2)
+    assert a.asnumpy().sum() == 0 and b.asnumpy().sum() == 0
+
+
+def test_index_add_accumulates():
+    from mxnet_tpu import contrib
+    old = mx.np.array(onp.zeros((4, 2), onp.float32))
+    idx = mx.np.array(onp.array([1, 1, 3], onp.int32))
+    new = mx.np.array(onp.ones((3, 2), onp.float32))
+    got = contrib.index_add(old, idx, new).asnumpy()
+    want = onp.zeros((4, 2), onp.float32)
+    want[1] = 2
+    want[3] = 1
+    assert_almost_equal(got, want, atol=0)
