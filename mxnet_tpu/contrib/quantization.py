@@ -1,0 +1,312 @@
+"""Post-training INT8 quantization for Gluon networks.
+
+Reference: `python/mxnet/contrib/quantization.py` (quantize_net /
+quantize_model, `_LayerHistogramCollector`, `_get_optimal_threshold`) over
+the C++ `QuantizeGraph` pass (`src/operator/quantization/
+quantize_graph_pass.cc:580`).
+
+TPU-native design: instead of a graph-rewriting pass inserting
+quantize/dequantize nodes into an nnvm graph, calibration attaches forward
+hooks to Dense/Conv blocks (the hook seam replaces the graph pass), and
+conversion swaps those children for Quantized* blocks whose forward is an
+int8 MXU dot — XLA then fuses the (quantize → int8 op → rescale) chain.
+Calibration modes mirror the reference: 'naive' (min/max) and 'entropy'
+(KL-optimal threshold over a 2048-bin histogram).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import numpy as mxnp
+from ..gluon.block import HybridBlock
+from ..gluon.nn.basic_layers import Dense
+from ..gluon.nn.conv_layers import Conv2D
+from ..gluon.parameter import Constant
+from ..ops import quantization as _q
+from ..ops.invoke import invoke
+
+__all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D",
+           "calib_entropy_threshold"]
+
+
+def _smooth(dist, eps=1e-4):
+    is_zero = dist == 0
+    n_zero = int(is_zero.sum())
+    n_nonzero = dist.size - n_zero
+    if n_zero == 0 or n_nonzero == 0:
+        return onp.maximum(dist, 1e-12)
+    out = dist.copy()
+    out[is_zero] = eps
+    out[~is_zero] -= eps * n_zero / n_nonzero
+    return onp.maximum(out, 1e-12)
+
+
+def calib_entropy_threshold(arr, num_bins=2048, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| for symmetric int8 quantization
+    (reference `_get_optimal_threshold`, contrib/quantization.py)."""
+    arr = onp.abs(onp.asarray(arr, onp.float32).ravel())
+    amax = float(arr.max()) if arr.size else 0.0
+    if amax == 0.0:
+        return 1e-8
+    hist, _ = onp.histogram(arr, bins=num_bins, range=(0, amax))
+    return _entropy_threshold_from_hist(hist, amax, num_quantized_bins)
+
+
+def _entropy_threshold_from_hist(hist, amax, num_quantized_bins=255):
+    num_bins = hist.size
+    edges = onp.linspace(0.0, amax, num_bins + 1)
+    best_kl, best_t = onp.inf, amax
+    # candidate thresholds sweep the top half of the histogram
+    for i in range(num_quantized_bins // 2, num_bins + 1,
+                   max(1, num_bins // 128)):
+        t = edges[i] if i < len(edges) else amax
+        p = hist[:i].astype(onp.float64).copy()
+        outliers = hist[i:].sum()
+        if p.size == 0 or p.sum() + outliers == 0:
+            continue
+        p[-1] += outliers  # clip outliers into the last bin
+        # quantize the i bins down to num_quantized_bins, then expand back
+        factor = i / num_quantized_bins
+        idx = onp.minimum((onp.arange(i) / factor).astype(onp.int64),
+                          num_quantized_bins - 1)
+        q_small = onp.zeros(num_quantized_bins)
+        onp.add.at(q_small, idx, p)
+        counts = onp.zeros(num_quantized_bins)
+        onp.add.at(counts, idx, (p > 0).astype(onp.float64))
+        q = onp.divide(q_small[idx], counts[idx],
+                       out=onp.zeros_like(p), where=counts[idx] > 0)
+        q[p == 0] = 0
+        if q.sum() == 0:
+            continue
+        # smooth both distributions (reference `_smooth_distribution`):
+        # move eps mass onto zero bins so KL stays finite and stable
+        pm = _smooth(p / p.sum())
+        qm = _smooth(q / q.sum())
+        kl = float((pm * onp.log(pm / qm)).sum())
+        if kl < best_kl:
+            best_kl, best_t = kl, float(t)
+    return max(best_t, 1e-8)
+
+
+class _CalibCollector:
+    """Forward hooks recording per-block input ranges (reference
+    `_LayerHistogramCollector`/min-max collector).  Entropy mode keeps one
+    fixed-size histogram per layer — O(num_bins) memory however many
+    calibration batches stream through — re-binning the accumulated counts
+    whenever a batch widens the observed range."""
+
+    NUM_BINS = 2048
+
+    def __init__(self, mode):
+        self.mode = mode
+        self.stats = {}       # id(block) -> dict
+        self._handles = []
+
+    def attach(self, blocks):
+        for blk in blocks:
+            self._handles.append(
+                blk.register_forward_hook(self._make_hook(blk)))
+
+    def _make_hook(self, blk):
+        def hook(block, args, out):
+            x = onp.asarray(args[0].asnumpy(), onp.float32)
+            st = self.stats.setdefault(id(blk), {"min": onp.inf,
+                                                 "max": -onp.inf,
+                                                 "absmax": 0.0,
+                                                 "hist": None})
+            st["min"] = min(st["min"], float(x.min()))
+            st["max"] = max(st["max"], float(x.max()))
+            bmax = float(onp.abs(x).max())
+            if self.mode == "entropy":
+                ax = onp.abs(x.ravel())
+                if st["hist"] is None:
+                    st["hist"] = onp.zeros(self.NUM_BINS, onp.float64)
+                if bmax > st["absmax"] and st["absmax"] > 0:
+                    # widen: map old bin centers proportionally into the
+                    # new range and redistribute the accumulated counts
+                    centers = (onp.arange(self.NUM_BINS) + 0.5) * \
+                        (st["absmax"] / self.NUM_BINS)
+                    idx = onp.minimum(
+                        (centers / bmax * self.NUM_BINS).astype(onp.int64),
+                        self.NUM_BINS - 1)
+                    widened = onp.zeros_like(st["hist"])
+                    onp.add.at(widened, idx, st["hist"])
+                    st["hist"] = widened
+                rng = max(bmax, st["absmax"], 1e-12)
+                st["hist"] += onp.histogram(
+                    ax, bins=self.NUM_BINS, range=(0, rng))[0]
+            st["absmax"] = max(st["absmax"], bmax)
+        return hook
+
+    def detach(self):
+        for h in self._handles:
+            h.detach()
+
+    def threshold(self, blk):
+        st = self.stats.get(id(blk))
+        if st is None:
+            return None
+        if self.mode == "entropy":
+            if st["hist"] is None or st["absmax"] == 0.0:
+                return max(st["absmax"], 1e-8)
+            return _entropy_threshold_from_hist(st["hist"], st["absmax"])
+        return max(abs(st["min"]), abs(st["max"]), 1e-8)
+
+
+def _quantize_weight(w, per_channel_axis=0):
+    """Symmetric per-output-channel int8 weight quantization; returns
+    (int8 ndarray, per-channel scale ndarray)."""
+    w = onp.asarray(w, onp.float32)
+    red = tuple(i for i in range(w.ndim) if i != per_channel_axis)
+    amax = onp.maximum(onp.abs(w).max(axis=red), 1e-12)
+    scale = _q.INT8_MAX / amax                       # (channels,)
+    shape = [1] * w.ndim
+    shape[per_channel_axis] = -1
+    qw = onp.clip(onp.round(w * scale.reshape(shape)),
+                  -127, 127).astype(onp.int8)
+    return qw, scale.astype(onp.float32)
+
+
+class QuantizedDense(HybridBlock):
+    """Int8 Dense: activation quantized online against a calibrated
+    threshold, weight pre-quantized per-output-channel (reference
+    `quantized_fully_connected.cc` + calibrated requantize)."""
+
+    def __init__(self, qweight, w_scale, bias, act_threshold, units,
+                 flatten=True, activation=None):
+        super().__init__()
+        self._units = units
+        self._flatten = flatten
+        self._act_threshold = float(act_threshold)
+        self.qweight = Constant(qweight, name="qweight")
+        self.w_scale = Constant(w_scale, name="w_scale")
+        self.bias = None if bias is None else Constant(bias, name="bias")
+        for c in (self.qweight, self.w_scale, self.bias):
+            if c is not None:
+                c.initialize()
+        from ..gluon.nn.basic_layers import Activation
+        self.act = Activation(activation) if activation else None
+
+    def forward(self, x):
+        t = self._act_threshold
+        x_scale = _q.INT8_MAX / t
+
+        def f(xd, qw, ws, *bias):
+            qx, _, _ = _q.quantize(xd, -t, t)
+            return _q.quantized_fully_connected(
+                qx, qw, x_scale, ws, bias[0] if bias else None,
+                flatten=self._flatten)
+
+        args = (x, self.qweight.data(), self.w_scale.data()) + \
+            (() if self.bias is None else (self.bias.data(),))
+        out = invoke(f, args, name="quantized_fully_connected",
+                     differentiable=False)
+        return self.act(out) if self.act is not None else out
+
+    def __repr__(self):
+        return f"QuantizedDense({self._units}, int8)"
+
+
+class QuantizedConv2D(HybridBlock):
+    """Int8 2-D convolution (reference `quantized_conv.cc`)."""
+
+    def __init__(self, qweight, w_scale, bias, act_threshold, channels,
+                 kernel, strides, padding, dilation, groups, layout,
+                 activation=None):
+        super().__init__()
+        self._conv_args = dict(stride=strides, dilate=dilation, pad=padding,
+                               num_filter=channels, num_group=groups,
+                               layout=layout)
+        self._act_threshold = float(act_threshold)
+        self.qweight = Constant(qweight, name="qweight")
+        self.w_scale = Constant(w_scale, name="w_scale")
+        self.bias = None if bias is None else Constant(bias, name="bias")
+        for c in (self.qweight, self.w_scale, self.bias):
+            if c is not None:
+                c.initialize()
+        from ..gluon.nn.basic_layers import Activation
+        self.act = Activation(activation) if activation else None
+
+    def forward(self, x):
+        t = self._act_threshold
+        x_scale = _q.INT8_MAX / t
+
+        def f(xd, qw, ws, *bias):
+            qx, _, _ = _q.quantize(xd, -t, t)
+            return _q.quantized_conv(qx, qw, x_scale, ws,
+                                     bias[0] if bias else None,
+                                     **self._conv_args)
+
+        args = (x, self.qweight.data(), self.w_scale.data()) + \
+            (() if self.bias is None else (self.bias.data(),))
+        out = invoke(f, args, name="quantized_conv", differentiable=False)
+        return self.act(out) if self.act is not None else out
+
+    def __repr__(self):
+        return f"QuantizedConv2D({self._conv_args['num_filter']}, int8)"
+
+
+def _quantizable(blk):
+    return type(blk) in (Dense, Conv2D)
+
+
+def _convert(blk, threshold):
+    if isinstance(blk, Dense):
+        qw, ws = _quantize_weight(blk.weight.data().asnumpy())
+        bias = None if blk.bias is None else blk.bias.data().asnumpy()
+        return QuantizedDense(qw, ws, bias, threshold, blk._units,
+                              flatten=blk._flatten,
+                              activation=blk._activation)
+    qw, ws = _quantize_weight(blk.weight.data().asnumpy())
+    bias = None if blk.bias is None else blk.bias.data().asnumpy()
+    return QuantizedConv2D(
+        qw, ws, bias, threshold, blk._channels, blk._kernel, blk._strides,
+        blk._padding, blk._dilation, blk._groups, blk._layout,
+        activation=blk.act._act_type if blk.act is not None else None)
+
+
+def quantize_net(net, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=None):
+    """Convert a trained float net's Dense/Conv2D layers to int8 in place
+    and return it (reference `quantize_net`, contrib/quantization.py).
+
+    ``calib_data`` is an iterable of input batches (or a single batch) run
+    through the net to calibrate activation ranges.  ``calib_mode``:
+    'naive' = min/max, 'entropy' = KL-optimal thresholds, 'none' = skip
+    layers that would need calibration.  ``exclude_layers`` is a list of
+    blocks or block names to leave in float.
+    """
+    if quantized_dtype != "int8":
+        raise ValueError("TPU quantization is symmetric int8")
+    exclude = set()
+    for e in (exclude_layers or ()):
+        exclude.add(e if isinstance(e, str) else id(e))
+
+    targets = []
+
+    def walk(block, prefix):
+        for name, child in list(block._children.items()):
+            path = f"{prefix}{name}"
+            skip = path in exclude or name in exclude or id(child) in exclude
+            if _quantizable(child) and not skip:
+                targets.append((block, name, child))
+            walk(child, path + ".")
+    walk(net, "")
+    if not targets:
+        return net
+
+    collector = _CalibCollector(calib_mode)
+    if calib_data is not None and calib_mode != "none":
+        collector.attach([t[2] for t in targets])
+        batches = calib_data if isinstance(calib_data, (list, tuple)) \
+            else [calib_data]
+        for batch in batches:
+            net(batch if not isinstance(batch, (list, tuple)) else batch[0])
+        collector.detach()
+
+    for parent, name, child in targets:
+        threshold = collector.threshold(child)
+        if threshold is None:
+            continue  # never saw calibration data; stays float
+        setattr(parent, name, _convert(child, threshold))
+    return net

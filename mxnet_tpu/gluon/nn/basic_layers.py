@@ -25,17 +25,22 @@ __all__ = [
 
 
 class Sequential(Block):
-    """Sequential container (reference basic_layers.py Sequential)."""
+    """Sequential container (reference basic_layers.py Sequential).
+
+    Children live solely in the Block child registry so replacing one via
+    ``setattr`` (AMP / quantization conversion) takes effect — there is no
+    shadow layer list to fall out of sync."""
 
     def __init__(self):
         super().__init__()
-        self._layers = []
+
+    @property
+    def _layers(self):
+        return list(self._children.values())
 
     def add(self, *blocks):
         for block in blocks:
-            idx = len(self._layers)
-            self._layers.append(block)
-            setattr(self, str(idx), block)
+            setattr(self, str(len(self._children)), block)
 
     def forward(self, x, *args):
         for block in self._layers:
@@ -65,7 +70,6 @@ class Sequential(Block):
 class HybridSequential(Sequential, HybridBlock):
     def __init__(self):
         HybridBlock.__init__(self)
-        self._layers = []
 
 
 class Dense(HybridBlock):
@@ -378,17 +382,15 @@ class HybridConcatenate(HybridBlock):
     def __init__(self, axis=-1):
         super().__init__()
         self.axis = axis
-        self._layers = []
 
     def add(self, *blocks):
         for block in blocks:
-            idx = len(self._layers)
-            self._layers.append(block)
-            setattr(self, str(idx), block)
+            setattr(self, str(len(self._children)), block)
 
     def forward(self, x):
-        return mxnp.concatenate([block(x) for block in self._layers],
-                                axis=self.axis)
+        return mxnp.concatenate(
+            [block(x) for block in self._children.values()],
+            axis=self.axis)
 
 
 Concatenate = HybridConcatenate

@@ -1,0 +1,127 @@
+"""INT8 quantization ops.
+
+Reference: `src/operator/quantization/` (quantize.cc, quantize_v2.cc,
+dequantize.cc, requantize.cc, quantized_fully_connected.cc,
+quantized_conv.cc — 6.7k LoC of MKLDNN/cuDNN int8 kernels).
+
+TPU-native design: the MXU multiplies int8 operands with int32
+accumulation natively (`preferred_element_type=int32`), so a quantized
+matmul/conv is a single XLA dot/conv plus scalar rescales — no per-backend
+kernel zoo.  Symmetric signed-int8 scheme as the reference's
+`kInt8`/`shifted` modes reduce to on GPU: scale = 127 / max(|min|, |max|).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+INT8_MAX = 127.0
+
+
+def _range_scale(min_range, max_range):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return INT8_MAX / jnp.maximum(amax, 1e-12)
+
+
+def quantize(data, min_range, max_range, out_type="int8"):
+    """Quantize float data into int8 given a calibrated float range
+    (reference `quantize.cc`).  Returns (qdata, min_out, max_out)."""
+    if out_type != "int8":
+        raise ValueError("TPU quantization is symmetric int8")
+    scale = _range_scale(min_range, max_range)
+    q = jnp.clip(jnp.round(data * scale), -INT8_MAX, INT8_MAX)
+    amax = INT8_MAX / scale
+    return q.astype(jnp.int8), -amax, amax
+
+
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """quantize with the range computed from the data when no calibrated
+    range is given (reference `quantize_v2.cc`)."""
+    if min_calib_range is None or max_calib_range is None:
+        min_calib_range = data.min()
+        max_calib_range = data.max()
+    return quantize(data, min_calib_range, max_calib_range, out_type)
+
+
+def dequantize(qdata, min_range, max_range):
+    """int8 → float32 (reference `dequantize.cc`)."""
+    scale = _range_scale(min_range, max_range)
+    return qdata.astype(jnp.float32) / scale
+
+
+INT32_MAX = float(2 ** 31 - 1)
+
+
+def dequantize_int32(qdata, min_range, max_range):
+    """int32 accumulator → float32.  (min_range, max_range) is the float
+    range the full int32 span represents: value = q * amax / INT32_MAX."""
+    amax = jnp.maximum(jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)),
+                       1e-12)
+    return qdata.astype(jnp.float32) * (amax / INT32_MAX)
+
+
+def requantize(qdata, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator → int8 under a narrower calibrated range
+    (reference `requantize.cc`).  (min_range, max_range) describe the float
+    range of the int32 data (see dequantize_int32)."""
+    real = dequantize_int32(qdata, min_range, max_range)
+    if min_calib_range is None or max_calib_range is None:
+        min_calib_range = real.min()
+        max_calib_range = real.max()
+    return quantize(real, min_calib_range, max_calib_range)
+
+
+def quantized_fully_connected(qx, qw, x_scale, w_scale, bias=None,
+                              flatten=True):
+    """int8 x @ int8 w^T with int32 accumulation on the MXU, rescaled to
+    float (reference `quantized_fully_connected.cc`; bias stays float —
+    the reference quantizes it to int32 only because cuDNN requires it).
+
+    qx (..., K) int8, qw (N, K) int8; ``x_scale``/``w_scale`` are the
+    float-per-int multipliers used to produce them.  w_scale may be
+    per-output-channel (N,).
+    """
+    if flatten and qx.ndim > 2:
+        qx = qx.reshape(qx.shape[0], -1)
+    acc = lax.dot_general(
+        qx, qw, (((qx.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) / (x_scale * w_scale)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def quantized_conv(qx, qw, x_scale, w_scale, bias=None, kernel=None,
+                   stride=None, dilate=None, pad=None, num_filter=None,
+                   num_group=1, layout="NCHW"):
+    """int8 convolution with int32 MXU accumulation (reference
+    `quantized_conv.cc`).  w_scale may be per-output-channel."""
+    nsp = len(layout) - 2
+
+    def tup(v, d):
+        if v is None:
+            return (d,) * nsp
+        return (v,) * nsp if isinstance(v, int) else tuple(v)
+
+    stride = tup(stride, 1)
+    dilate = tup(dilate, 1)
+    pad = tuple((p, p) for p in tup(pad, 0))
+    spatial = layout.replace("N", "").replace("C", "")
+    dn = lax.conv_dimension_numbers(
+        qx.shape, qw.shape, (layout, "OI" + spatial, layout))
+    acc = lax.conv_general_dilated(
+        qx, qw, window_strides=stride, padding=pad, rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    c_axis = layout.index("C")
+    shape = [1] * acc.ndim
+    shape[c_axis] = acc.shape[c_axis]
+    ws = jnp.asarray(w_scale)
+    ws = ws.reshape(shape) if ws.ndim else ws
+    out = acc.astype(jnp.float32) / (x_scale * ws)
+    if bias is not None:
+        out = out + jnp.asarray(bias).reshape(shape)
+    return out
