@@ -295,11 +295,31 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
     if not targets:
         return net
 
+    # calibration must run eagerly: a hybridized net replays its jit cache
+    # (or traces with abstract values), so hooks would observe nothing or
+    # tracers; deactivate any hybridized blocks for the calibration pass
+    hybridized = []
+
+    def find_active(block):
+        if getattr(block, "_active", False):
+            hybridized.append(block)
+        for child in block._children.values():
+            find_active(child)
+    find_active(net)
+    for blk in hybridized:
+        blk._active = False
+        blk._clear_cached()
+
     collector = _CalibCollector(calib_mode)
     if calib_data is not None and calib_mode != "none":
         collector.attach([t[2] for t in targets])
-        batches = calib_data if isinstance(calib_data, (list, tuple)) \
-            else [calib_data]
+        if isinstance(calib_data, (list, tuple)):
+            batches = calib_data
+        elif hasattr(calib_data, "__iter__") and not hasattr(
+                calib_data, "shape"):
+            batches = calib_data   # DataLoader / generator of batches
+        else:
+            batches = [calib_data]
         for batch in batches:
             net(batch if not isinstance(batch, (list, tuple)) else batch[0])
         collector.detach()
@@ -309,4 +329,9 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
         if threshold is None:
             continue  # never saw calibration data; stays float
         setattr(parent, name, _convert(child, threshold))
+
+    # re-activate with cleared caches so the next call traces the int8 graph
+    for blk in hybridized:
+        blk._active = True
+        blk._clear_cached()
     return net

@@ -99,19 +99,14 @@ def quantized_conv(qx, qw, x_scale, w_scale, bias=None, kernel=None,
                    num_group=1, layout="NCHW"):
     """int8 convolution with int32 MXU accumulation (reference
     `quantized_conv.cc`).  w_scale may be per-output-channel."""
+    from .nn import _conv_dimension_numbers, _tuplize
+
     nsp = len(layout) - 2
-
-    def tup(v, d):
-        if v is None:
-            return (d,) * nsp
-        return (v,) * nsp if isinstance(v, int) else tuple(v)
-
-    stride = tup(stride, 1)
-    dilate = tup(dilate, 1)
-    pad = tuple((p, p) for p in tup(pad, 0))
-    spatial = layout.replace("N", "").replace("C", "")
+    stride = _tuplize(stride, nsp)
+    dilate = _tuplize(dilate, nsp)
+    pad = tuple((p, p) for p in _tuplize(pad if pad is not None else 0, nsp))
     dn = lax.conv_dimension_numbers(
-        qx.shape, qw.shape, (layout, "OI" + spatial, layout))
+        qx.shape, qw.shape, _conv_dimension_numbers(layout))
     acc = lax.conv_general_dilated(
         qx, qw, window_strides=stride, padding=pad, rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group,

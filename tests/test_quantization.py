@@ -148,6 +148,34 @@ def test_entropy_streaming_matches_single_shot():
     assert streamed == pytest.approx(oneshot, rel=0.15)
 
 
+def test_quantize_net_on_hybridized_net():
+    # regression: calibration on a hybridized net either replayed the jit
+    # cache (hooks silent, nothing converted) or crashed on tracers
+    onp.random.seed(7)
+    net = _make_net()
+    net.hybridize()
+    x = mx.np.array(onp.random.uniform(-1, 1, (4, 3, 8, 8)).astype(onp.float32))
+    want = net(x).asnumpy()   # populate the jit cache first
+    qnet = q.quantize_net(net, calib_data=x, calib_mode="naive")
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert "Dense" not in kinds and "Conv2D" not in kinds
+    got = qnet(x).asnumpy()   # traces the int8 graph, not the stale cache
+    assert onp.abs(got - want).max() > 0
+    assert onp.abs(got - want).max() < 0.35 * max(1.0, abs(want).max())
+
+
+def test_quantize_net_generator_calib_data():
+    onp.random.seed(8)
+    net = _make_net()
+    batches = [mx.np.array(onp.random.uniform(-1, 1, (2, 3, 8, 8))
+                           .astype(onp.float32)) for _ in range(3)]
+    net(batches[0])
+    qnet = q.quantize_net(net, calib_data=(b for b in batches),
+                          calib_mode="naive")
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert "Dense" not in kinds and "Conv2D" not in kinds
+
+
 def test_quantize_net_excludes_layers():
     net = _make_net()
     x = mx.np.array(onp.zeros((2, 3, 8, 8), onp.float32))
