@@ -1,0 +1,169 @@
+"""Per-operator forward/backward benchmark harness.
+
+Reference: `benchmark/opperf/opperf.py` (runs every registered op with
+default shapes, times fwd/bwd via the profiler, dumps md/json tables used
+as a perf-regression gate).
+
+TPU-native design: each op is timed twice — `eager` (per-call dispatch
+through the imperative tape, the cost a user pays op-at-a-time) and
+`jit` (the op compiled alone, measuring the XLA kernel itself).  The gap
+between the two columns is the dispatch overhead the reference's engine
+bulking hides, which on TPU is the argument for `hybridize()`.
+
+Usage:
+    python benchmark/opperf/opperf.py [--category elemwise,nn,...]
+        [--output results.json] [--iters 50] [--dtype float32]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+# runnable from a checkout without installation, like the reference harness
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _corpus(dtype):
+    """op name -> (category, fn(mx) -> (callable, args...)) with
+    reference-comparable default shapes (benchmark/opperf/rules/
+    default_params.py uses 1024x1024 style shapes)."""
+    import mxnet_tpu as mx
+    npx = mx.npx
+    np_ = mx.np
+
+    def arr(*shape):
+        return np_.array(onp.random.uniform(-1, 1, shape).astype(dtype))
+
+    big = (1024, 1024)
+    conv_x = (32, 64, 56, 56)
+
+    ops = {
+        # elemwise / broadcast (reference src/operator/tensor/)
+        "add": ("elemwise", lambda: (lambda a, b: a + b, arr(*big), arr(*big))),
+        "mul": ("elemwise", lambda: (lambda a, b: a * b, arr(*big), arr(*big))),
+        "exp": ("elemwise", lambda: (np_.exp, arr(*big))),
+        "tanh": ("elemwise", lambda: (np_.tanh, arr(*big))),
+        "broadcast_add": ("elemwise",
+                          lambda: (lambda a, b: a + b, arr(*big), arr(1024))),
+        # reduce
+        "sum": ("reduce", lambda: (np_.sum, arr(*big))),
+        "mean_axis": ("reduce", lambda: (lambda a: np_.mean(a, axis=1),
+                                         arr(*big))),
+        "argmax": ("reduce", lambda: (lambda a: np_.argmax(a, axis=1),
+                                      arr(*big))),
+        # gemm (MXU)
+        "dot": ("gemm", lambda: (np_.dot, arr(*big), arr(*big))),
+        "batch_dot": ("gemm", lambda: (npx.batch_dot,
+                                       arr(32, 256, 256), arr(32, 256, 256))),
+        "fully_connected": ("gemm", lambda: (
+            lambda x, w, b: npx.fully_connected(x, w, b, num_hidden=1024),
+            arr(128, 1024), arr(1024, 1024), arr(1024))),
+        # nn (reference src/operator/nn/)
+        "convolution": ("nn", lambda: (
+            lambda x, w: npx.convolution(x, w, kernel=(3, 3), pad=(1, 1),
+                                         num_filter=64),
+            arr(*conv_x), arr(64, 64, 3, 3))),
+        "pooling": ("nn", lambda: (
+            lambda x: npx.pooling(x, kernel=(2, 2), stride=(2, 2),
+                                  pool_type="max"), arr(*conv_x))),
+        "softmax": ("nn", lambda: (npx.softmax, arr(128, 1024))),
+        "layer_norm": ("nn", lambda: (
+            lambda x, g, b: npx.layer_norm(x, g, b), arr(128, 1024),
+            arr(1024), arr(1024))),
+        "relu": ("nn", lambda: (npx.relu, arr(*conv_x))),
+        # indexing
+        "topk": ("indexing", lambda: (
+            lambda a: npx.topk(a, k=10, axis=1), arr(*big))),
+        "take": ("indexing", lambda: (
+            np_.take, arr(*big),
+            np_.array(onp.random.randint(0, 1024, 4096).astype("int32")))),
+        "one_hot": ("indexing", lambda: (
+            lambda i: npx.one_hot(i, 1024),
+            np_.array(onp.random.randint(0, 1024, 4096).astype("int32")))),
+    }
+    return ops
+
+
+def _time(fn, iters, *, sync):
+    fn()  # warmup / compile
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    sync()
+    return (time.perf_counter() - t0) / iters * 1e6  # us
+
+
+def run(categories=None, iters=50, dtype="float32", warmup=None):
+    import mxnet_tpu as mx
+    import jax
+
+    results = []
+    for name, (cat, make) in _corpus(dtype).items():
+        if categories and cat not in categories:
+            continue
+        fn, *args = make()
+
+        # eager: imperative dispatch per call (tape + device dispatch)
+        eager_us = _time(lambda: fn(*args), iters, sync=mx.waitall)
+
+        # jit: the op compiled alone — kernel + PjRt call
+        from mxnet_tpu.ndarray.ndarray import NDArray
+        datas = [a._data for a in args]
+
+        def jit_body(*ds, _fn=fn):
+            out = _fn(*[NDArray(d) for d in ds])
+            return out._data if isinstance(out, NDArray) else out
+        jfn = jax.jit(jit_body)
+        jit_us = _time(lambda: jfn(*datas), iters,
+                       sync=lambda: jax.block_until_ready(jfn(*datas)))
+
+        # fwd+bwd through the tape where the op is differentiable
+        bwd_us = None
+        try:
+            for a in args:
+                if a._data.dtype.kind == "f":
+                    a.attach_grad()
+
+            def step():
+                with mx.autograd.record():
+                    out = fn(*args)
+                out.backward()
+                return out
+            bwd_us = _time(step, max(1, iters // 5), sync=mx.waitall)
+        except Exception:
+            pass
+
+        row = {"op": name, "category": cat, "eager_us": round(eager_us, 1),
+               "jit_us": round(jit_us, 1),
+               "fwd_bwd_us": None if bwd_us is None else round(bwd_us, 1)}
+        results.append(row)
+        print(f"{name:20s} {cat:9s} eager {row['eager_us']:>10} us   "
+              f"jit {row['jit_us']:>10} us   "
+              f"fwd+bwd {row['fwd_bwd_us'] or '-':>10}")
+    return results
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--category", default=None,
+                   help="comma-separated: elemwise,reduce,gemm,nn,indexing")
+    p.add_argument("--iters", type=int, default=50)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--output", default=None, help="write JSON results here")
+    args = p.parse_args()
+    cats = set(args.category.split(",")) if args.category else None
+    results = run(cats, args.iters, args.dtype)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"wrote {args.output}")
+
+
+if __name__ == "__main__":
+    main()
