@@ -38,6 +38,8 @@ from . import numpy as np  # the mx.np namespace (shadows stdlib-style import on
 from . import numpy_extension as npx
 from . import autograd
 from . import random
+from . import symbol
+from . import symbol as sym
 from . import util
 from .util import set_np, reset_np, is_np_array, use_np
 

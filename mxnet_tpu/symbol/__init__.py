@@ -1,0 +1,396 @@
+"""``mx.sym`` / ``mx.symbol`` — symbolic graph construction.
+
+Reference: `python/mxnet/symbol/` (15.7k LoC of generated wrappers over the
+nnvm graph C API: `Symbol`, `var`, compose/bind/eval, `infer_shape`,
+`tojson`/`load`, `list_arguments`).
+
+TPU-native design: a Symbol is a lightweight expression node (op name +
+input symbols + attrs) — the nnvm graph — whose execution lowers through
+the SAME imperative ops the eager path uses, jitted once per bind: XLA is
+the graph compiler, so there is no separate symbolic kernel registry to
+maintain.  `bind` returns an Executor with forward/backward (backward via
+`jax.vjp`, replacing the `MXGradient` pass), `infer_shape` rides
+`jax.eval_shape`, and `tojson`/`load` round-trip the node structure.
+"""
+from __future__ import annotations
+
+import json as _json
+
+import jax
+import numpy as onp
+
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "loads"]
+
+_OP_REGISTRY = {}   # op name -> callable over NDArrays/arrays
+
+
+class Symbol:
+    """A node in the symbolic graph (reference `symbol.py` Symbol)."""
+
+    def __init__(self, op, inputs, attrs=None, name=None, nout=1, index=0):
+        self._op = op                    # None for variables
+        self._inputs = list(inputs)      # Symbol list
+        self._attrs = dict(attrs or {})
+        self._name = name or (op if op else "var")
+        self._nout = nout
+        self._index = index
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    def list_arguments(self):
+        """Free variables in topological order (reference
+        `symbol.py list_arguments`)."""
+        seen, order = set(), []
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                walk(i)
+            if s._op is None and not isinstance(s, _ScalarSymbol) \
+                    and s._name not in order:
+                order.append(s._name)
+        walk(self)
+        return order
+
+    def get_internals(self):
+        """All nodes as a Group (reference `get_internals`)."""
+        seen, nodes = set(), []
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                walk(i)
+            nodes.append(s)
+        walk(self)
+        return Group(nodes)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            for s in self.get_internals()._outputs:
+                if s._name == idx:
+                    return s
+            raise KeyError(idx)
+        if self._nout == 1 and idx == 0:
+            return self
+        return Symbol("_tuple_get", [self], {"index": idx},
+                      name=f"{self._name}[{idx}]")
+
+    # -- composition --------------------------------------------------------
+    def _binop(self, other, opname, fn, swap=False):
+        if not isinstance(other, Symbol):
+            other = _ScalarSymbol(other)
+        a, b = (other, self) if swap else (self, other)
+        return Symbol(opname, [a, b], name=opname)
+
+    def __add__(self, o):
+        return self._binop(o, "_plus", None)
+
+    def __radd__(self, o):
+        return self._binop(o, "_plus", None, swap=True)
+
+    def __sub__(self, o):
+        return self._binop(o, "_minus", None)
+
+    def __rsub__(self, o):
+        return self._binop(o, "_minus", None, swap=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "_mul", None)
+
+    def __rmul__(self, o):
+        return self._binop(o, "_mul", None, swap=True)
+
+    def __truediv__(self, o):
+        return self._binop(o, "_div", None)
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "_div", None, swap=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "_power", None)
+
+    def __neg__(self):
+        return self._binop(-1.0, "_mul", None)
+
+    # -- evaluation ---------------------------------------------------------
+    def _eval(self, env):
+        """Recursively evaluate against ``env`` name->array; memoized."""
+        memo = {}
+
+        def ev(s):
+            if id(s) in memo:
+                return memo[id(s)]
+            if isinstance(s, _ScalarSymbol):
+                out = s._value
+            elif s._op is None:
+                if s._name not in env:
+                    raise ValueError(f"unbound symbol variable '{s._name}'")
+                out = env[s._name]
+            elif s._op == "_tuple_get":
+                out = ev(s._inputs[0])[s._attrs["index"]]
+            else:
+                fn = _OP_REGISTRY[s._op]
+                ins = [ev(i) for i in s._inputs]
+                out = fn(*ins, **s._attrs)
+                if isinstance(out, NDArray):
+                    out = out._data
+                elif isinstance(out, (tuple, list)):
+                    out = tuple(o._data if isinstance(o, NDArray) else o
+                                for o in out)
+            memo[id(s)] = out
+            return out
+        return ev(self)
+
+    def eval(self, ctx=None, **kwargs):
+        """Eager evaluation (reference `symbol.py eval`): returns [NDArray]."""
+        env = {k: (v._data if isinstance(v, NDArray) else onp.asarray(v))
+               for k, v in kwargs.items()}
+        out = self._eval(env)
+        outs = out if isinstance(out, tuple) else (out,)
+        return [NDArray(o, ctx=ctx) for o in outs]
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write"):
+        """Compile the graph for repeated execution (reference `bind`);
+        the TPU executor is one jitted XLA program."""
+        return Executor(self, ctx or current_context(), args or {},
+                        args_grad or {}, grad_req)
+
+    simple_bind = bind
+
+    # -- shape/type inference ----------------------------------------------
+    def infer_shape(self, **shapes):
+        """Shapes of (args, outputs, aux) given input shapes — via
+        jax.eval_shape, replacing the nnvm InferShape pass."""
+        names = self.list_arguments()
+        specs = {}
+        for n in names:
+            if n not in shapes:
+                raise ValueError(f"infer_shape needs a shape for '{n}'")
+            specs[n] = jax.ShapeDtypeStruct(tuple(shapes[n]), onp.float32)
+        out = jax.eval_shape(lambda env: self._eval(env), specs)
+        outs = out if isinstance(out, tuple) else (out,)
+        return ([tuple(shapes[n]) for n in names],
+                [tuple(o.shape) for o in outs], [])
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self):
+        """Serialize node structure (reference `tojson`; the format is a
+        plain node list, not the legacy nnvm JSON)."""
+        nodes, index = [], {}
+
+        def walk(s):
+            if id(s) in index:
+                return index[id(s)]
+            ins = [walk(i) for i in s._inputs]
+            idx = len(nodes)
+            entry = {"op": s._op, "name": s._name, "inputs": ins,
+                     "attrs": s._attrs}
+            if isinstance(s, _ScalarSymbol):
+                entry["op"] = "_scalar"
+                entry["attrs"] = {"value": float(s._value)}
+            nodes.append(entry)
+            index[id(s)] = idx
+            return idx
+        head = walk(self)
+        return _json.dumps({"nodes": nodes, "head": head,
+                            "format": "mxnet_tpu-sym-v1"})
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def __repr__(self):
+        return f"<Symbol {self._name}>"
+
+
+class _ScalarSymbol(Symbol):
+    def __init__(self, value):
+        super().__init__(None, [], name=f"scalar{value}")
+        self._value = value
+
+    def list_arguments(self):
+        return []
+
+
+class Group(Symbol):
+    """Multiple outputs (reference `Group`)."""
+
+    def __init__(self, symbols):
+        super().__init__("_group", list(symbols), name="group",
+                         nout=len(symbols))
+        self._outputs = list(symbols)
+
+    def _eval(self, env):
+        return tuple(s._eval(env) for s in self._outputs)
+
+
+def var(name, shape=None, dtype=None, **kwargs):
+    """Create a free variable (reference `symbol.py var`)."""
+    s = Symbol(None, [], name=name)
+    s._shape = shape
+    s._dtype = dtype
+    return s
+
+
+Variable = var
+
+
+class Executor:
+    """Bound graph (reference `executor.py`): forward/backward over one
+    jitted value_and_grad program."""
+
+    def __init__(self, symbol, ctx, args, args_grad, grad_req):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = {k: v if isinstance(v, NDArray) else NDArray(v)
+                         for k, v in args.items()}
+        self.grad_dict = {k: v if isinstance(v, NDArray) else NDArray(v)
+                          for k, v in (args_grad or {}).items()}
+        self._grad_req = grad_req
+        self._names = symbol.list_arguments()
+        self.outputs = []
+
+        def fwd(env):
+            return self._symbol._eval(env)
+        self._fwd = jax.jit(fwd)
+
+        grad_names = [n for n in self._names
+                      if grad_req != "null" and
+                      (not self.grad_dict or n in self.grad_dict)]
+
+        def fwd_for_grad(genv, env):
+            out = self._symbol._eval({**env, **genv})
+            outs = out if isinstance(out, tuple) else (out,)
+            return outs[0]
+        self._grad_names = grad_names
+        self._vjp_fn = jax.jit(
+            lambda genv, env, ct: jax.vjp(
+                lambda g: fwd_for_grad(g, env), genv)[1](ct)[0])
+
+    def _env(self):
+        return {k: v._data for k, v in self.arg_dict.items()}
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            self.arg_dict[k] = v if isinstance(v, NDArray) else NDArray(v)
+        out = self._fwd(self._env())
+        outs = out if isinstance(out, tuple) else (out,)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        env = self._env()
+        genv = {k: env[k] for k in self._grad_names}
+        rest = {k: v for k, v in env.items() if k not in self._grad_names}
+        if out_grads is None:
+            out0 = self._fwd(env)
+            out0 = out0[0] if isinstance(out0, tuple) else out0
+            ct = jax.numpy.ones_like(out0)
+        else:
+            g = out_grads[0] if isinstance(out_grads, (list, tuple)) \
+                else out_grads
+            ct = g._data if isinstance(g, NDArray) else g
+        grads = self._vjp_fn(genv, rest, ct)
+        for k, gv in grads.items():
+            if k in self.grad_dict:
+                if self._grad_req == "add":
+                    self.grad_dict[k]._rebind(self.grad_dict[k]._data + gv)
+                else:
+                    self.grad_dict[k]._rebind(gv)
+            else:
+                self.grad_dict[k] = NDArray(gv, ctx=self._ctx)
+        return [self.grad_dict[n] for n in self._grad_names]
+
+
+# ---------------------------------------------------------------------------
+# op surface: lift the imperative namespaces to symbol builders
+# ---------------------------------------------------------------------------
+def _register(name, fn):
+    _OP_REGISTRY[name] = fn
+
+    def builder(*args, **kwargs):
+        name_attr = kwargs.pop("name", None)
+        sym_inputs = []
+        for a in args:
+            if isinstance(a, Symbol):
+                sym_inputs.append(a)
+            else:
+                sym_inputs.append(_ScalarSymbol(a))
+        return Symbol(name, sym_inputs, kwargs, name=name_attr or name)
+    builder.__name__ = name
+    return builder
+
+
+def loads(json_str):
+    """Rebuild a Symbol from `tojson` output."""
+    data = _json.loads(json_str)
+    built = {}
+    for idx, node in enumerate(data["nodes"]):
+        ins = [built[i] for i in node["inputs"]]
+        if node["op"] is None:
+            built[idx] = var(node["name"])
+        elif node["op"] == "_scalar":
+            built[idx] = _ScalarSymbol(node["attrs"]["value"])
+        elif node["op"] == "_group":
+            built[idx] = Group(ins)
+        else:
+            built[idx] = Symbol(node["op"], ins, node["attrs"],
+                                name=node["name"])
+    return built[data["head"]]
+
+
+def load(fname):
+    with open(fname) as f:
+        return loads(f.read())
+
+
+def _populate():
+    import jax.numpy as jnp
+
+    from .. import numpy as mxnp
+    from .. import numpy_extension as mxnpx
+
+    # arithmetic primitives used by operator overloads
+    _register("_plus", lambda a, b: a + b)
+    _register("_minus", lambda a, b: a - b)
+    _register("_mul", lambda a, b: a * b)
+    _register("_div", lambda a, b: a / b)
+    _register("_power", lambda a, b: a ** b)
+
+    g = globals()
+    for ns in (mxnp, mxnpx):
+        for attr in dir(ns):
+            if attr.startswith("_"):
+                continue
+            fn = getattr(ns, attr)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if attr in ("array", "save", "load", "seed", "waitall",
+                        "set_np", "reset_np", "use_np", "is_np_array",
+                        "invoke", "apply_aux_update", "is_recording",
+                        "is_training", "cpu", "gpu", "tpu",
+                        "current_context", "num_gpus", "num_tpus"):
+                continue
+            if attr not in g:
+                g[attr] = _register(attr, fn)
+                __all__.append(attr)
+
+
+_populate()
+
+# reference CamelCase aliases commonly used in legacy symbol scripts
+FullyConnected = globals().get("fully_connected")
+Activation = globals().get("activation")
+Convolution = globals().get("convolution")
+Pooling = globals().get("pooling")
+SoftmaxOutput = None  # legacy training-head op: use make_loss + softmax

@@ -1,0 +1,144 @@
+"""mx.sym symbolic API tests.
+
+Reference strategy: `tests/python/unittest/test_symbol.py` (compose,
+list_arguments, infer_shape, tojson/load round-trip, bind + forward/
+backward vs the imperative oracle).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_compose_and_list_arguments():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = (a + b) * a - 2.0
+    assert c.list_arguments() == ["a", "b"]
+
+
+def test_eval_matches_numpy():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = sym.dot(a, b) + 1.0
+    x = onp.random.randn(3, 4).astype(onp.float32)
+    y = onp.random.randn(4, 5).astype(onp.float32)
+    out = c.eval(a=mx.np.array(x), b=mx.np.array(y))[0].asnumpy()
+    assert_almost_equal(out, x @ y + 1.0, rtol=1e-5, atol=1e-5)
+
+
+def test_infer_shape():
+    a = sym.var("a")
+    w = sym.var("w")
+    out = sym.fully_connected(a, w, num_hidden=16)
+    args, outs, aux = out.infer_shape(a=(8, 32), w=(16, 32))
+    assert outs == [(8, 16)]
+    assert aux == []
+
+
+def test_bind_forward_backward_matches_autograd():
+    onp.random.seed(0)
+    a_np = onp.random.randn(4, 3).astype(onp.float32)
+    w_np = onp.random.randn(5, 3).astype(onp.float32)
+
+    a = sym.var("a")
+    w = sym.var("w")
+    loss = sym.sum(sym.tanh(sym.dot(a, sym.transpose(w))))
+
+    ex = loss.bind(args={"a": a_np, "w": w_np})
+    (out,) = ex.forward()
+    ex.backward()
+
+    # imperative oracle
+    av = mx.np.array(a_np)
+    wv = mx.np.array(w_np)
+    av.attach_grad()
+    wv.attach_grad()
+    with mx.autograd.record():
+        ref = mx.np.sum(mx.np.tanh(mx.np.dot(av, wv.T)))
+    ref.backward()
+
+    assert_almost_equal(out.asnumpy(), ref.asnumpy(), rtol=1e-5, atol=1e-5)
+    assert_almost_equal(ex.grad_dict["a"].asnumpy(), av.grad.asnumpy(),
+                        rtol=1e-4, atol=1e-5)
+    assert_almost_equal(ex.grad_dict["w"].asnumpy(), wv.grad.asnumpy(),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_executor_rerun_with_new_args():
+    x = sym.var("x")
+    y = x * 2.0
+    ex = y.bind(args={"x": onp.ones(3, onp.float32)})
+    (o1,) = ex.forward()
+    (o2,) = ex.forward(x=mx.np.array(onp.full(3, 4.0, onp.float32)))
+    assert_almost_equal(o1.asnumpy(), onp.full(3, 2.0), atol=1e-6)
+    assert_almost_equal(o2.asnumpy(), onp.full(3, 8.0), atol=1e-6)
+
+
+def test_tojson_roundtrip():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = sym.relu(a * b + 0.5)
+    j = c.tojson()
+    c2 = sym.loads(j)
+    x = onp.random.randn(2, 3).astype(onp.float32)
+    y = onp.random.randn(2, 3).astype(onp.float32)
+    got = c2.eval(a=mx.np.array(x), b=mx.np.array(y))[0].asnumpy()
+    want = onp.maximum(x * y + 0.5, 0)
+    assert_almost_equal(got, want, rtol=1e-6, atol=1e-6)
+    assert c2.list_arguments() == ["a", "b"]
+
+
+def test_save_load_file(tmp_path):
+    a = sym.var("a")
+    c = sym.softmax(a)
+    path = str(tmp_path / "net-symbol.json")
+    c.save(path)
+    c2 = sym.load(path)
+    x = onp.random.randn(2, 5).astype(onp.float32)
+    assert_almost_equal(c2.eval(a=mx.np.array(x))[0].asnumpy(),
+                        c.eval(a=mx.np.array(x))[0].asnumpy(), atol=1e-6)
+
+
+def test_group_outputs():
+    a = sym.var("a")
+    g = sym.Group([a + 1.0, a * 3.0])
+    outs = g.eval(a=mx.np.array(onp.ones(2, onp.float32)))
+    assert len(outs) == 2
+    assert_almost_equal(outs[0].asnumpy(), onp.full(2, 2.0), atol=1e-6)
+    assert_almost_equal(outs[1].asnumpy(), onp.full(2, 3.0), atol=1e-6)
+
+
+def test_group_tojson_roundtrip():
+    a = sym.var("a")
+    b = sym.var("b")
+    g = sym.Group([a + b, a * b])
+    g2 = sym.loads(g.tojson())
+    outs = g2.eval(a=mx.np.array(onp.full(2, 3.0, onp.float32)),
+                   b=mx.np.array(onp.full(2, 4.0, onp.float32)))
+    assert len(outs) == 2
+    assert_almost_equal(outs[0].asnumpy(), onp.full(2, 7.0), atol=1e-6)
+    assert_almost_equal(outs[1].asnumpy(), onp.full(2, 12.0), atol=1e-6)
+
+
+def test_grad_req_add_accumulates():
+    x_np = onp.ones(3, onp.float32)
+    x = sym.var("x")
+    y = sym.sum(x * x)
+    gbuf = mx.np.array(onp.zeros(3, onp.float32))
+    ex = y.bind(args={"x": x_np}, args_grad={"x": gbuf}, grad_req="add")
+    ex.forward()
+    ex.backward()
+    ex.backward()
+    # d(sum x^2)/dx = 2x = 2; accumulated twice = 4
+    assert_almost_equal(ex.grad_dict["x"].asnumpy(), onp.full(3, 4.0),
+                        atol=1e-5)
+
+
+def test_unbound_variable_raises():
+    a = sym.var("a")
+    b = sym.var("b")
+    with pytest.raises(ValueError, match="unbound"):
+        (a + b).eval(a=mx.np.array(onp.ones(2, onp.float32)))
