@@ -2,6 +2,7 @@
 from .parameter import Parameter, Constant
 from .block import Block, HybridBlock, SymbolBlock
 from .trainer import Trainer
+from .fused_step import FusedTrainStep
 from . import nn
 from . import rnn
 from . import loss
@@ -14,5 +15,5 @@ from . import utils
 from .utils import split_and_load, clip_global_norm
 
 __all__ = ["Parameter", "Constant", "Block", "HybridBlock", "SymbolBlock",
-           "Trainer", "nn", "rnn", "loss", "metric", "data", "model_zoo",
+           "Trainer", "FusedTrainStep", "nn", "rnn", "loss", "metric", "data", "model_zoo",
            "utils", "split_and_load", "clip_global_norm"]

@@ -1,0 +1,173 @@
+"""One-dispatch training step.
+
+Reference analogue: engine op-bulking (`src/engine/threaded_engine.h:507`)
+plus CachedOp static_alloc (`src/imperative/cached_op.h:413`) — MXNet's
+answer to per-op dispatch overhead.  On TPU the equivalent leverage is far
+larger: ``FusedTrainStep`` compiles loss forward, all gradients, and the
+optimizer update into a SINGLE donated XLA program, so a training step is
+one host→device dispatch regardless of model size.  When the chip sits
+behind a network link (or any time dispatch latency matters), this is the
+documented fast path; the eager record/backward/step triple remains fully
+supported and numerically identical.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import random as _rng
+from ..ndarray.ndarray import NDArray
+from .block import _TREEDEFS, _intern_treedef, _is_nd, _scoped_forward
+
+__all__ = ["FusedTrainStep"]
+
+
+def _as_tuple(x):
+    return x if isinstance(x, tuple) else (x,)
+
+
+class FusedTrainStep:
+    """Fuse ``loss = block(*inputs); loss.backward(); trainer.step(bs)``
+    into one jitted program.
+
+    ``block`` must produce the loss (its first output leaf is summed as the
+    backward seed, matching ``backward()``'s ones-cotangent), and the
+    trainer's optimizer must expose ``update_math`` (all built-ins do).
+
+    >>> step = FusedTrainStep(mod, trainer)
+    >>> loss = step(x, y, batch_size=128)
+    """
+
+    def __init__(self, block, trainer):
+        self._block = block
+        self._trainer = trainer
+        self._jit = None
+        self._plist = None
+        self._train_idx = None
+        self._opt_index = None
+
+    def _setup(self, args):
+        block, trainer = self._block, self._trainer
+        if getattr(trainer._optimizer, "supports_fused", True) is False:
+            raise ValueError(
+                f"{type(trainer._optimizer).__name__} has no update_math; "
+                "use the eager record/backward/step path")
+        block._ensure_shapes(*args)   # deferred shapes before state alloc
+        trainer._init_kvstore()
+        trainer._init_states()
+        params = block.collect_params()
+        self._plist = [params[k] for k in sorted(params)]
+        for p in self._plist:
+            if len(p.list_ctx()) != 1:
+                raise ValueError(
+                    "FusedTrainStep is single-device; use kvstore DP or the "
+                    "SPMD mesh path for multi-device")
+        # trainable = has a gradient AND is managed by this trainer; params
+        # outside the trainer (frozen fine-tuning subsets) stay constant,
+        # matching the eager path where the trainer only updates its own
+        by_id = {id(p): i for i, p in enumerate(trainer._params)}
+        self._train_idx = tuple(
+            k for k, p in enumerate(self._plist)
+            if p.grad_req != "null" and id(p) in by_id)
+        self._opt_index = tuple(by_id[id(self._plist[k])]
+                                for k in self._train_idx)
+
+    def _build(self, treedef_id):
+        block = self._block
+        optimizer = self._trainer._optimizer
+        plist = self._plist
+        train_idx = self._train_idx
+        holder = []
+        self._aux_holder = holder
+
+        def fused(train_ws, const_pd, states, key, flat_inputs, lrs, wds,
+                  ts, rescale, clip, treedef_id):
+            def loss_fn(tws):
+                full = list(const_pd)
+                for j, k in enumerate(train_idx):
+                    full[k] = tws[j]
+                out_datas, aux = _scoped_forward(
+                    block, plist, full, key, flat_inputs,
+                    _TREEDEFS[treedef_id], True)
+                holder.clear()
+                holder.extend(getattr(a, "_param_ref", None)
+                              for a, _v in aux.updates)
+                aux_datas = [v._data if _is_nd(v) else v
+                             for _a, v in aux.updates]
+                first = jax.tree_util.tree_leaves(out_datas)[0]
+                return jnp.sum(first.astype(jnp.float32)), \
+                    (out_datas, aux_datas)
+
+            (_lsum, (outs, auxs)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(train_ws)
+            new_ws, new_states = [], []
+            for j in range(len(train_idx)):
+                g = grads[j].astype(jnp.float32) * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                w = train_ws[j]
+                g = g.astype(w.dtype)
+                nw, nst = optimizer.update_math(
+                    w, g, states[j], lrs[j], wds[j], ts[j])
+                new_ws.append(nw)
+                new_states.append(nst)
+            return outs, auxs, tuple(new_ws), tuple(new_states)
+
+        return jax.jit(fused, donate_argnums=(0, 2),
+                       static_argnums=(9, 10))
+
+    def __call__(self, *args, batch_size=1):
+        return self.step(*args, batch_size=batch_size)
+
+    def step(self, *args, batch_size=1):
+        if self._plist is None:
+            self._setup(args)
+        trainer = self._trainer
+        optimizer = trainer._optimizer
+        optimizer.rescale_grad = trainer._scale / batch_size
+        plist = self._plist
+
+        flat, treedef = jax.tree_util.tree_flatten(args, is_leaf=_is_nd)
+        flat = [a._data if _is_nd(a) else a for a in flat]
+        treedef_id = _intern_treedef(treedef)
+        if self._jit is None:
+            self._jit = self._build(treedef_id)
+
+        pd = [p.data()._data for p in plist]
+        train_ws = tuple(pd[k] for k in self._train_idx)
+        const_pd = tuple(
+            d if k not in set(self._train_idx) else None
+            for k, d in enumerate(pd))
+        states = tuple(
+            tuple(s._data for s in _as_tuple(trainer._states[i]))
+            for i in self._opt_index)
+
+        lrs, wds, ts = [], [], []
+        for i in self._opt_index:
+            optimizer._update_count(i)
+            lrs.append(optimizer._get_lr(i))
+            wds.append(optimizer._get_wd(i))
+            ts.append(optimizer._index_update_count[i])
+        lrs = jnp.asarray(onp.asarray(lrs, onp.float32))
+        wds = jnp.asarray(onp.asarray(wds, onp.float32))
+        ts = jnp.asarray(onp.asarray(ts, onp.float32))
+
+        outs, auxs, new_ws, new_states = self._jit(
+            train_ws, const_pd, states, _rng.new_key(), flat, lrs, wds, ts,
+            jnp.float32(optimizer.rescale_grad), optimizer.clip_gradient,
+            treedef_id)
+
+        for j, k in enumerate(self._train_idx):
+            plist[k].data()._rebind(new_ws[j])
+        for i, nst in zip(self._opt_index, new_states):
+            for s_nd, s_new in zip(_as_tuple(trainer._states[i]),
+                                   _as_tuple(nst)):
+                s_nd._rebind(s_new)
+        for p, v in zip(self._aux_holder, auxs):
+            if p is not None:
+                p.data()._rebind(v)
+
+        ctx = plist[0].list_ctx()[0] if plist else None
+        return jax.tree_util.tree_map(
+            lambda o: NDArray(o, ctx=ctx), outs)

@@ -116,11 +116,15 @@ class TestStore(KVStoreBase):
         for v in values[1:]:
             reduced = reduced + v.as_in_ctx(reduced.ctx)
         if out is None:
+            if len(values) == 1:
+                return  # the reduction of one copy is itself: no dispatch
             for v in values:
                 reduced.as_in_ctx(v.ctx).copyto(v)
         else:
             outs = out if isinstance(out, list) else [out]
             for o in outs:
+                if o is reduced:
+                    continue
                 reduced.as_in_ctx(o.ctx).copyto(o)
 
     @staticmethod

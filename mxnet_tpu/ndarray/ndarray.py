@@ -98,9 +98,16 @@ class NDArray:
     def wait_to_read(self):
         """Block until the buffer is defined (reference ``WaitToRead``);
         asynchronous execution errors are raised here, matching the
-        reference's contract (`src/engine/threaded_engine.h:461-498`)."""
+        reference's contract (`src/engine/threaded_engine.h:461-498`).
+
+        A one-element host readback backs the wait: tunneled/remote
+        backends ack ``block_until_ready`` without waiting, but a value
+        fetch cannot complete before the producing computation has."""
         if isinstance(self._data, jax.Array):
             self._data.block_until_ready()
+            probe = self._data[(0,) * self._data.ndim] \
+                if self._data.size else self._data
+            onp.asarray(probe)
         return self
 
     wait_to_write = wait_to_read
@@ -545,12 +552,19 @@ def waitall():
     """Drain all pending device work (reference `mx.nd.waitall`,
     `python/mxnet/ndarray/ndarray.py:231`).
 
-    PjRt executes per-device work in submission order, so blocking on a
-    freshly enqueued no-op computation per device drains that device's queue.
+    PjRt executes per-device work in submission order, so a host READBACK of
+    a freshly enqueued computation drains that device's queue.  The readback
+    (not ``block_until_ready``) is load-bearing: tunneled/remote backends ack
+    ``block_until_ready`` immediately, but a value fetch cannot complete
+    before everything queued ahead of it has executed.
     """
     for d in jax.devices():
         try:
-            jax.device_put(0, d).block_until_ready()
-            (jnp.zeros((), onp.float32) + 0).block_until_ready()
+            with jax.default_device(d):
+                onp.asarray(jnp.zeros((), onp.float32) + 0)
+        except jax.errors.JaxRuntimeError:
+            # a deferred execution error (OOM, kernel failure) surfacing at
+            # the drain point — the reference rethrows at WaitForAll too
+            raise
         except Exception:  # pragma: no cover - backend without alloc
             pass

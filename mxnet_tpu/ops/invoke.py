@@ -196,7 +196,23 @@ def invoke(fun, args, kwargs=None, name=None, differentiable=True, wrap=True):
         a, kw = jax.tree_util.tree_unflatten(treedef, full)
         return fun(*a, **kw)
 
-    if prof is not None:
+    # Fast path for jitted functionals (hybridized blocks): an eager
+    # jax.vjp would re-trace the whole program EVERY step (hundreds of ms
+    # for a ResNet).  Instead run the cached forward executable now and
+    # defer the vjp to backward(), where a jitted fwd+bwd program is
+    # compiled once per (fun, structure) and replayed (see _lazy_vjp).
+    lazy = isinstance(fun, jax.stages.Wrapped) and _lazy_key(
+        fun, treedef, diff_idx, flat_const) is not None
+    if lazy:
+        if prof is not None:
+            t0 = prof[0]()
+            out = flat_fun(*[datas[i] for i in diff_idx])
+            prof[1](name or getattr(fun, "__name__", "op"), t0,
+                    prof[0]() - t0)
+        else:
+            out = flat_fun(*[datas[i] for i in diff_idx])
+        vjp_fn = None
+    elif prof is not None:
         t0 = prof[0]()
         out, vjp_fn = jax.vjp(flat_fun, *[datas[i] for i in diff_idx])
         prof[1](name or getattr(fun, "__name__", "op"), t0, prof[0]() - t0)
@@ -415,6 +431,76 @@ def _add_ct(a, b):
     return a + b
 
 
+def _lazy_key(fun, treedef, diff_idx, flat_const):
+    """Cache key for a deferred-vjp executor, or None if any static (non
+    array) leaf is unhashable."""
+    diff = set(diff_idx)
+    statics = []
+    for i, v in enumerate(flat_const):
+        if i in diff or isinstance(v, (jax.Array, onp.ndarray)):
+            continue
+        try:
+            hash(v)
+        except TypeError:
+            return None
+        statics.append((i, v))
+    return (id(fun), treedef, tuple(diff_idx), tuple(statics))
+
+
+# (fun, structure) -> (jitted fwd+bwd executor, fun ref keeping the id
+# stable).  Bounded: evicts oldest (compiled executables are heavy).
+_VJP_EXEC_CACHE = {}
+_VJP_EXEC_CACHE_MAX = 256
+
+
+def _lazy_vjp(node, ct):
+    """Backward for a node recorded through the lazy fast path: one jitted
+    program recomputes the forward and applies the vjp — compiled once per
+    (fun, structure), replayed every subsequent step.  This is the tape's
+    CachedOp::Backward analogue (`src/imperative/cached_op.h:637`)."""
+    key = _lazy_key(node.fun, node.treedef, node.diff_idx, node.flat_const)
+    entry = _VJP_EXEC_CACHE.get(key)
+    if entry is None:
+        fun, treedef = node.fun, node.treedef
+        diff_idx = tuple(node.diff_idx)
+        n_leaves = len(node.flat_const)
+        diff = set(diff_idx)
+        arr_pos = tuple(
+            i for i, v in enumerate(node.flat_const)
+            if i not in diff and isinstance(v, (jax.Array, onp.ndarray)))
+        static = {i: v for i, v in enumerate(node.flat_const)
+                  if i not in diff and i not in arr_pos}
+
+        def exec_raw(diff_datas, const_datas, ct_val):
+            full = [None] * n_leaves
+            for i, v in static.items():
+                full[i] = v
+            for i, v in zip(arr_pos, const_datas):
+                full[i] = v
+
+            def ff(*dd):
+                leaves = list(full)
+                for i, d in zip(diff_idx, dd):
+                    leaves[i] = d
+                a, kw = jax.tree_util.tree_unflatten(treedef, leaves)
+                return fun(*a, **kw)
+
+            _out, vjp_fn = jax.vjp(ff, *diff_datas)
+            return vjp_fn(ct_val)
+
+        entry = (jax.jit(exec_raw), fun)
+        if len(_VJP_EXEC_CACHE) >= _VJP_EXEC_CACHE_MAX:
+            _VJP_EXEC_CACHE.pop(next(iter(_VJP_EXEC_CACHE)))
+        _VJP_EXEC_CACHE[key] = entry
+    exec_fn = entry[0]
+    diff_datas = tuple(node.flat_const[i] for i in node.diff_idx)
+    diff = set(node.diff_idx)
+    const_datas = tuple(
+        v for i, v in enumerate(node.flat_const)
+        if i not in diff and isinstance(v, (jax.Array, onp.ndarray)))
+    return exec_fn(diff_datas, const_datas, ct)
+
+
 def _node_vjp(node, cotangents, create_graph):
     """Apply the node's vjp.  With create_graph, re-derive it through invoke
     so the backward computation is itself recorded (higher-order grads;
@@ -426,6 +512,8 @@ def _node_vjp(node, cotangents, create_graph):
         if len(node.out_structs) == 1:
             ct = ct[0]
     if not create_graph:
+        if node.vjp_fn is None and node.fun is not None:
+            return _lazy_vjp(node, ct)
         if node.vjp_fn is None:
             raise RuntimeError(
                 "graph has been freed; pass retain_graph=True to backward() "

@@ -80,7 +80,11 @@ class Symbol:
                 if s._name == idx:
                     return s
             raise KeyError(idx)
-        if self._nout == 1 and idx == 0:
+        if idx < 0 or idx >= self._nout:
+            raise IndexError(
+                f"symbol {self._name!r} has {self._nout} output(s), "
+                f"index {idx} out of range")
+        if self._nout == 1:
             return self
         return Symbol("_tuple_get", [self], {"index": idx},
                       name=f"{self._name}[{idx}]")
@@ -196,8 +200,13 @@ class Symbol:
             entry = {"op": s._op, "name": s._name, "inputs": ins,
                      "attrs": s._attrs}
             if isinstance(s, _ScalarSymbol):
+                v = s._value
                 entry["op"] = "_scalar"
-                entry["attrs"] = {"value": float(s._value)}
+                # tuples (shapes, axes) survive as lists + a tuple flag;
+                # ints stay ints so dtype promotion survives a round-trip
+                entry["attrs"] = {"value": list(v) if isinstance(v, tuple)
+                                  else v,
+                                  "tuple": isinstance(v, tuple)}
             nodes.append(entry)
             index[id(s)] = idx
             return idx
@@ -270,12 +279,13 @@ class Executor:
 
         def fwd_for_grad(genv, env):
             out = self._symbol._eval({**env, **genv})
-            outs = out if isinstance(out, tuple) else (out,)
-            return outs[0]
+            return out if isinstance(out, tuple) else (out,)
         self._grad_names = grad_names
+        # cotangents is a tuple with one entry per output; every output's
+        # contribution accumulates into the input gradients
         self._vjp_fn = jax.jit(
-            lambda genv, env, ct: jax.vjp(
-                lambda g: fwd_for_grad(g, env), genv)[1](ct)[0])
+            lambda genv, env, cts: jax.vjp(
+                lambda g: fwd_for_grad(g, env), genv)[1](cts)[0])
 
     def _env(self):
         return {k: v._data for k, v in self.arg_dict.items()}
@@ -292,15 +302,20 @@ class Executor:
         env = self._env()
         genv = {k: env[k] for k in self._grad_names}
         rest = {k: v for k, v in env.items() if k not in self._grad_names}
+        out = self._fwd(env)
+        outs = out if isinstance(out, tuple) else (out,)
         if out_grads is None:
-            out0 = self._fwd(env)
-            out0 = out0[0] if isinstance(out0, tuple) else out0
-            ct = jax.numpy.ones_like(out0)
+            cts = tuple(jax.numpy.ones_like(o) for o in outs)
         else:
-            g = out_grads[0] if isinstance(out_grads, (list, tuple)) \
-                else out_grads
-            ct = g._data if isinstance(g, NDArray) else g
-        grads = self._vjp_fn(genv, rest, ct)
+            gs = out_grads if isinstance(out_grads, (list, tuple)) \
+                else [out_grads]
+            if len(gs) != len(outs):
+                raise ValueError(
+                    f"backward got {len(gs)} head gradients for "
+                    f"{len(outs)} outputs")
+            cts = tuple(g._data if isinstance(g, NDArray) else
+                        jax.numpy.asarray(g) for g in gs)
+        grads = self._vjp_fn(genv, rest, cts)
         for k, gv in grads.items():
             if k in self.grad_dict:
                 if self._grad_req == "add":
@@ -340,7 +355,10 @@ def loads(json_str):
         if node["op"] is None:
             built[idx] = var(node["name"])
         elif node["op"] == "_scalar":
-            built[idx] = _ScalarSymbol(node["attrs"]["value"])
+            v = node["attrs"]["value"]
+            if node["attrs"].get("tuple"):
+                v = tuple(v)
+            built[idx] = _ScalarSymbol(v)
         elif node["op"] == "_group":
             built[idx] = Group(ins)
         else:

@@ -18,6 +18,7 @@ __all__ = [
     "default_context", "set_default_context", "rand_ndarray", "rand_shape_nd",
     "assert_almost_equal", "almost_equal", "same", "check_numeric_gradient",
     "check_consistency", "default_dtype", "effective_dtype",
+    "check_symbolic_forward", "check_symbolic_backward",
 ]
 
 _rng = onp.random.RandomState(12345)
@@ -179,3 +180,33 @@ def check_consistency(f, inputs, ctx_list=None, rtol=1e-4, atol=1e-5):
         assert_almost_equal(results[0], r, rtol=rtol, atol=atol,
                             names=(str(ctx_list[0]), "other"))
     return results
+
+
+def check_symbolic_forward(sym, inputs, expected, rtol=None, atol=None):
+    """Bind ``sym`` to ``inputs`` (list ordered by ``list_arguments``) and
+    compare outputs to ``expected`` numpy arrays (reference
+    `test_utils.py:1193`)."""
+    names = sym.list_arguments()
+    assert len(names) == len(inputs), (names, len(inputs))
+    ex = sym.bind(args=dict(zip(names, inputs)))
+    outs = ex.forward()
+    assert len(outs) == len(expected), (len(outs), len(expected))
+    for i, (o, e) in enumerate(zip(outs, expected)):
+        assert_almost_equal(_to_numpy(o), _to_numpy(e), rtol=rtol, atol=atol,
+                            names=(f"output[{i}]", f"expected[{i}]"))
+    return outs
+
+
+def check_symbolic_backward(sym, inputs, out_grads, expected, rtol=None,
+                            atol=None):
+    """Bind, forward, backward with ``out_grads`` cotangents, and compare
+    input gradients to ``expected`` (reference `test_utils.py:1276`)."""
+    names = sym.list_arguments()
+    ex = sym.bind(args=dict(zip(names, inputs)))
+    ex.forward()
+    grads = ex.backward(out_grads)
+    assert len(grads) == len(expected), (len(grads), len(expected))
+    for n, g, e in zip(names, grads, expected):
+        assert_almost_equal(_to_numpy(g), _to_numpy(e), rtol=rtol, atol=atol,
+                            names=(f"grad[{n}]", f"expected[{n}]"))
+    return grads

@@ -1,0 +1,132 @@
+"""FusedTrainStep must be numerically identical to record/backward/step.
+
+Reference analogue: CachedOp static vs dynamic execution equivalence
+(`tests/python/unittest/test_gluon.py` hybridize checks).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import FusedTrainStep, Trainer, loss as gloss, nn
+from mxnet_tpu.gluon.block import HybridBlock
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+class _NetWithLoss(HybridBlock):
+    def __init__(self, net, loss_fn):
+        super().__init__()
+        self.net = net
+        self.loss_fn = loss_fn
+
+    def forward(self, x, y):
+        return self.loss_fn(self.net(x), y)
+
+
+def _make(seed, with_bn=True):
+    onp.random.seed(seed)
+    net = nn.HybridSequential()
+    # no conv bias before BN: BN cancels mean shifts, leaving the bias with
+    # a ~0 gradient whose Adam-normalized update amplifies float noise into
+    # divergent-but-equally-valid trajectories between compiled programs
+    net.add(nn.Conv2D(4, kernel_size=3, padding=1, use_bias=not with_bn))
+    if with_bn:
+        net.add(nn.BatchNorm())
+    net.add(nn.Activation("relu"))
+    net.add(nn.Dense(8))
+    net.initialize(init=mx.init.Xavier())
+    return _NetWithLoss(net, gloss.SoftmaxCrossEntropyLoss()), net
+
+
+@pytest.mark.parametrize("opt,kw", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+])
+def test_fused_step_matches_eager(opt, kw):
+    x_np = onp.random.uniform(-1, 1, (8, 3, 6, 6)).astype(onp.float32)
+    y_np = onp.random.randint(0, 8, (8,))
+
+    mod_a, net_a = _make(0)
+    mod_b, net_b = _make(0)   # identical init (same seed + init rngs)
+    x = mx.np.array(x_np)
+    y = mx.np.array(y_np, dtype="int32")
+    mod_a(x, y)               # materialize deferred shapes (inference mode)
+    mod_b(x, y)
+    # force identical weights
+    pa, pb = net_a.collect_params(), net_b.collect_params()
+    for k in pa:
+        pb[k].set_data(mx.np.array(pa[k].data().asnumpy()))
+
+    tr_a = Trainer(pa, opt, dict(kw))
+    tr_b = Trainer(pb, opt, dict(kw))
+    fused = FusedTrainStep(mod_b, tr_b)
+
+    losses_a, losses_b = [], []
+    for _ in range(3):
+        with mx.autograd.record():
+            la = mod_a(x, y)
+        la.backward()
+        tr_a.step(8)
+        losses_a.append(la.asnumpy())
+        lb = fused(x, y, batch_size=8)
+        losses_b.append(lb.asnumpy())
+
+    for la, lb in zip(losses_a, losses_b):
+        assert_almost_equal(la, lb, rtol=1e-4, atol=1e-5)
+    for k in pa:
+        assert_almost_equal(pa[k].data().asnumpy(), pb[k].data().asnumpy(),
+                            rtol=1e-4, atol=1e-5,
+                            names=(f"eager:{k}", f"fused:{k}"))
+
+
+def test_fused_step_updates_batchnorm_stats():
+    mod, net = _make(1, with_bn=True)
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    fused = FusedTrainStep(mod, tr)
+    x = mx.np.array(onp.random.uniform(-1, 1, (8, 3, 6, 6)).astype(onp.float32))
+    y = mx.np.array(onp.random.randint(0, 8, (8,)), dtype="int32")
+    fused(x, y, batch_size=8)   # first step finishes deferred shape init
+    params = net.collect_params()
+    rm_key = [k for k in params if "running_mean" in k][0]
+    before = params[rm_key].data().asnumpy().copy()
+    for _ in range(3):
+        fused(x, y, batch_size=8)
+    after = params[rm_key].data().asnumpy()
+    assert onp.abs(after - before).max() > 0
+
+
+def test_fused_step_rejects_statless_optimizer():
+    class Weird(mx.optimizer.Optimizer):
+        supports_fused = False
+
+        def create_state(self, index, weight):
+            return None
+
+        def update(self, indices, weights, grads, states):
+            pass
+
+    mod, net = _make(2, with_bn=False)
+    tr = Trainer(net.collect_params(), Weird())
+    fused = FusedTrainStep(mod, tr)
+    x = mx.np.array(onp.zeros((2, 3, 6, 6), onp.float32))
+    y = mx.np.array(onp.zeros((2,), onp.int32))
+    with pytest.raises(ValueError, match="update_math"):
+        fused(x, y, batch_size=2)
+
+
+def test_fused_step_with_frozen_subset():
+    # trainer manages only the Dense tail; conv stays frozen (constant)
+    mod, net = _make(3, with_bn=False)
+    dense = [c for c in net._children.values()
+             if type(c).__name__ == "Dense"][0]
+    x = mx.np.array(onp.random.uniform(-1, 1, (4, 3, 6, 6)).astype(onp.float32))
+    y = mx.np.array(onp.random.randint(0, 8, (4,)), dtype="int32")
+    mod(x, y)
+    conv_w = [p for k, p in net.collect_params().items() if "0." in k][0]
+    before = conv_w.data().asnumpy().copy()
+    tr = Trainer(dense.collect_params(), "sgd", {"learning_rate": 0.5})
+    fused = FusedTrainStep(mod, tr)
+    fused(x, y, batch_size=4)
+    fused(x, y, batch_size=4)
+    assert_almost_equal(conv_w.data().asnumpy(), before, atol=0)  # frozen
+    dw = dense.weight.data().asnumpy()
+    assert onp.abs(dw).max() > 0

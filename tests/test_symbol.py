@@ -142,3 +142,46 @@ def test_unbound_variable_raises():
     b = sym.var("b")
     with pytest.raises(ValueError, match="unbound"):
         (a + b).eval(a=mx.np.array(onp.ones(2, onp.float32)))
+
+
+def test_check_symbolic_oracles():
+    from mxnet_tpu.test_utils import (check_symbolic_backward,
+                                      check_symbolic_forward)
+    a = sym.var("a")
+    b = sym.var("b")
+    s = sym.dot(a, b)
+    x = onp.random.randn(3, 4).astype(onp.float32)
+    w = onp.random.randn(4, 5).astype(onp.float32)
+    check_symbolic_forward(s, [x, w], [x @ w])
+    ct = onp.ones((3, 5), onp.float32)
+    check_symbolic_backward(s, [x, w], [ct], [ct @ w.T, x.T @ ct])
+
+
+def test_multi_output_backward_uses_all_cotangents():
+    a = sym.var("a")
+    g = sym.Group([a * 2.0, a * 3.0])
+    x = onp.ones(3, onp.float32)
+    ex = g.bind(args={"a": x})
+    ex.forward()
+    ct1 = mx.np.array(onp.full(3, 1.0, onp.float32))
+    ct2 = mx.np.array(onp.full(3, 10.0, onp.float32))
+    ex.backward([ct1, ct2])
+    # d/da (2a*1 + 3a*10) = 2 + 30
+    assert_almost_equal(ex.grad_dict["a"].asnumpy(), onp.full(3, 32.0),
+                        atol=1e-5)
+
+
+def test_getitem_out_of_range_raises():
+    a = sym.var("a")
+    s = sym.relu(a)
+    with pytest.raises(IndexError):
+        s[1]
+    assert list(s) == [s]   # iteration terminates
+
+
+def test_tojson_with_tuple_attr_roundtrip():
+    a = sym.var("a")
+    s = sym.reshape(a, (2, 3))
+    s2 = sym.loads(s.tojson())
+    x = onp.arange(6, dtype=onp.float32)
+    assert s2.eval(a=mx.np.array(x))[0].shape == (2, 3)
