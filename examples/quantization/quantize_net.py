@@ -1,0 +1,95 @@
+"""Post-training INT8 quantization walkthrough.
+
+Reference shape: `example/quantization/imagenet_gen_qsym_onedn.py` —
+train (or load) a float model, calibrate on sample batches, convert to
+int8, compare accuracy and latency.  The TPU path quantizes Gluon blocks
+directly (`contrib.quantization.quantize_net`); the int8 matmul/conv run
+on the MXU with int32 accumulation.
+
+Run: python examples/quantization/quantize_net.py [--mode entropy]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.gluon import nn
+
+
+def make_net():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(32, kernel_size=3, padding=1, activation="relu"))
+    net.add(nn.MaxPool2D(2))
+    net.add(nn.Conv2D(64, kernel_size=3, padding=1, activation="relu"))
+    net.add(nn.MaxPool2D(2))
+    net.add(nn.Dense(128, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+    return net
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", default="naive", choices=["naive", "entropy"])
+    p.add_argument("--batches", type=int, default=8)
+    args = p.parse_args()
+
+    onp.random.seed(0)
+    net = make_net()
+
+    # quick synthetic training so the float model is not random noise
+    X = onp.random.rand(512, 1, 28, 28).astype("float32")
+    Yv = (X.mean(axis=(1, 2, 3)) * 10).astype("int64") % 10
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    mod = _NetWithLoss(net, loss_fn)
+    fused = gluon.FusedTrainStep(mod, trainer)
+    for ep in range(3):
+        for i in range(0, 512, 64):
+            x = mx.np.array(X[i:i + 64])
+            y = mx.np.array(Yv[i:i + 64], dtype="int32")
+            loss = fused(x, y, batch_size=64)
+        print(f"epoch {ep}: loss {float(loss.asnumpy().mean()):.4f}")
+
+    xs = mx.np.array(X[:256])
+    float_logits = net(xs).asnumpy()
+    t0 = time.perf_counter()
+    net(xs).wait_to_read()
+    t_float = time.perf_counter() - t0
+
+    calib = [mx.np.array(X[i:i + 32]) for i in range(0, 32 * args.batches, 32)]
+    qnet = q.quantize_net(net, calib_data=calib, calib_mode=args.mode)
+    print("converted:", [type(c).__name__ for c in qnet._children.values()])
+
+    int8_logits = qnet(xs).asnumpy()
+    qnet(xs).wait_to_read()
+    t0 = time.perf_counter()
+    qnet(xs).wait_to_read()
+    t_int8 = time.perf_counter() - t0
+
+    agree = (int8_logits.argmax(1) == float_logits.argmax(1)).mean()
+    print(f"float->int8 argmax agreement: {agree:.3f}")
+    print(f"latency: float {t_float * 1e3:.1f} ms, int8 {t_int8 * 1e3:.1f} ms")
+
+
+class _NetWithLoss(gluon.HybridBlock):
+    def __init__(self, net, loss_fn):
+        super().__init__()
+        self.net = net
+        self.loss_fn = loss_fn
+
+    def forward(self, x, y):
+        return self.loss_fn(self.net(x), y)
+
+
+if __name__ == "__main__":
+    main()

@@ -3,7 +3,8 @@
 from ..ops.contrib import (box_iou, box_nms, bipartite_matching, roi_align,
                            multibox_prior, multibox_target,
                            multibox_detection, boolean_mask, allclose,
-                           index_copy, index_add, index_array)
+                           index_copy, index_add, index_array,
+                           circ_conv, k_smallest_flags)
 from . import text
 
 # reference CamelCase aliases (mx.nd.contrib.ROIAlign)
@@ -14,4 +15,5 @@ MultiBoxTarget = multibox_target
 
 __all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
            "ROIAlign", "multibox_prior", "MultiBoxPrior", "multibox_target", "MultiBoxTarget", "multibox_detection", "MultiBoxDetection",
-           "boolean_mask", "allclose", "index_copy", "index_add", "index_array"]
+           "boolean_mask", "allclose", "index_copy", "index_add", "index_array",
+           "circ_conv", "k_smallest_flags"]

@@ -278,6 +278,9 @@ class HybridBlock(Block):
         super().hybridize(active=False)  # children run inside this trace
 
     def _clear_cached(self):
+        from ..ops.invoke import evict_vjp_cache_for
+        for fn in self._jit_cache.values():
+            evict_vjp_cache_for(fn)
         self._jit_cache = {}
         self._cached_param_list = None
 

@@ -49,9 +49,12 @@ class FusedTrainStep:
 
     def _setup(self, args):
         block, trainer = self._block, self._trainer
-        if getattr(trainer._optimizer, "supports_fused", True) is False:
+        from ..optimizer.optimizer import Optimizer as _OptBase
+        opt = trainer._optimizer
+        if getattr(opt, "supports_fused", True) is False or \
+                type(opt).update_math is _OptBase.update_math:
             raise ValueError(
-                f"{type(trainer._optimizer).__name__} has no update_math; "
+                f"{type(opt).__name__} has no update_math; "
                 "use the eager record/backward/step path")
         block._ensure_shapes(*args)   # deferred shapes before state alloc
         trainer._init_kvstore()

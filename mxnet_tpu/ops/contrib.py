@@ -455,3 +455,31 @@ def index_array(data, axes=None):
         # reference emits int64; int32 is the TPU-native index type
         return idx.astype(jnp.int32)
     return invoke(f, (data,), name="index_array", differentiable=False)
+
+
+def circ_conv(data, weight):
+    """Batched 1-D circular convolution, Matlab cconv syntax (fork op
+    `src/operator/circ_conv.cc`: per-row out[j] = sum_k d[k] w[(j-k) mod n]).
+    TPU-native: one rfft/irfft pair on the VPU instead of the reference's
+    O(n^2) gather loop; exact for real inputs."""
+    def f(d, w):
+        n = d.shape[-1]
+        out = jnp.fft.irfft(jnp.fft.rfft(d, axis=-1) *
+                            jnp.fft.rfft(w, axis=-1), n=n, axis=-1)
+        return out.astype(d.dtype)
+    return invoke(f, (data, weight), name="circ_conv")
+
+
+def k_smallest_flags(data, k=1):
+    """Per-row mask of entries <= the k-th smallest (fork op
+    `src/operator/k_smallest_flags.cc`; 2-D input, flags dtype follows
+    data).  Non-differentiable (the reference backward is zero)."""
+    def f(d):
+        if not 1 <= k <= d.shape[1]:
+            raise ValueError(
+                f"k_smallest_flags: k={k} out of range for row length "
+                f"{d.shape[1]}")
+        thr = jnp.sort(d, axis=1)[:, k - 1:k]
+        return (d <= thr).astype(d.dtype)
+    return invoke(f, (data,), name="k_smallest_flags",
+                  differentiable=False)

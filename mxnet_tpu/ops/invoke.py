@@ -453,6 +453,16 @@ _VJP_EXEC_CACHE = {}
 _VJP_EXEC_CACHE_MAX = 256
 
 
+def evict_vjp_cache_for(fun):
+    """Drop deferred-vjp executors built over ``fun``.  The executor's
+    closure holds ``fun`` (for a hybridized block: the block and all its
+    parameter buffers), so HybridBlock._clear_cached calls this to avoid
+    pinning dropped models in device memory."""
+    fid = id(fun)
+    for key in [k for k in _VJP_EXEC_CACHE if k[0] == fid]:
+        del _VJP_EXEC_CACHE[key]
+
+
 def _lazy_vjp(node, ct):
     """Backward for a node recorded through the lazy fast path: one jitted
     program recomputes the forward and applies the vjp — compiled once per

@@ -233,3 +233,24 @@ class scope:
     def __exit__(self, *exc):
         self._jax_ctx.__exit__(*exc)
         self._span.stop()
+
+
+def dump_memory_profile(path="memory.pprof"):
+    """Write a device-memory snapshot in pprof format (the GPU memory
+    profiler analogue, reference `src/profiler/storage_profiler.h:131`;
+    on TPU the allocator is PjRt's, introspected via jax.profiler)."""
+    import jax
+    import jax.profiler as _jp
+
+    # Proxied PJRT plugins (e.g. a tunneled chip, platform_version
+    # "axon ...") don't implement the heap-profile C-API callbacks and
+    # LogFatal the whole process — refuse instead of aborting.
+    for d in jax.devices():
+        version = getattr(d.client, "platform_version", "")
+        if d.platform not in ("cpu", "gpu", "tpu") or "axon" in version:
+            raise NotImplementedError(
+                f"device_memory_profile unsupported on backend "
+                f"{d.platform!r} ({version.splitlines()[0] if version else ''})")
+    with open(path, "wb") as f:
+        f.write(_jp.device_memory_profile())
+    return path

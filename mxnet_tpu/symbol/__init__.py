@@ -302,8 +302,13 @@ class Executor:
         env = self._env()
         genv = {k: env[k] for k in self._grad_names}
         rest = {k: v for k, v in env.items() if k not in self._grad_names}
-        out = self._fwd(env)
-        outs = out if isinstance(out, tuple) else (out,)
+        # use the outputs from the preceding forward (no extra device
+        # program); fall back to one forward only if none has run yet
+        if self.outputs:
+            outs = tuple(o._data for o in self.outputs)
+        else:
+            out = self._fwd(env)
+            outs = out if isinstance(out, tuple) else (out,)
         if out_grads is None:
             cts = tuple(jax.numpy.ones_like(o) for o in outs)
         else:

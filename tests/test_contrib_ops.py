@@ -281,3 +281,32 @@ def test_multibox_target_every_gt_gets_an_anchor():
     _lt, _lm, cls_t = contrib.multibox_target(
         mx.np.array(anchors), mx.np.array(label), overlap_threshold=0.5)
     assert (cls_t.asnumpy()[0] == 4.0).sum() == 1  # stage-1 claim
+
+
+def test_circ_conv_matches_bruteforce():
+    onp.random.seed(11)
+    d = onp.random.randn(2, 6).astype(onp.float32)
+    w = onp.random.randn(2, 6).astype(onp.float32)
+    want = onp.zeros_like(d)
+    for b in range(2):
+        for j in range(6):
+            want[b, j] = sum(d[b, k] * w[b, (j - k) % 6] for k in range(6))
+    got = contrib.circ_conv(mx.np.array(d), mx.np.array(w)).asnumpy()
+    assert onp.abs(got - want).max() < 1e-5
+
+
+def test_circ_conv_grad():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    onp.random.seed(12)
+    d = mx.np.array(onp.random.randn(1, 5).astype(onp.float32))
+    w = mx.np.array(onp.random.randn(1, 5).astype(onp.float32))
+    check_numeric_gradient(lambda a, b: contrib.circ_conv(a, b).sum(),
+                           [d, w], rtol=1e-2, atol=1e-3)
+
+
+def test_k_smallest_flags():
+    d = onp.array([[3.0, 1.0, 2.0, 5.0],
+                   [0.0, -1.0, 4.0, 2.0]], onp.float32)
+    got = contrib.k_smallest_flags(mx.np.array(d), k=2).asnumpy()
+    want = onp.array([[0, 1, 1, 0], [1, 1, 0, 0]], onp.float32)
+    assert (got == want).all()

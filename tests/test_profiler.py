@@ -67,3 +67,10 @@ def test_profiler_pause_resume():
     assert profiler.state() in ("pause", "paused", "run", "stop")
     profiler.resume()
     profiler.set_state("stop")
+
+
+def test_dump_memory_profile(tmp_path):
+    import mxnet_tpu.profiler as prof
+    p = prof.dump_memory_profile(str(tmp_path / "m.pprof"))
+    import os
+    assert os.path.getsize(p) > 0
