@@ -1,0 +1,78 @@
+"""BERT-base pretraining throughput on one chip (BASELINE config 4 path).
+
+MLM+NSP loss over the Gluon BERT, bf16, batch 32 x seq 128, driven by
+`gluon.FusedTrainStep` (one XLA program per step).  Prints one JSON line;
+best of three fully-drained windows (see bench.py for the sync rationale).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+B, T = 32, 128
+WARMUP = 6
+ITERS = 30
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import FusedTrainStep, Trainer
+    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.models import BertForPretraining
+
+    model = BertForPretraining(vocab_size=30522, units=768, hidden_size=3072,
+                               num_layers=12, num_heads=12, max_length=512,
+                               dropout=0.1)
+    model.initialize()
+    model.cast("bfloat16")
+
+    class PretrainLoss(HybridBlock):
+        def __init__(self, m):
+            super().__init__()
+            self.m = m
+
+        def forward(self, tokens, segments, labels):
+            mlm_logits, nsp_logits = self.m(tokens, segments)
+            logp = mx.npx.log_softmax(mlm_logits.astype("float32"), axis=-1)
+            mlm = -mx.np.mean(mx.npx.pick(logp, labels, axis=-1))
+            nsp = -mx.np.mean(
+                mx.npx.log_softmax(nsp_logits.astype("float32"))[:, 0])
+            return mlm + nsp
+
+    mod = PretrainLoss(model)
+    tokens = mx.np.array(onp.random.randint(0, 30522, (B, T)), dtype="int32")
+    segments = mx.np.array(onp.zeros((B, T)), dtype="int32")
+    labels = mx.np.array(onp.random.randint(0, 30522, (B, T)), dtype="int32")
+    trainer = Trainer(model.collect_params(), "adam", {"learning_rate": 1e-4})
+    step = FusedTrainStep(mod, trainer)
+
+    for _ in range(WARMUP):
+        loss = step(tokens, segments, labels, batch_size=B)
+    loss.wait_to_read()
+    mx.waitall()
+
+    windows = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            step(tokens, segments, labels, batch_size=B)
+        mx.waitall()
+        windows.append(B * T * ITERS / (time.perf_counter() - t0))
+
+    print(json.dumps({
+        "metric": "bert_base_pretrain_bf16_tokens_per_s",
+        "value": round(max(windows), 0),
+        "unit": "tokens/s",
+        "batch": B, "seq_len": T,
+        "window_tokens_per_s": [round(w) for w in windows],
+    }))
+
+
+if __name__ == "__main__":
+    main()

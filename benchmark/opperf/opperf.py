@@ -89,14 +89,34 @@ def _corpus(dtype):
     return ops
 
 
+def _window(fn, n, sync, t_sync):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    sync()
+    return max(time.perf_counter() - t0 - t_sync, 1e-9) / n
+
+
 def _time(fn, iters, *, sync):
+    """Best-of-3 windows, iteration count adapted so the op work dominates
+    the drain: the drain is a host round trip (~100 ms with ±tens of ms of
+    jitter through a tunneled chip), so a fixed small count would measure
+    the tunnel, not the op."""
     fn()  # warmup / compile
     sync()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn()
-    sync()
-    return (time.perf_counter() - t0) / iters * 1e6  # us
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sync()
+        samples.append(time.perf_counter() - t0)
+    t_sync = min(samples)
+
+    est = _window(fn, max(iters, 10), sync, t_sync)
+    n = min(max(iters, int(4 * t_sync / est) + 1), 20000)
+    best = min(_window(fn, n, sync, t_sync) for _ in range(3))
+    # below ~2 drains of op work the tunnel jitter owns the number
+    reliable = best * n >= 2 * t_sync
+    return best * 1e6, reliable  # us
 
 
 def run(categories=None, iters=50, dtype="float32", warmup=None):
@@ -110,7 +130,8 @@ def run(categories=None, iters=50, dtype="float32", warmup=None):
         fn, *args = make()
 
         # eager: imperative dispatch per call (tape + device dispatch)
-        eager_us = _time(lambda: fn(*args), iters, sync=mx.waitall)
+        eager_us, eager_ok = _time(lambda: fn(*args), iters,
+                                   sync=mx.waitall)
 
         # jit: the op compiled alone — kernel + PjRt call
         from mxnet_tpu.ndarray.ndarray import NDArray
@@ -120,8 +141,15 @@ def run(categories=None, iters=50, dtype="float32", warmup=None):
             out = _fn(*[NDArray(d) for d in ds])
             return out._data if isinstance(out, NDArray) else out
         jfn = jax.jit(jit_body)
-        jit_us = _time(lambda: jfn(*datas), iters,
-                       sync=lambda: jax.block_until_ready(jfn(*datas)))
+
+        def jit_sync():
+            # host readback, not block_until_ready: tunneled backends ack
+            # the latter immediately (see ndarray.waitall)
+            out = jfn(*datas)
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            onp.asarray(leaf.ravel()[0])
+        jit_us, jit_ok = _time(lambda: jfn(*datas), iters,
+                               sync=jit_sync)
 
         # fwd+bwd through the tape where the op is differentiable
         bwd_us = None
@@ -135,13 +163,15 @@ def run(categories=None, iters=50, dtype="float32", warmup=None):
                     out = fn(*args)
                 out.backward()
                 return out
-            bwd_us = _time(step, max(1, iters // 5), sync=mx.waitall)
+            bwd_us, _bwd_ok = _time(step, max(1, iters // 5),
+                                    sync=mx.waitall)
         except Exception:
             pass
 
         row = {"op": name, "category": cat, "eager_us": round(eager_us, 1),
                "jit_us": round(jit_us, 1),
-               "fwd_bwd_us": None if bwd_us is None else round(bwd_us, 1)}
+               "fwd_bwd_us": None if bwd_us is None else round(bwd_us, 1),
+               "reliable": bool(eager_ok and jit_ok)}
         results.append(row)
         print(f"{name:20s} {cat:9s} eager {row['eager_us']:>10} us   "
               f"jit {row['jit_us']:>10} us   "

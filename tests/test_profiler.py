@@ -70,7 +70,12 @@ def test_profiler_pause_resume():
 
 
 def test_dump_memory_profile(tmp_path):
+    import pytest
+
     import mxnet_tpu.profiler as prof
-    p = prof.dump_memory_profile(str(tmp_path / "m.pprof"))
+    try:
+        p = prof.dump_memory_profile(str(tmp_path / "m.pprof"))
+    except NotImplementedError as e:
+        pytest.skip(str(e))   # proxied PJRT backend without heap profiling
     import os
     assert os.path.getsize(p) > 0
