@@ -171,7 +171,8 @@ def run(categories=None, iters=50, dtype="float32", warmup=None):
         row = {"op": name, "category": cat, "eager_us": round(eager_us, 1),
                "jit_us": round(jit_us, 1),
                "fwd_bwd_us": None if bwd_us is None else round(bwd_us, 1),
-               "reliable": bool(eager_ok and jit_ok)}
+               "reliable": bool(eager_ok and jit_ok and
+                                (bwd_us is None or _bwd_ok))}
         results.append(row)
         print(f"{name:20s} {cat:9s} eager {row['eager_us']:>10} us   "
               f"jit {row['jit_us']:>10} us   "
