@@ -4,7 +4,7 @@ from ..ops.contrib import (box_iou, box_nms, bipartite_matching, roi_align,
                            multibox_prior, multibox_target,
                            multibox_detection, boolean_mask, allclose,
                            index_copy, index_add, index_array,
-                           circ_conv, k_smallest_flags)
+                           circ_conv, k_smallest_flags, hawkes_ll)
 from . import text
 
 # reference CamelCase aliases (mx.nd.contrib.ROIAlign)
@@ -16,4 +16,4 @@ MultiBoxTarget = multibox_target
 __all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
            "ROIAlign", "multibox_prior", "MultiBoxPrior", "multibox_target", "MultiBoxTarget", "multibox_detection", "MultiBoxDetection",
            "boolean_mask", "allclose", "index_copy", "index_add", "index_array",
-           "circ_conv", "k_smallest_flags"]
+           "circ_conv", "k_smallest_flags", "hawkes_ll"]

@@ -108,12 +108,17 @@ class FusedTrainStep:
         specs = shard_parameters(params, mesh, self._rules)
         names = sorted(params)
         rep = NamedSharding(mesh, PartitionSpec())
-        self._data_sharding = NamedSharding(mesh, self._data_spec)
-        # the number of shards along the data axis, for input divisibility
+        self._rep = rep
+        # per-rank input shardings: the spec is truncated to the array's
+        # rank so a rank-2 data_spec still places rank-1 labels
+        self._data_shardings = [
+            NamedSharding(mesh, PartitionSpec(*self._data_spec[:r]))
+            for r in range(1, 9)]
+        # shard count along the LEADING dim only, for divisibility checks
+        lead = self._data_spec[0] if len(self._data_spec) else None
         self._dp_size = 1
-        for ax in self._data_spec:
-            for name in ((ax,) if isinstance(ax, str) else (ax or ())):
-                self._dp_size *= mesh.shape[name]
+        for name in ((lead,) if isinstance(lead, str) else (lead or ())):
+            self._dp_size *= mesh.shape[name]
         self._shardings = [NamedSharding(mesh, specs[n]) for n in names]
         for i, k in zip(self._opt_index, self._train_idx):
             p_shape = self._plist[k].shape
@@ -189,10 +194,9 @@ class FusedTrainStep:
                     return d
                 if d.shape[0] >= self._dp_size and \
                         d.shape[0] % self._dp_size == 0:
-                    return jax.device_put(d, self._data_sharding)
-                return jax.device_put(
-                    d, jax.sharding.NamedSharding(
-                        self._mesh, jax.sharding.PartitionSpec()))
+                    return jax.device_put(
+                        d, self._data_shardings[min(d.ndim, 8) - 1])
+                return jax.device_put(d, self._rep)
             flat = [place(d) for d in flat]
         treedef_id = _intern_treedef(treedef)
         if self._jit is None:

@@ -483,3 +483,60 @@ def k_smallest_flags(data, k=1):
         return (d <= thr).astype(d.dtype)
     return invoke(f, (data,), name="k_smallest_flags",
                   differentiable=False)
+
+
+def hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Log-likelihood of a marked univariate Hawkes process (reference
+    `src/operator/contrib/hawkes_ll.cc`):
+    lambda_k*(t) = mu_k + alpha_k beta_k sum_{t_i<t, y_i=k} exp(-beta_k (t-t_i)).
+
+    mu (N,K), alpha (K,), beta (K,), state (N,K) carried memory,
+    lags (N,T) interarrival times, marks (N,T) int, valid_length (N,),
+    max_time (N,).  Returns (loglike (N,), out_state (N,K)).
+
+    TPU-native: a `lax.scan` over the T event steps vectorized across the
+    batch (the reference is a per-sample CPU/CUDA loop); gradients for all
+    float inputs come from the scan's vjp instead of the reference's
+    hand-written backward kernels.
+    """
+    def f(mu, alpha, beta, state, lags, marks, valid_length, max_time):
+        n, k = mu.shape
+        marks = marks.astype(jnp.int32)
+
+        def step(carry, inp):
+            t, last, st, ll = carry
+            lag, mark, j = inp
+            valid = (j < valid_length)
+            t_new = t + lag
+            idx = jnp.arange(n)
+            d = t_new - last[idx, mark]
+            a_m, b_m = alpha[mark], beta[mark]
+            s_m = st[idx, mark]
+            ed = jnp.exp(-b_m * d)
+            lda = mu[idx, mark] + a_m * b_m * s_m * ed
+            comp = mu[idx, mark] * d + a_m * s_m * (1.0 - ed)
+            ll = ll + jnp.where(valid, jnp.log(lda) - comp, 0.0)
+            upd = valid[:, None] & (mark[:, None] == jnp.arange(k))
+            st = jnp.where(upd, 1.0 + st * ed[:, None], st)
+            last = jnp.where(upd, t_new[:, None], last)
+            t = jnp.where(valid, t_new, t)
+            return (t, last, st, ll), None
+
+        t0 = jnp.zeros((n,), mu.dtype)
+        last0 = jnp.zeros((n, k), mu.dtype)
+        ll0 = jnp.zeros((n,), mu.dtype)
+        steps = lags.shape[1]
+        (t, last, st, ll), _ = lax.scan(
+            step, (t0, last0, state.astype(mu.dtype), ll0),
+            (lags.T, marks.T, jnp.arange(steps)))
+
+        # remaining compensators up to max_time + state decay (reference
+        # hawkesll_forward_compensator)
+        d = max_time[:, None] - last
+        ed = jnp.exp(-beta[None, :] * d)
+        rem = mu * d + alpha[None, :] * st * (1.0 - ed)
+        ll = ll - rem.sum(axis=1)
+        return ll, ed * st
+
+    return invoke(f, (mu, alpha, beta, state, lags, marks, valid_length,
+                      max_time), name="hawkes_ll")

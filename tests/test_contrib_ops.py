@@ -310,3 +310,72 @@ def test_k_smallest_flags():
     got = contrib.k_smallest_flags(mx.np.array(d), k=2).asnumpy()
     want = onp.array([[0, 1, 1, 0], [1, 1, 0, 0]], onp.float32)
     assert (got == want).all()
+
+
+def _np_hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length,
+                  max_time):
+    """Direct port of the reference per-sample loop (hawkes_ll-inl.h)."""
+    n, k = mu.shape
+    ll = onp.zeros(n)
+    out_state = state.astype(onp.float64).copy()
+    for i in range(n):
+        last = onp.zeros(k)
+        t = 0.0
+        for j in range(int(valid_length[i])):
+            ci = int(marks[i, j])
+            t += lags[i, j]
+            d = t - last[ci]
+            ed = onp.exp(-beta[ci] * d)
+            lda = mu[i, ci] + alpha[ci] * beta[ci] * out_state[i, ci] * ed
+            comp = mu[i, ci] * d + alpha[ci] * out_state[i, ci] * (1 - ed)
+            ll[i] += onp.log(lda) - comp
+            out_state[i, ci] = 1 + out_state[i, ci] * ed
+            last[ci] = t
+        for m in range(k):
+            d = max_time[i] - last[m]
+            ed = onp.exp(-beta[m] * d)
+            ll[i] -= mu[i, m] * d + alpha[m] * out_state[i, m] * (1 - ed)
+            out_state[i, m] = ed * out_state[i, m]
+    return ll, out_state
+
+
+def test_hawkes_ll_matches_reference_loop():
+    onp.random.seed(13)
+    n, t, k = 3, 7, 2
+    mu = onp.random.uniform(0.5, 1.5, (n, k)).astype(onp.float32)
+    alpha = onp.array([0.2, 0.3], onp.float32)
+    beta = onp.array([1.0, 2.0], onp.float32)
+    state = onp.random.uniform(0, 0.5, (n, k)).astype(onp.float32)
+    lags = onp.random.exponential(0.5, (n, t)).astype(onp.float32)
+    marks = onp.random.randint(0, k, (n, t)).astype(onp.int32)
+    valid_length = onp.array([7, 5, 3], onp.float32)
+    max_time = lags.sum(axis=1).astype(onp.float32) + 1.0
+
+    ll, out_state = contrib.hawkes_ll(
+        mx.np.array(mu), mx.np.array(alpha), mx.np.array(beta),
+        mx.np.array(state), mx.np.array(lags), mx.np.array(marks),
+        mx.np.array(valid_length), mx.np.array(max_time))
+    want_ll, want_state = _np_hawkes_ll(mu, alpha, beta, state, lags, marks,
+                                        valid_length, max_time)
+    assert onp.abs(ll.asnumpy() - want_ll).max() < 1e-4
+    assert onp.abs(out_state.asnumpy() - want_state).max() < 1e-5
+
+
+def test_hawkes_ll_grad():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    onp.random.seed(14)
+    n, t, k = 2, 4, 2
+    mu = mx.np.array(onp.random.uniform(0.5, 1.5, (n, k)).astype(onp.float32))
+    alpha = mx.np.array(onp.array([0.2, 0.3], onp.float32))
+    beta = mx.np.array(onp.array([1.0, 2.0], onp.float32))
+    state = mx.np.array(onp.zeros((n, k), onp.float32))
+    lags = onp.random.exponential(0.5, (n, t)).astype(onp.float32)
+    marks = mx.np.array(onp.random.randint(0, k, (n, t)).astype(onp.int32))
+    vl = mx.np.array(onp.full(n, t, onp.float32))
+    mt = mx.np.array(lags.sum(1) + 0.5)
+
+    def f(mu_, alpha_):
+        ll, _st = contrib.hawkes_ll(mu_, alpha_, beta, state,
+                                    mx.np.array(lags), marks, vl, mt)
+        return ll.sum()
+    check_numeric_gradient(f, [mu, alpha], rtol=1e-2, atol=1e-3)

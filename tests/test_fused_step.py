@@ -181,8 +181,9 @@ def test_fused_step_spmd_tensor_parallel_rules():
     from mxnet_tpu.parallel import mesh as pmesh
 
     mod, net = _make(10, with_bn=False)
-    x = mx.np.array(onp.random.uniform(-1, 1, (8, 3, 6, 6)).astype(onp.float32))
-    y = mx.np.array(onp.random.randint(0, 8, (8,)), dtype="int32")
+    rng = onp.random.RandomState(10)
+    x = mx.np.array(rng.uniform(-1, 1, (8, 3, 6, 6)).astype(onp.float32))
+    y = mx.np.array(rng.randint(0, 8, (8,)), dtype="int32")
     mod(x, y)
     tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
     mesh = pmesh.make_mesh({"dp": 4, "tp": 2})
@@ -218,4 +219,31 @@ def test_fused_step_spmd_broadcastable_extra_input():
     tr = Trainer(mod.collect_params(), "sgd", {"learning_rate": 0.1})
     fused = FusedTrainStep(mod, tr, mesh=pmesh.make_mesh({"dp": 8}))
     loss = fused(x, shift, y, batch_size=8)
+    assert onp.isfinite(loss.asnumpy()).all()
+
+
+def test_fused_step_spmd_rank2_data_spec_with_1d_labels():
+    # a 2-entry data_spec must truncate for rank-1 inputs instead of crashing
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu.parallel import mesh as pmesh
+
+    class MLP(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d = nn.Dense(4)
+
+        def forward(self, x, y):
+            return gloss.SoftmaxCrossEntropyLoss()(self.d(x), y)
+
+    mod = MLP()
+    mod.initialize()
+    rng = onp.random.RandomState(11)
+    x = mx.np.array(rng.randn(8, 6).astype(onp.float32))
+    y = mx.np.array(rng.randint(0, 4, (8,)), dtype="int32")
+    mod(x, y)
+    tr = Trainer(mod.collect_params(), "sgd", {"learning_rate": 0.1})
+    mesh = pmesh.make_mesh({"dp": 4, "tp": 2})
+    fused = FusedTrainStep(mod, tr, mesh=mesh, data_spec=P("dp", "tp"))
+    loss = fused(x, y, batch_size=8)
     assert onp.isfinite(loss.asnumpy()).all()
