@@ -27,15 +27,30 @@ class _BaseConvRNNCell(RecurrentCell):
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
                  conv_layout="NCHW"):
         super().__init__()
-        assert conv_layout == "NCHW", "only NCHW is supported"
-        self._input_shape = tuple(input_shape)  # (C, H, W)
+        if conv_layout not in ("NCW", "NCHW", "NCDHW"):
+            raise ValueError(f"unsupported conv_layout {conv_layout!r}")
+        self._layout = conv_layout
+        ndim = len(conv_layout) - 2
+        self._ndim = ndim
+        self._input_shape = tuple(input_shape)  # (C, *spatial)
         self._hc = hidden_channels
         self._ng = num_gates
-        self._i2h_kernel = _pair(i2h_kernel, 2)
-        self._h2h_kernel = _pair(h2h_kernel, 2)
+        self._i2h_kernel = _pair(i2h_kernel, ndim)
+        self._h2h_kernel = _pair(h2h_kernel, ndim)
+        self._i2h_pad = _pair(i2h_pad, ndim)
+        for nm, t in (("i2h_kernel", self._i2h_kernel),
+                      ("h2h_kernel", self._h2h_kernel),
+                      ("i2h_pad", self._i2h_pad)):
+            if len(t) != ndim:
+                raise ValueError(
+                    f"{nm}={t} has {len(t)} dims but conv_layout "
+                    f"{conv_layout!r} implies {ndim}")
+        if len(self._input_shape) != ndim + 1:
+            raise ValueError(
+                f"input_shape={input_shape} must be (C, *{ndim} spatial "
+                f"dims) for conv_layout {conv_layout!r}")
         assert all(k % 2 == 1 for k in self._h2h_kernel), \
             "h2h_kernel must be odd to preserve the state shape"
-        self._i2h_pad = _pair(i2h_pad, 2)
         self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
         self._activation = activation
 
@@ -57,27 +72,27 @@ class _BaseConvRNNCell(RecurrentCell):
             init=_resolve_init(h2h_bias_initializer))
 
     def _state_shape(self):
-        _c, h, w = self._input_shape
-        kh, kw = self._i2h_kernel
-        ph, pw = self._i2h_pad
-        oh = h + 2 * ph - kh + 1
-        ow = w + 2 * pw - kw + 1
-        return (self._hc, oh, ow)
+        spatial = self._input_shape[1:]
+        out = tuple(s + 2 * p - k + 1 for s, k, p in
+                    zip(spatial, self._i2h_kernel, self._i2h_pad))
+        return (self._hc,) + out
 
     def state_info(self, batch_size=0):
         shape = (batch_size,) + self._state_shape()
-        return [{"shape": shape, "__layout__": "NCHW"}
+        return [{"shape": shape, "__layout__": self._layout}
                 for _ in range(len(self._state_names))]
 
     def _proj(self, x, states):
         i2h = npx.convolution(x, self.i2h_weight.data(),
                               self.i2h_bias.data(),
                               kernel=self._i2h_kernel, pad=self._i2h_pad,
-                              num_filter=self._ng * self._hc)
+                              num_filter=self._ng * self._hc,
+                              layout=self._layout)
         h2h = npx.convolution(states[0], self.h2h_weight.data(),
                               self.h2h_bias.data(),
                               kernel=self._h2h_kernel, pad=self._h2h_pad,
-                              num_filter=self._ng * self._hc)
+                              num_filter=self._ng * self._hc,
+                              layout=self._layout)
         return i2h, h2h
 
     def _act(self, x):
@@ -140,3 +155,28 @@ class ConvGRUCell(_BaseConvRNNCell):
         n = self._act(i2h[:, 2 * hc:] + r * h2h[:, 2 * hc:])
         next_h = (1 - z) * n + z * states[0]
         return next_h, [next_h]
+
+
+def _dim_variant(base, ndim, layout, name):
+    """Reference-named per-dimension conv cell (reference
+    conv_rnn_cell.py:217-855: Conv{1,2,3}D{RNN,LSTM,GRU}Cell)."""
+    class _Cell(base):
+        def __init__(self, input_shape, hidden_channels,
+                     i2h_kernel=(3,) * ndim, h2h_kernel=(3,) * ndim,
+                     i2h_pad=(0,) * ndim, activation="tanh", **kwargs):
+            kwargs.setdefault("conv_layout", layout)
+            super().__init__(input_shape, hidden_channels, i2h_kernel,
+                             h2h_kernel, i2h_pad, activation, **kwargs)
+    _Cell.__name__ = _Cell.__qualname__ = name
+    return _Cell
+
+
+Conv1DRNNCell = _dim_variant(ConvRNNCell, 1, "NCW", "Conv1DRNNCell")
+Conv2DRNNCell = _dim_variant(ConvRNNCell, 2, "NCHW", "Conv2DRNNCell")
+Conv3DRNNCell = _dim_variant(ConvRNNCell, 3, "NCDHW", "Conv3DRNNCell")
+Conv1DLSTMCell = _dim_variant(ConvLSTMCell, 1, "NCW", "Conv1DLSTMCell")
+Conv2DLSTMCell = _dim_variant(ConvLSTMCell, 2, "NCHW", "Conv2DLSTMCell")
+Conv3DLSTMCell = _dim_variant(ConvLSTMCell, 3, "NCDHW", "Conv3DLSTMCell")
+Conv1DGRUCell = _dim_variant(ConvGRUCell, 1, "NCW", "Conv1DGRUCell")
+Conv2DGRUCell = _dim_variant(ConvGRUCell, 2, "NCHW", "Conv2DGRUCell")
+Conv3DGRUCell = _dim_variant(ConvGRUCell, 3, "NCDHW", "Conv3DGRUCell")

@@ -35,13 +35,19 @@ class RecurrentCell(HybridBlock):
         return states
 
     def reset(self):
-        pass
+        """Clear per-sequence state; recurses into child cells (the
+        reference reset, rnn_cell.py:164, resets `_children` too)."""
+        for child in self._children.values():
+            if isinstance(child, RecurrentCell):
+                child.reset()
 
     def __call__(self, inputs, states, **kwargs):
         return super().__call__(inputs, states, **kwargs)
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
+        self.reset()   # new sequence: per-sequence caches (e.g. locked
+        # dropout masks) re-draw, matching the reference unroll contract
         axis = layout.find("T")
         if isinstance(inputs, (list, tuple)):
             # list of per-step (N, ...) tensors (reference _format_sequence)
@@ -343,3 +349,106 @@ class BidirectionalCell(RecurrentCell):
             out = [mxnp.squeeze(s, axis=axis)
                    for s in mxnp.split(out, length, axis=axis)]
         return out, l_states + r_states
+
+
+# public aliases matching the reference class hierarchy (reference
+# rnn_cell.py:310,755,887 — here every cell is hybrid-capable, so the
+# Hybrid* variants and the modifier base are the same classes)
+HybridRecurrentCell = RecurrentCell
+HybridSequentialRNNCell = SequentialRNNCell
+ModifierCell = _ModifierCell
+
+
+class VariationalDropoutCell(_ModifierCell):
+    """Variational (locked) dropout over a base cell (reference
+    rnn_cell.py:1090, Gal & Ghahramani 2016): ONE dropout mask per
+    sequence for inputs/outputs/first-state, fixed across time steps;
+    masks re-draw at ``reset()``."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        if drop_states and isinstance(base_cell, BidirectionalCell):
+            raise ValueError(
+                "BidirectionalCell doesn't support variational state "
+                "dropout; wrap the cells underneath instead")
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._mask_in = None
+        self._mask_st = None
+        self._mask_out = None
+
+    def reset(self):
+        super().reset()
+        self._mask_in = self._mask_st = self._mask_out = None
+
+    @staticmethod
+    def _mask(like, rate):
+        # inverted-dropout mask with the same scaling Dropout applies
+        return npx.dropout(mxnp.ones_like(like), p=rate, mode="always")
+
+    def forward(self, inputs, states):
+        from ...ops.invoke import is_training
+        if is_training():
+            if self.drop_inputs:
+                if self._mask_in is None:
+                    self._mask_in = self._mask(inputs, self.drop_inputs)
+                inputs = inputs * self._mask_in
+            if self.drop_states:
+                if self._mask_st is None:
+                    self._mask_st = self._mask(states[0], self.drop_states)
+                states = [states[0] * self._mask_st] + list(states[1:])
+        output, next_states = self.base_cell(inputs, states)
+        if is_training() and self.drop_outputs:
+            if self._mask_out is None:
+                self._mask_out = self._mask(output, self.drop_outputs)
+            output = output * self._mask_out
+        return output, next_states
+
+
+class LSTMPCell(_BaseRNNCell):
+    """LSTM with a hidden-state projection (reference rnn_cell.py:1260,
+    Sak et al. 2014): states are [h (projection_size,), c (hidden_size,)]
+    and h = (o * tanh(c')) @ W_h2r."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__(hidden_size, 4, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer)
+        self._projection_size = projection_size
+        # h2h consumes the PROJECTED state: replace the base's Parameter
+        self.h2h_weight = Parameter(
+            "h2h_weight", shape=(4 * hidden_size, projection_size),
+            init=_resolve_init(h2h_weight_initializer),
+            allow_deferred_init=True)
+        self.h2r_weight = Parameter(
+            "h2r_weight", shape=(projection_size, hidden_size),
+            init=_resolve_init(h2r_weight_initializer),
+            allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def forward(self, inputs, states):
+        i2h, h2h = self._proj(inputs, states)
+        if self.h2r_weight._data is None:
+            self.h2r_weight.finish_deferred_init()
+        gates = i2h + h2h
+        h = self._hidden_size
+        i = npx.sigmoid(gates[:, :h])
+        f = npx.sigmoid(gates[:, h:2 * h])
+        c_in = mxnp.tanh(gates[:, 2 * h:3 * h])
+        o = npx.sigmoid(gates[:, 3 * h:])
+        next_c = f * states[1] + i * c_in
+        hidden = o * mxnp.tanh(next_c)
+        next_h = npx.fully_connected(
+            hidden, self.h2r_weight.data(), None,
+            num_hidden=self._projection_size, flatten=False)
+        return next_h, [next_h, next_c]

@@ -121,3 +121,96 @@ def test_examples_run(script, args):
     r = subprocess.run([sys.executable, os.path.join(REPO, script)] + args,
                        capture_output=True, text=True, env=env, timeout=500)
     assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_lstmp_cell_projects_state():
+    from mxnet_tpu.gluon import rnn
+    cell = rnn.LSTMPCell(hidden_size=8, projection_size=3)
+    cell.initialize()
+    x = mx.np.array(onp.random.randn(4, 5).astype(onp.float32))
+    states = cell.begin_state(batch_size=4)
+    out, new_states = cell(x, states)
+    assert out.shape == (4, 3)                 # projected
+    assert new_states[0].shape == (4, 3)       # h is projected
+    assert new_states[1].shape == (4, 8)       # c keeps hidden size
+    # unroll works and grads flow
+    seq = [mx.np.array(onp.random.randn(4, 5).astype(onp.float32))
+           for _ in range(3)]
+    outs, _ = cell.unroll(3, seq)
+    assert outs[-1].shape == (4, 3)
+
+
+def test_variational_dropout_cell_locks_mask():
+    from mxnet_tpu.gluon import rnn
+    import mxnet_tpu.autograd as ag
+    base = rnn.RNNCell(hidden_size=6)
+    cell = rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    x = mx.np.array(onp.ones((2, 6), onp.float32))
+    states = cell.begin_state(batch_size=2)
+    with ag.record():
+        with ag.train_mode():
+            o1, s1 = cell(x, states)
+            m1 = cell._mask_in.asnumpy().copy()
+            o2, _ = cell(x, s1)
+            m2 = cell._mask_in.asnumpy()
+    # the mask is LOCKED: identical object/values across both steps
+    assert set(onp.unique(m1)) <= {0.0, 2.0}   # inverted dropout scaling
+    assert (m1 == m2).all()
+    # and it is actually applied: the base cell sees x*mask on step 1
+    base2 = rnn.RNNCell(hidden_size=6)
+    base2.initialize()
+    for k, p in base.collect_params().items():
+        base2.collect_params()[k].set_data(
+            mx.np.array(p.data().asnumpy()))
+    with ag.train_mode():
+        want, _ = base2(x * mx.np.array(m1), cell.begin_state(batch_size=2))
+    assert onp.allclose(o1.asnumpy(), want.asnumpy(), atol=1e-6)
+    cell.reset()
+    assert cell._mask_in is None
+    # reset() recurses from containers (reference reset semantics)
+    seq = rnn.SequentialRNNCell()
+    seq.add(rnn.VariationalDropoutCell(rnn.LSTMCell(4), drop_inputs=0.5))
+    inner = list(seq._children.values())[0]
+    inner._mask_in = mx.np.array(onp.ones((2, 4), onp.float32))
+    seq.reset()
+    assert inner._mask_in is None
+    # inference mode: no dropout applied
+    o3, _ = cell(x, states)
+    assert onp.isfinite(o3.asnumpy()).all()
+
+
+def test_conv1d_and_conv3d_lstm_cells():
+    from mxnet_tpu.gluon import rnn
+    c1 = rnn.Conv1DLSTMCell(input_shape=(2, 10), hidden_channels=4,
+                            i2h_kernel=(3,), i2h_pad=(1,))
+    c1.initialize()
+    x = mx.np.array(onp.random.randn(2, 2, 10).astype(onp.float32))
+    out, st = c1(x, c1.begin_state(batch_size=2))
+    assert out.shape == (2, 4, 10)
+    c3 = rnn.Conv3DLSTMCell(input_shape=(1, 4, 4, 4), hidden_channels=2,
+                            i2h_kernel=(3, 3, 3), i2h_pad=(1, 1, 1))
+    c3.initialize()
+    x3 = mx.np.array(onp.random.randn(2, 1, 4, 4, 4).astype(onp.float32))
+    out3, _ = c3(x3, c3.begin_state(batch_size=2))
+    assert out3.shape == (2, 2, 4, 4, 4)
+
+
+def test_unroll_redraws_variational_mask_per_sequence():
+    from mxnet_tpu.gluon import rnn
+    import mxnet_tpu.autograd as ag
+    cell = rnn.VariationalDropoutCell(rnn.RNNCell(6), drop_inputs=0.5)
+    cell.initialize()
+    seq4 = mx.np.array(onp.ones((4, 3, 6), onp.float32))
+    seq2 = mx.np.array(onp.ones((2, 3, 6), onp.float32))
+    with ag.train_mode():
+        cell.unroll(3, seq4)
+        # batch-size change across sequences must not reuse the old mask
+        cell.unroll(3, seq2)
+
+
+def test_conv_cell_rejects_mismatched_kernel_ndim():
+    from mxnet_tpu.gluon import rnn
+    with pytest.raises(ValueError, match="conv_layout"):
+        rnn.ConvLSTMCell(input_shape=(2, 10), hidden_channels=4,
+                         conv_layout="NCW")
