@@ -141,6 +141,50 @@ for _name in _JNP_FUNCS:
         continue
     _g[_name] = _make_op(_jf, _name, differentiable=_name not in _NON_DIFF)
 
+_NON_DIFF |= {"nanargmax", "nanargmin", "isin", "in1d", "intersect1d",
+              "union1d", "setdiff1d", "diag_indices", "packbits",
+              "spacing", "ix_"}
+# second wave: set ops (data-dependent shapes → eager-only, like
+# boolean_mask), nan arg-reductions, statistics, polynomial utilities
+for _name in ["nanargmax", "nanargmin", "isin", "intersect1d", "union1d",
+              "setdiff1d", "piecewise", "corrcoef", "cov", "unwrap",
+              "vander", "diag_indices", "packbits", "spacing",
+              "block", "ix_"]:
+    if _name in _g:
+        continue
+    _jf = getattr(jnp, _name, None)
+    if _jf is None:
+        continue
+    _g[_name] = _make_op(_jf, _name, differentiable=_name not in _NON_DIFF)
+
+# renamed/removed jnp aliases with reference-era numpy names
+row_stack = _g.get("vstack")
+trapz = _make_op(jnp.trapezoid, "trapz")
+round_ = _g.get("round")
+in1d = _make_op(lambda ar1, ar2, **kw: jnp.isin(ar1, ar2, **kw), "in1d",
+                differentiable=False)
+
+
+# functional variants of numpy's in-place mutators (XLA buffers are
+# immutable): these RETURN the updated array instead of mutating
+fill_diagonal = _make_op(
+    lambda a, val, wrap=False: jnp.fill_diagonal(a, val, wrap=wrap,
+                                                 inplace=False),
+    "fill_diagonal")
+put_along_axis = _make_op(
+    lambda a, idx, vals, axis: jnp.put_along_axis(a, idx, vals, axis,
+                                                  inplace=False),
+    "put_along_axis")
+
+
+def roots(p):
+    """Polynomial roots.  The underlying nonsymmetric eigensolver ('eig')
+    has no TPU lowering, so this computes on host numpy — eager-only,
+    like the reference's LAPACK-backed ops."""
+    arr = p.asnumpy() if hasattr(p, "asnumpy") else onp.asarray(p)
+    from ..ndarray.ndarray import NDArray
+    return NDArray(jnp.asarray(onp.roots(arr)))
+
 
 # ---------------------------------------------------------------------------
 # creation ops — honor ctx/device kwarg (reference: `mx.np.zeros(ctx=...)`)

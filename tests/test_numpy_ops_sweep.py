@@ -136,3 +136,62 @@ def test_gradients_of_sampled_unary_ops():
         y.backward()
         assert onp.allclose(x.grad.asnumpy(), dfn(_X), rtol=1e-4,
                             atol=1e-5), name
+
+
+def test_second_wave_ops():
+    a = mx.np.array(onp.array([3.0, onp.nan, 5.0, 1.0], onp.float32))
+    assert int(mx.np.nanargmax(a).asnumpy()) == 2
+    assert int(mx.np.nanargmin(a).asnumpy()) == 3
+
+    x = mx.np.array(onp.array([1, 2, 3, 4], onp.int32))
+    y = mx.np.array(onp.array([2, 4, 6], onp.int32))
+    assert (mx.np.isin(x, y).asnumpy() == [False, True, False, True]).all()
+    assert (mx.np.in1d(x, y).asnumpy() == [False, True, False, True]).all()
+    assert sorted(mx.np.intersect1d(x, y).asnumpy().tolist()) == [2, 4]
+    assert sorted(mx.np.union1d(x, y).asnumpy().tolist()) == [1, 2, 3, 4, 6]
+    assert sorted(mx.np.setdiff1d(x, y).asnumpy().tolist()) == [1, 3]
+
+    m = onp.random.RandomState(0).randn(3, 50).astype(onp.float32)
+    got = mx.np.corrcoef(mx.np.array(m)).asnumpy()
+    assert onp.allclose(got, onp.corrcoef(m), atol=1e-5)
+    got = mx.np.cov(mx.np.array(m)).asnumpy()
+    assert onp.allclose(got, onp.cov(m), atol=1e-4)
+
+    t = onp.linspace(0, 1, 11).astype(onp.float32)
+    v = (t ** 2).astype(onp.float32)
+    assert float(mx.np.trapz(mx.np.array(v), mx.np.array(t)).asnumpy()) == \
+        pytest.approx(onp.trapezoid(v, t), rel=1e-5)
+
+    vv = mx.np.vander(mx.np.array(onp.array([1.0, 2.0, 3.0], onp.float32)), 3)
+    assert onp.allclose(vv.asnumpy(), onp.vander([1.0, 2.0, 3.0], 3))
+
+    fd = mx.np.fill_diagonal(mx.np.array(onp.zeros((3, 3), onp.float32)), 7.0)
+    assert onp.allclose(fd.asnumpy(), onp.eye(3) * 7)
+
+    bl = mx.np.block([[mx.np.array(onp.ones((2, 2), onp.float32)),
+                       mx.np.array(onp.zeros((2, 2), onp.float32))]])
+    assert bl.shape == (2, 4)
+
+    rs = mx.np.row_stack([mx.np.array(onp.ones(3, onp.float32)),
+                          mx.np.array(onp.zeros(3, onp.float32))])
+    assert rs.shape == (2, 3)
+
+    pw = mx.np.unwrap(mx.np.array(
+        onp.array([0.0, onp.pi * 1.5, 0.0], onp.float32)))
+    assert onp.allclose(pw.asnumpy(),
+                        onp.unwrap([0.0, onp.pi * 1.5, 0.0]), atol=1e-5)
+
+
+def test_put_along_axis_and_roots():
+    a = mx.np.array(onp.zeros((3, 3), onp.float32))
+    idx = mx.np.array(onp.array([[1], [0], [2]], onp.int64))
+    vals = mx.np.array(onp.array([[5.0], [6.0], [7.0]], onp.float32))
+    got = mx.np.put_along_axis(a, idx, vals, 1).asnumpy()
+    want = onp.zeros((3, 3), onp.float32)
+    onp.put_along_axis(want, onp.array([[1], [0], [2]]),
+                       onp.array([[5.0], [6.0], [7.0]], onp.float32), 1)
+    assert (got == want).all()
+
+    r = mx.np.roots(mx.np.array(onp.array([1.0, -3.0, 2.0], onp.float32)))
+    assert sorted(onp.real(r.asnumpy()).tolist()) == pytest.approx([1.0, 2.0],
+                                                                   abs=1e-4)
