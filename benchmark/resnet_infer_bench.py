@@ -1,0 +1,61 @@
+"""ResNet-50 bf16 inference throughput on one chip.
+
+Reference bar: V100 fp16 inference 2085-2355 img/s at batch 32/128
+(`docs/.../faq/perf.md:208-210`).  Hybridized model-zoo net, one jitted
+forward per batch; best of three fully-drained windows (see bench.py for
+the sync rationale).  Prints one JSON line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+BASELINE_IMG_PER_S = 2355.04  # V100 fp16, batch 128
+BATCH = 128
+WARMUP = 5
+ITERS = 50
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet50_v1()
+    net.initialize(init=mx.init.Xavier())
+    net.cast("bfloat16")
+    net.hybridize(static_alloc=True)
+
+    x = mx.np.array(onp.random.uniform(-1, 1, (BATCH, 3, 224, 224)),
+                    dtype="bfloat16")
+    for _ in range(WARMUP):
+        out = net(x)
+    out.wait_to_read()
+    mx.waitall()
+
+    windows = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            net(x)
+        mx.waitall()
+        windows.append(BATCH * ITERS / (time.perf_counter() - t0))
+
+    img_per_s = max(windows)
+    print(json.dumps({
+        "metric": "resnet50_infer_bf16_img_per_s",
+        "value": round(img_per_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_per_s / BASELINE_IMG_PER_S, 3),
+        "batch": BATCH,
+        "window_img_per_s": [round(w, 2) for w in windows],
+    }))
+
+
+if __name__ == "__main__":
+    main()
