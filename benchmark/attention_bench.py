@@ -1,0 +1,76 @@
+"""Long-sequence attention: flash (Pallas) vs dense (XLA) on one chip.
+
+The long-context story's perf evidence: at sequence lengths where the
+(T, T) score matrix stresses HBM, the blockwise Pallas kernel keeps
+memory O(T * block) and overtakes XLA's dense fusion.  fwd and fwd+bwd
+timed with the true-drain methodology (see bench.py).  Prints one JSON
+line per (T, variant).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+B, H, D = 4, 8, 64
+WARMUP = 3
+ITERS = 10
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_kernels as pk
+    from mxnet_tpu.ndarray.ndarray import waitall
+
+    def dense(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    def flash(q, k, v):
+        return pk._flash(q, k, v, False, None, 128, 128, None)
+
+    for t in (2048, 4096, 8192):
+        qkv = [jnp.asarray(onp.random.randn(B, H, t, D), jnp.bfloat16)
+               for _ in range(3)]
+
+        for name, impl in (("dense", dense), ("flash", flash)):
+            fn = jax.jit(impl)
+            gn = jax.jit(jax.grad(
+                lambda q, k, v: impl(q, k, v).sum().astype(jnp.float32)))
+
+            def fwd():
+                return fn(*qkv)
+
+            def fwd_bwd():
+                return gn(*qkv)
+
+            try:
+                for kind, step in (("fwd", fwd), ("fwd_bwd", fwd_bwd)):
+                    for _ in range(WARMUP):
+                        step()
+                    waitall()
+                    t0 = time.perf_counter()
+                    for _ in range(ITERS):
+                        step()
+                    waitall()
+                    ms = (time.perf_counter() - t0) / ITERS * 1e3
+                    print(json.dumps({
+                        "metric": f"attn_{name}_{kind}_ms",
+                        "seq_len": t, "value": round(ms, 2), "unit": "ms",
+                        "tokens_per_s": round(B * t / (ms / 1e3)),
+                    }))
+            except Exception as e:
+                print(json.dumps({"metric": f"attn_{name}_error",
+                                  "seq_len": t, "error": str(e)[:120]}))
+
+
+if __name__ == "__main__":
+    main()
