@@ -44,7 +44,8 @@ def main():
         for name, impl in (("dense", dense), ("flash", flash)):
             fn = jax.jit(impl)
             gn = jax.jit(jax.grad(
-                lambda q, k, v: impl(q, k, v).sum().astype(jnp.float32)))
+                lambda q, k, v: impl(q, k, v).sum().astype(jnp.float32),
+                argnums=(0, 1, 2)))
 
             def fwd():
                 return fn(*qkv)

@@ -8,7 +8,6 @@ the map from the reference (`sxjscience/mxnet`) to this design.  Import as::
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
 
 import jax as _jax
 
@@ -65,6 +64,18 @@ from . import rtc
 from . import visualization
 from . import visualization as viz
 from . import test_utils
+from . import attribute
+from . import dlpack
+from . import engine
+from . import error
+from . import libinfo
+from . import log
+from . import name
+from . import operator
+from .libinfo import __version__
+
+# legacy custom-op entry: mx.nd.Custom(data..., op_type="name")
+ndarray.Custom = operator.invoke_custom  # (mx.nd is the same module)
 
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "NDArray", "nd", "np",

@@ -1,0 +1,42 @@
+"""Symbol attribute scoping (reference: `python/mxnet/attribute.py`)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope:
+    """Thread-scoped attribute dict applied to symbols created inside the
+    scope (reference attribute.py:28)."""
+
+    _state = threading.local()
+
+    def __init__(self, **kwargs):
+        self._attr = {k: str(v) for k, v in kwargs.items()}
+        self._old = None
+
+    def get(self, attr=None):
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        self._old = current()
+        merged = AttrScope()
+        merged._attr = {**self._old._attr, **self._attr}
+        self._merged = merged
+        AttrScope._state.current = merged
+        return self
+
+    def __exit__(self, *_exc):
+        AttrScope._state.current = self._old
+
+
+def current():
+    cur = getattr(AttrScope._state, "current", None)
+    if cur is None:
+        cur = AttrScope()
+        AttrScope._state.current = cur
+    return cur

@@ -1,0 +1,39 @@
+"""DLPack interop (reference: `python/mxnet/dlpack.py`).
+
+Zero-copy exchange with torch/numpy/cupy through the DLPack protocol,
+riding `jax.dlpack`.  `to_dlpack_for_read`/`to_dlpack_for_write` both wait
+for the buffer (the reference distinguishes read/write engine deps; XLA
+buffers are immutable so both are a read-barrier + export)."""
+from __future__ import annotations
+
+import jax
+import jax.dlpack
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["to_dlpack_for_read", "to_dlpack_for_write", "from_dlpack"]
+
+
+def _export(arr):
+    arr.wait_to_read()
+    # modern protocol: the array itself is a capsule provider
+    # (jax arrays implement __dlpack__)
+    return arr._data
+
+
+def to_dlpack_for_read(data):
+    """NDArray → DLPack-capable object (consume with
+    `torch.utils.dlpack.from_dlpack` / `np.from_dlpack`)."""
+    return _export(data)
+
+
+def to_dlpack_for_write(data):
+    # XLA buffers are immutable; writers must copy, same net semantics as
+    # the reference's write-dependency version
+    return _export(data)
+
+
+def from_dlpack(ext):
+    """DLPack-capable object (torch/cupy/numpy array or legacy capsule) →
+    NDArray sharing memory where the backend allows."""
+    return NDArray(jax.dlpack.from_dlpack(ext))

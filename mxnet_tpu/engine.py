@@ -1,0 +1,31 @@
+"""Engine controls (reference: `python/mxnet/engine.py`).
+
+The reference bulks small engine ops to amortize dispatch
+(`threaded_engine.h:507`).  On TPU, XLA fusion inside a jit is the real
+bulking; these knobs are kept for API compatibility — they record the
+requested size and advise hybridize/FusedTrainStep, which subsume them."""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["set_bulk_size", "bulk"]
+
+_bulk_size = 0
+
+
+def set_bulk_size(size):
+    """Set the op-bulking budget; returns the previous value.  Advisory on
+    TPU: tracing (hybridize / FusedTrainStep) fuses unconditionally."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Scoped bulking (reference `engine.bulk`)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

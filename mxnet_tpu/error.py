@@ -1,0 +1,42 @@
+"""Exception classes (reference: `python/mxnet/error.py`)."""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
+           "TypeError", "AttributeError", "NotImplementedForSymbol",
+           "register"]
+
+
+class InternalError(MXNetError):
+    """Framework-internal invariant violation."""
+
+
+class IndexError(MXNetError, IndexError):            # noqa: A001
+    pass
+
+
+class ValueError(MXNetError, ValueError):            # noqa: A001
+    pass
+
+
+class TypeError(MXNetError, TypeError):              # noqa: A001
+    pass
+
+
+class AttributeError(MXNetError, AttributeError):    # noqa: A001
+    pass
+
+
+class NotImplementedForSymbol(MXNetError):
+    pass
+
+
+_ERROR_TYPES = {}
+
+
+def register(cls):
+    """Register an error class for message-prefix resolution (reference
+    error.py `register`)."""
+    _ERROR_TYPES[cls.__name__] = cls
+    return cls

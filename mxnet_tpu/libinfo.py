@@ -1,0 +1,20 @@
+"""Version / build info (reference: `python/mxnet/libinfo.py`)."""
+from __future__ import annotations
+
+__all__ = ["__version__", "find_lib_path", "find_include_path"]
+
+# 2.0-era reference lineage, TPU-native rebuild
+__version__ = "2.0.0.tpu1"
+
+
+def find_lib_path(prefix=None):
+    """Paths of the native components (reference: locate libmxnet.so).
+    Here: the ctypes-loaded C++ core, when built."""
+    import os
+
+    from ._native import _SO
+    return [_SO] if os.path.exists(_SO) else []
+
+
+def find_include_path():
+    return []

@@ -1,0 +1,27 @@
+"""Logging helpers (reference: `python/mxnet/log.py`)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger"]
+
+_LOGGER_FMT = "%(asctime)-15s %(message)s"
+
+
+def get_logger(name=None, filename=None, filemode=None, level=logging.WARNING):
+    """Create/retrieve a configured logger (reference log.py:73)."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_init_done", False):
+        logger.setLevel(level)
+        return logger
+    logger._init_done = True
+    if filename:
+        mode = filemode or "a"
+        hdlr = logging.FileHandler(filename, mode)
+    else:
+        hdlr = logging.StreamHandler(sys.stderr)
+    hdlr.setFormatter(logging.Formatter(_LOGGER_FMT))
+    logger.addHandler(hdlr)
+    logger.setLevel(level)
+    return logger

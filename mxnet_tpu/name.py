@@ -1,0 +1,51 @@
+"""Automatic operator naming (reference: `python/mxnet/name.py`)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class NameManager:
+    """Thread-scoped unique-name generator (reference name.py:27)."""
+
+    _state = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return f"{hint}{idx}"
+
+    def __enter__(self):
+        self._old = current()
+        NameManager._state.current = self
+        return self
+
+    def __exit__(self, *_exc):
+        NameManager._state.current = self._old
+
+
+class Prefix(NameManager):
+    """Prepends a prefix to every generated name (reference name.py:83)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        # the reference Prefix namespaces EVERY name, explicit ones included
+        return self._prefix + (name if name else super().get(None, hint))
+
+
+def current():
+    cur = getattr(NameManager._state, "current", None)
+    if cur is None:
+        cur = NameManager()
+        NameManager._state.current = cur
+    return cur

@@ -1,0 +1,123 @@
+"""Legacy python custom-operator API.
+
+Reference: `python/mxnet/operator.py` (CustomOp/CustomOpProp/register, the
+`mx.nd.Custom(..., op_type=...)` entry, backed by the C++ custom-op host
+thread pool `src/operator/custom/custom-inl.h:52`).
+
+TPU-native design: there is no worker-thread bridge — a custom op is plain
+python over NDArrays executed eagerly, and its backward hooks into the
+same tape machinery as `autograd.Function` (one opaque vjp node).  The
+faster path for new code is `ops/invoke.invoke` (any pure jax function is
+a differentiable op) or `rtc.PallasModule` for real kernels; this module
+exists so legacy `CustomOp` code ports unchanged.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import autograd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for python operators (reference operator.py:434)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write ``src`` into ``dst`` honoring req ('null'/'write'/'add')."""
+        if req == "null":
+            return
+        if req == "add":
+            dst[:] = dst + src
+        else:
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Operator metadata (reference operator.py:487)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under ``op_type``
+    (reference operator.py `register`)."""
+    def wrapper(prop_cls):
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return wrapper
+
+
+def get_all_registered():
+    return dict(_REGISTRY)
+
+
+class _CustomFunction(autograd.Function):
+    def __init__(self, op, prop):
+        super().__init__()
+        self._op = op
+        self._prop = prop
+
+    def forward(self, *inputs):
+        from .ops.invoke import is_training
+
+        in_shapes = [list(i.shape) for i in inputs]
+        _, out_shapes, _aux = self._prop.infer_shape(in_shapes)
+        in_types = [i.dtype for i in inputs]
+        _, out_types, _ = self._prop.infer_type(in_types)
+        outs = [NDArray(onp.zeros(tuple(s), dtype=t))
+                for s, t in zip(out_shapes, out_types)]
+        self._op.forward(is_training(), ["write"] * len(outs),
+                         list(inputs), outs, [])
+        self.save_for_backward(tuple(inputs), tuple(outs))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def backward(self, *output_grads):
+        inputs, outs = self.saved_tensors
+        in_grads = [NDArray(onp.zeros(i.shape, dtype=i.dtype))
+                    for i in inputs]
+        self._op.backward(["write"] * len(in_grads), list(output_grads),
+                          list(inputs), list(outs), in_grads, [])
+        return in_grads[0] if len(in_grads) == 1 else tuple(in_grads)
+
+
+def invoke_custom(*data, op_type, **kwargs):
+    """`mx.nd.Custom` (reference `_ctypes/ndarray.py` Custom dispatch)."""
+    prop_cls = _REGISTRY.get(op_type)
+    if prop_cls is None:
+        raise ValueError(f"custom op {op_type!r} is not registered "
+                         f"(known: {sorted(_REGISTRY)})")
+    str_kwargs = {k: str(v) for k, v in kwargs.items()}
+    prop = prop_cls(**str_kwargs) if str_kwargs else prop_cls()
+    op = prop.create_operator(None, [list(d.shape) for d in data],
+                              [d.dtype for d in data])
+    return _CustomFunction(op, prop)(*data)

@@ -1,0 +1,91 @@
+"""Legacy top-level module parity: operator (CustomOp), dlpack, engine,
+name/attribute scopes, error classes, libinfo.
+
+Reference strategy: `tests/python/unittest/test_operator.py::test_custom_op`,
+`test_dlpack`.
+"""
+import numpy as onp
+import pytest
+import torch
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_custom_op_forward_backward():
+    @mx.operator.register("scale2")
+    class Scale2Prop(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class Scale2(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * 2.0)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * 2.0)
+            return Scale2()
+
+    x = mx.np.array(onp.array([1.0, 2.0, 3.0], onp.float32))
+    out = mx.nd.Custom(x, op_type="scale2")
+    assert onp.allclose(out.asnumpy(), [2.0, 4.0, 6.0])
+
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="scale2")
+        loss = (y * mx.np.array(onp.array([1.0, 10.0, 100.0], onp.float32))).sum()
+    loss.backward()
+    assert onp.allclose(x.grad.asnumpy(), [2.0, 20.0, 200.0])
+
+
+def test_custom_op_unregistered_raises():
+    with pytest.raises(ValueError, match="not registered"):
+        mx.nd.Custom(mx.np.array(onp.zeros(2, onp.float32)),
+                     op_type="nope_xyz")
+
+
+def test_dlpack_roundtrip_with_torch():
+    x = mx.np.array(onp.arange(6, dtype=onp.float32).reshape(2, 3))
+    t = torch.utils.dlpack.from_dlpack(mx.dlpack.to_dlpack_for_read(x))
+    assert torch.allclose(t, torch.arange(6, dtype=torch.float32).view(2, 3))
+
+    src = torch.full((3,), 7.0)
+    back = mx.dlpack.from_dlpack(src)
+    assert onp.allclose(back.asnumpy(), onp.full(3, 7.0))
+
+
+def test_engine_bulk_scope():
+    prev = mx.engine.set_bulk_size(16)
+    assert mx.engine.set_bulk_size(prev) == 16
+    with mx.engine.bulk(8):
+        pass  # advisory on TPU; must roundtrip without error
+
+
+def test_name_manager_and_prefix():
+    nm = mx.name.NameManager()
+    with nm:
+        assert nm.get(None, "conv") == "conv0"
+        assert nm.get(None, "conv") == "conv1"
+        assert nm.get("explicit", "conv") == "explicit"
+    with mx.name.Prefix("net_"):
+        assert mx.name.current().get(None, "fc") == "net_fc0"
+        # the reference Prefix namespaces explicit names too
+        assert mx.name.current().get("fc9", "fc") == "net_fc9"
+
+
+def test_attr_scope_nesting():
+    with mx.attribute.AttrScope(group="a"):
+        assert mx.attribute.current().get()["group"] == "a"
+        with mx.attribute.AttrScope(lr_mult="2"):
+            got = mx.attribute.current().get()
+            assert got["group"] == "a" and got["lr_mult"] == "2"
+        assert "lr_mult" not in mx.attribute.current().get()
+
+
+def test_error_classes_and_version():
+    assert issubclass(mx.error.ValueError, mx.MXNetError)
+    assert issubclass(mx.error.ValueError, ValueError)
+    assert mx.__version__.startswith("2.")
+    assert isinstance(mx.libinfo.find_lib_path(), list)
