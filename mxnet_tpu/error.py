@@ -7,36 +7,33 @@ __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "TypeError", "AttributeError", "NotImplementedForSymbol",
            "register"]
 
-
+@register
 class InternalError(MXNetError):
     """Framework-internal invariant violation."""
 
 
+
+
+@register
 class IndexError(MXNetError, IndexError):            # noqa: A001
     pass
 
 
+@register
 class ValueError(MXNetError, ValueError):            # noqa: A001
     pass
 
 
+@register
 class TypeError(MXNetError, TypeError):              # noqa: A001
     pass
 
 
+@register
 class AttributeError(MXNetError, AttributeError):    # noqa: A001
     pass
 
 
+@register
 class NotImplementedForSymbol(MXNetError):
     pass
-
-
-_ERROR_TYPES = {}
-
-
-def register(cls):
-    """Register an error class for message-prefix resolution (reference
-    error.py `register`)."""
-    _ERROR_TYPES[cls.__name__] = cls
-    return cls

@@ -59,7 +59,7 @@ class CustomOpProp:
         return []
 
     def infer_shape(self, in_shape):
-        return in_shape, [in_shape[0]], []
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
 
     def infer_type(self, in_type):
         return in_type, [in_type[0]] * len(self.list_outputs()), []
@@ -82,21 +82,23 @@ def get_all_registered():
 
 
 class _CustomFunction(autograd.Function):
-    def __init__(self, op, prop):
+    def __init__(self, op, prop, is_train):
         super().__init__()
         self._op = op
         self._prop = prop
+        # captured BEFORE Function.__call__ pauses the tape (pause() also
+        # clears the training flag, so reading it inside forward would
+        # always see False)
+        self._is_train = is_train
 
     def forward(self, *inputs):
-        from .ops.invoke import is_training
-
         in_shapes = [list(i.shape) for i in inputs]
         _, out_shapes, _aux = self._prop.infer_shape(in_shapes)
         in_types = [i.dtype for i in inputs]
         _, out_types, _ = self._prop.infer_type(in_types)
         outs = [NDArray(onp.zeros(tuple(s), dtype=t))
                 for s, t in zip(out_shapes, out_types)]
-        self._op.forward(is_training(), ["write"] * len(outs),
+        self._op.forward(self._is_train, ["write"] * len(outs),
                          list(inputs), outs, [])
         self.save_for_backward(tuple(inputs), tuple(outs))
         return outs[0] if len(outs) == 1 else tuple(outs)
@@ -118,6 +120,7 @@ def invoke_custom(*data, op_type, **kwargs):
                          f"(known: {sorted(_REGISTRY)})")
     str_kwargs = {k: str(v) for k, v in kwargs.items()}
     prop = prop_cls(**str_kwargs) if str_kwargs else prop_cls()
+    from .ops.invoke import is_training
     op = prop.create_operator(None, [list(d.shape) for d in data],
                               [d.dtype for d in data])
-    return _CustomFunction(op, prop)(*data)
+    return _CustomFunction(op, prop, is_training())(*data)

@@ -168,3 +168,19 @@ def test_launcher_propagates_failure(tmp_path):
         capture_output=True, text=True, env=env, timeout=240)
     assert r.returncode == 1
     assert "workers failed: [1]" in r.stderr
+
+
+def test_two_process_global_array_collective(tmp_path):
+    """Same-binary 2-process SPMD: a dp-sharded global array reduces
+    across processes through jax.distributed (the DCN story's local
+    equivalent; reference tests/nightly/dist_sync_kvstore.py pattern)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable,
+         os.path.join(REPO, "tests", "dist_scripts", "psum_worker.py")],
+        capture_output=True, text=True, timeout=300,
+        env={k: v for k, v in os.environ.items()
+             if k != "PALLAS_AXON_POOL_IPS"})
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    assert "rank 0 OK 24.0" in r.stdout
+    assert "rank 1 OK 24.0" in r.stdout
