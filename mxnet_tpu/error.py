@@ -1,16 +1,24 @@
-"""Exception classes (reference: `python/mxnet/error.py`)."""
+"""Exception classes (reference: `python/mxnet/error.py`).
+
+The reference's ``register`` comes from ``base._MXNetErrorRegister``
+(`python/mxnet/error.py:47-80`); here it is the shared string registry
+from :mod:`mxnet_tpu.base`, keyed by class name so native/runtime code
+can map an error kind string to its Python class.
+"""
 from __future__ import annotations
 
-from .base import MXNetError
+from .base import MXNetError, registry
 
 __all__ = ["MXNetError", "InternalError", "IndexError", "ValueError",
            "TypeError", "AttributeError", "NotImplementedForSymbol",
            "register"]
 
+register = registry.get_register_func(MXNetError, "error")
+
+
 @register
 class InternalError(MXNetError):
     """Framework-internal invariant violation."""
-
 
 
 
