@@ -17,22 +17,17 @@ def _lazy_np():
 
 
 def __getattr__(name):
-    legacy = {
-        "elemwise_add": "add",
-        "elemwise_sub": "subtract",
-        "elemwise_mul": "multiply",
-        "elemwise_div": "true_divide",
-        "broadcast_add": "add",
-        "broadcast_sub": "subtract",
-        "broadcast_mul": "multiply",
-        "broadcast_div": "true_divide",
-        "broadcast_maximum": "maximum",
-        "broadcast_minimum": "minimum",
-        "broadcast_power": "power",
-    }
+    # the generated legacy op surface (reference
+    # `python/mxnet/ndarray/register.py:265-277`) takes precedence: its
+    # arg conventions (exclude=, special reshape codes, CamelCase layer
+    # ops, mutate-output optimizer kernels) differ from mx.np
+    import importlib
+    _legacy = importlib.import_module(".legacy", __name__)
+    if name == "legacy":
+        return _legacy
+    if not name.startswith("_") and hasattr(_legacy, name):
+        return getattr(_legacy, name)
     np_mod = _lazy_np()
-    if name in legacy:
-        return getattr(np_mod, legacy[name])
     if hasattr(np_mod, name):
         return getattr(np_mod, name)
     raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute {name!r}")

@@ -1,0 +1,1046 @@
+"""The legacy ``mx.nd.*`` generated-op surface.
+
+Reference: `python/mxnet/ndarray/register.py:265-277` generates ~21k LoC of
+wrappers over the registered ops (kernels in `src/operator/`); this module
+provides the same names and argument conventions over the TPU lowerings —
+CamelCase layer ops (`FullyConnected`, `Convolution`, `BatchNorm`, ...),
+the broadcast/elemwise zoo, legacy reductions (with ``exclude``), the
+special-code ``Reshape``, training heads with custom backward semantics
+(`SoftmaxOutput`), the fused ``RNN`` op, and the fused optimizer update
+kernels.  Everything dispatches through ``ops.invoke`` so autograd records
+it, and through the same lowerings Gluon uses, so the two APIs agree.
+
+``out=`` follows the reference's mutate-output convention: the result is
+rebound into the given NDArray (version bump; see `ndarray/ndarray.py`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import numpy_extension as _npx
+from ..context import current_context
+from ..ops import legacy_math as _lm
+from ..ops import nn as _nn
+from ..ops.invoke import invoke
+from .ndarray import NDArray
+
+
+def _nd(x):
+    return x if isinstance(x, NDArray) else NDArray(jnp.asarray(x))
+
+
+def _ret(res, out=None):
+    if out is None:
+        return res
+    out._rebind(res._data if isinstance(res, NDArray) else jnp.asarray(res))
+    return out
+
+
+def _inplace(arr, new):
+    """Mutate-in-place contract of the optimizer kernels: the state arg is
+    rebound to the updated value (reference kMutate outputs)."""
+    arr = _nd(arr)
+    arr._rebind(new._data if isinstance(new, NDArray) else jnp.asarray(new))
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# unary math missing from mx.np (`src/operator/tensor/elemwise_unary_op.cc`)
+# ---------------------------------------------------------------------------
+
+def rsqrt(data, out=None):
+    return _ret(invoke(lambda d: jax.lax.rsqrt(d), (data,), name="rsqrt"), out)
+
+
+def rcbrt(data, out=None):
+    return _ret(invoke(lambda d: 1.0 / jnp.cbrt(d), (data,), name="rcbrt"),
+                out)
+
+
+def softsign(data, out=None):
+    return _ret(invoke(lambda d: d / (1 + jnp.abs(d)), (data,),
+                       name="softsign"), out)
+
+
+def hard_sigmoid(data, alpha=0.2, beta=0.5, out=None):
+    return _ret(invoke(lambda d: jnp.clip(alpha * d + beta, 0, 1), (data,),
+                       name="hard_sigmoid"), out)
+
+
+def reciprocal(data, out=None):
+    return _ret(invoke(lambda d: 1.0 / d, (data,), name="reciprocal"), out)
+
+
+# ---------------------------------------------------------------------------
+# broadcast / elemwise binary zoo.  Legacy comparisons return the lhs float
+# dtype, not bool (`src/operator/tensor/elemwise_binary_broadcast_op_logic.cc`)
+# ---------------------------------------------------------------------------
+
+def _binary(name, fn, boolout=False):
+    def op(lhs, rhs, out=None):
+        def lower(a, b):
+            r = fn(a, b)
+            if boolout:
+                dt = a.dtype if jnp.issubdtype(a.dtype, jnp.floating) \
+                    else jnp.float32
+                r = r.astype(dt)
+            return r
+        return _ret(invoke(lower, (lhs, rhs), name=name), out)
+    op.__name__ = name
+    return op
+
+
+broadcast_add = _binary("broadcast_add", jnp.add)
+broadcast_plus = broadcast_add
+broadcast_sub = _binary("broadcast_sub", jnp.subtract)
+broadcast_minus = broadcast_sub
+broadcast_mul = _binary("broadcast_mul", jnp.multiply)
+broadcast_div = _binary("broadcast_div", jnp.divide)
+broadcast_mod = _binary("broadcast_mod", jnp.mod)
+broadcast_power = _binary("broadcast_power", jnp.power)
+broadcast_maximum = _binary("broadcast_maximum", jnp.maximum)
+broadcast_minimum = _binary("broadcast_minimum", jnp.minimum)
+broadcast_hypot = _binary("broadcast_hypot", jnp.hypot)
+broadcast_equal = _binary("broadcast_equal", jnp.equal, True)
+broadcast_not_equal = _binary("broadcast_not_equal", jnp.not_equal, True)
+broadcast_greater = _binary("broadcast_greater", jnp.greater, True)
+broadcast_greater_equal = _binary("broadcast_greater_equal",
+                                  jnp.greater_equal, True)
+broadcast_lesser = _binary("broadcast_lesser", jnp.less, True)
+broadcast_lesser_equal = _binary("broadcast_lesser_equal",
+                                 jnp.less_equal, True)
+broadcast_logical_and = _binary("broadcast_logical_and",
+                                jnp.logical_and, True)
+broadcast_logical_or = _binary("broadcast_logical_or", jnp.logical_or, True)
+broadcast_logical_xor = _binary("broadcast_logical_xor",
+                                jnp.logical_xor, True)
+elemwise_add = _binary("elemwise_add", jnp.add)
+elemwise_sub = _binary("elemwise_sub", jnp.subtract)
+elemwise_mul = _binary("elemwise_mul", jnp.multiply)
+elemwise_div = _binary("elemwise_div", jnp.divide)
+equal = broadcast_equal
+not_equal = broadcast_not_equal
+greater = broadcast_greater
+greater_equal = broadcast_greater_equal
+lesser = broadcast_lesser
+lesser_equal = broadcast_lesser_equal
+
+
+# ---------------------------------------------------------------------------
+# legacy reductions (`exclude` convention) and ordering ops
+# ---------------------------------------------------------------------------
+
+def _reduction(name):
+    def op(data, axis=None, keepdims=False, exclude=False, out=None):
+        return _ret(invoke(_lm.reduce_op, (data,),
+                           dict(axis=axis, keepdims=keepdims,
+                                exclude=exclude, op=name), name=name), out)
+    op.__name__ = name
+    return op
+
+
+sum = _reduction("sum")              # noqa: A001
+mean = _reduction("mean")
+prod = _reduction("prod")
+nansum = _reduction("nansum")
+nanprod = _reduction("nanprod")
+max = _reduction("max")              # noqa: A001
+min = _reduction("min")              # noqa: A001
+sum_axis = sum
+max_axis = max
+min_axis = min
+
+
+def norm(data, ord=2, axis=None, keepdims=False, out=None):  # noqa: A002
+    return _ret(invoke(_lm.norm, (data,),
+                       dict(ord=ord, axis=axis, keepdims=keepdims),
+                       name="norm"), out)
+
+
+def moments(data, axes=None, keepdims=False):
+    axes = tuple(axes) if axes is not None else None
+    return invoke(_lm.moments, (data,), dict(axes=axes, keepdims=keepdims),
+                  name="moments")
+
+
+def argmax(data, axis=None, keepdims=False, out=None):
+    return _ret(invoke(
+        lambda d: jnp.argmax(d, axis=axis, keepdims=keepdims).astype(
+            jnp.float32),
+        (data,), name="argmax", differentiable=False), out)
+
+
+def argmin(data, axis=None, keepdims=False, out=None):
+    return _ret(invoke(
+        lambda d: jnp.argmin(d, axis=axis, keepdims=keepdims).astype(
+            jnp.float32),
+        (data,), name="argmin", differentiable=False), out)
+
+
+def argmax_channel(data, out=None):
+    return _ret(invoke(_lm.argmax_channel, (data,), name="argmax_channel",
+                       differentiable=False), out)
+
+
+def sort(data, axis=-1, is_ascend=True, out=None):
+    def lower(d):
+        s = jnp.sort(d, axis=axis)
+        return s if is_ascend else jnp.flip(s, axis=axis)
+    return _ret(invoke(lower, (data,), name="sort"), out)
+
+
+def argsort(data, axis=-1, is_ascend=True, dtype="float32", out=None):
+    def lower(d):
+        s = jnp.argsort(d, axis=axis)
+        if not is_ascend:
+            s = jnp.flip(s, axis=axis)
+        return s.astype(dtype)
+    return _ret(invoke(lower, (data,), name="argsort",
+                       differentiable=False), out)
+
+
+topk = _npx.topk
+pick = _npx.pick
+one_hot = _npx.one_hot
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+def Reshape(data, shape=None, reverse=False, out=None, **_ignored):
+    return _ret(invoke(_lm.legacy_reshape, (data,),
+                       dict(shape=tuple(shape), reverse=reverse),
+                       name="Reshape"), out)
+
+
+reshape = Reshape
+
+
+def transpose(data, axes=None, out=None):
+    axes = tuple(axes) if axes else None
+    return _ret(invoke(lambda d: jnp.transpose(d, axes), (data,),
+                       name="transpose"), out)
+
+
+def SwapAxis(data, dim1=0, dim2=0, out=None):
+    return _ret(invoke(lambda d: jnp.swapaxes(d, dim1, dim2), (data,),
+                       name="SwapAxis"), out)
+
+
+swapaxes = SwapAxis
+
+
+def expand_dims(data, axis, out=None):
+    return _ret(invoke(lambda d: jnp.expand_dims(d, axis), (data,),
+                       name="expand_dims"), out)
+
+
+def squeeze(data, axis=None, out=None):
+    return _ret(invoke(lambda d: jnp.squeeze(d, axis=axis), (data,),
+                       name="squeeze"), out)
+
+
+def Flatten(data, out=None):
+    return _ret(invoke(lambda d: d.reshape(d.shape[0], -1), (data,),
+                       name="Flatten"), out)
+
+
+flatten = Flatten
+
+
+def Concat(*data, dim=1, out=None, num_args=None):
+    return _ret(invoke(lambda *a: jnp.concatenate(a, axis=dim), data,
+                       name="Concat"), out)
+
+
+concat = Concat
+
+
+def stack(*data, axis=0, out=None, num_args=None):
+    return _ret(invoke(lambda *a: jnp.stack(a, axis=axis), data,
+                       name="stack"), out)
+
+
+def SliceChannel(data, num_outputs=1, axis=1, squeeze_axis=False):
+    def lower(d):
+        parts = jnp.split(d, num_outputs, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+    return list(invoke(lower, (data,), name="SliceChannel"))
+
+
+split = SliceChannel
+
+
+def tile(data, reps, out=None):
+    return _ret(invoke(lambda d: jnp.tile(d, tuple(reps)), (data,),
+                       name="tile"), out)
+
+
+def repeat(data, repeats=1, axis=None, out=None):
+    return _ret(invoke(lambda d: jnp.repeat(d, repeats, axis=axis), (data,),
+                       name="repeat"), out)
+
+
+def reverse(data, axis=0, out=None):
+    return _ret(invoke(_lm.reverse, (data,), dict(axis=axis),
+                       name="reverse"), out)
+
+
+flip = reverse
+
+
+def depth_to_space(data, block_size, out=None):
+    return _ret(invoke(_lm.depth_to_space, (data,),
+                       dict(block_size=block_size), name="depth_to_space"),
+                out)
+
+
+def space_to_depth(data, block_size, out=None):
+    return _ret(invoke(_lm.space_to_depth, (data,),
+                       dict(block_size=block_size), name="space_to_depth"),
+                out)
+
+
+def diag(data, k=0, out=None):
+    def lower(d):
+        if d.ndim == 1:
+            return jnp.diag(d, k)
+        return jnp.diagonal(d, offset=k, axis1=-2, axis2=-1)
+    return _ret(invoke(lower, (data,), name="diag"), out)
+
+
+def broadcast_axis(data, axis=(), size=(), out=None):
+    return _ret(invoke(_lm.broadcast_axis, (data,),
+                       dict(axis=axis, size=size), name="broadcast_axis"),
+                out)
+
+
+broadcast_axes = broadcast_axis
+
+
+def broadcast_to(data, shape=None, out=None):
+    return _ret(invoke(_lm.broadcast_to, (data,), dict(shape=tuple(shape)),
+                       name="broadcast_to"), out)
+
+
+def shape_array(data, out=None):
+    return _ret(_nd(jnp.asarray(onp.asarray(_nd(data).shape, onp.int64))),
+                out)
+
+
+def size_array(data, out=None):
+    return _ret(_nd(jnp.asarray(onp.asarray([_nd(data).size], onp.int64))),
+                out)
+
+
+def Cast(data, dtype="float32", out=None):
+    return _ret(invoke(lambda d: d.astype(dtype), (data,), name="Cast"), out)
+
+
+cast = Cast
+
+
+def amp_cast(data, dtype="float32", out=None):
+    return Cast(data, dtype, out)
+
+
+def amp_multicast(*data, num_outputs=None, cast_narrow=False):
+    dts = [_nd(d)._data.dtype for d in data]
+    widths = [jnp.dtype(dt).itemsize for dt in dts]
+    target = dts[int(onp.argmin(widths))] if cast_narrow else \
+        dts[int(onp.argmax(widths))]
+    return [Cast(d, target) for d in data]
+
+
+# ---------------------------------------------------------------------------
+# indexing / gather
+# ---------------------------------------------------------------------------
+
+def slice(data, begin=None, end=None, step=None, out=None):  # noqa: A001
+    return _ret(invoke(_lm.slice_op, (data,),
+                       dict(begin=tuple(begin) if begin else None,
+                            end=tuple(end) if end else None,
+                            step=tuple(step) if step else None),
+                       name="slice"), out)
+
+
+def slice_axis(data, axis=0, begin=0, end=None, out=None):
+    return _ret(invoke(_lm.slice_axis, (data,),
+                       dict(axis=axis, begin=begin, end=end),
+                       name="slice_axis"), out)
+
+
+slice_like = _npx.slice_like
+gather_nd = _npx.gather_nd
+scatter_nd = _npx.scatter_nd
+reshape_like = _npx.reshape_like
+broadcast_like = _npx.broadcast_like
+
+
+def take(a, indices, axis=0, mode="clip", out=None):
+    return _ret(invoke(_lm.take, (a, indices), dict(axis=axis, mode=mode),
+                       name="take"), out)
+
+
+def batch_take(a, indices, out=None):
+    return _ret(invoke(_lm.batch_take, (a, indices), name="batch_take"), out)
+
+
+def where(condition, x, y, out=None):
+    return _ret(invoke(
+        lambda c, a, b: jnp.where(c.astype(bool), a, b),
+        (condition, x, y), name="where"), out)
+
+
+def clip(data, a_min=None, a_max=None, out=None):
+    return _ret(invoke(lambda d: jnp.clip(d, a_min, a_max), (data,),
+                       name="clip"), out)
+
+
+# ---------------------------------------------------------------------------
+# linear algebra
+# ---------------------------------------------------------------------------
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None,
+        forward_stype=None):
+    """Legacy dot: reduce last axis of lhs with first of rhs; the transpose
+    flags flip which end is reduced (`src/operator/tensor/dot-inl.h`)."""
+    def lower(a, b):
+        aa = 0 if transpose_a else a.ndim - 1
+        bb = b.ndim - 1 if transpose_b else 0
+        return jnp.tensordot(a, b, axes=((aa,), (bb,)))
+    return _ret(invoke(lower, (lhs, rhs), name="dot"), out)
+
+
+batch_dot = _npx.batch_dot
+khatri_rao = _npx.khatri_rao
+
+
+# ---------------------------------------------------------------------------
+# CamelCase layer ops
+# ---------------------------------------------------------------------------
+
+def Activation(data, act_type="relu", out=None):
+    return _ret(_npx.activation(data, act_type=act_type), out)
+
+
+def SoftmaxActivation(data, mode="instance", out=None):
+    axis = 1 if mode == "channel" else -1
+    return _ret(_npx.softmax(_nd(data), axis=axis), out)
+
+
+def FullyConnected(data, weight=None, bias=None, num_hidden=None,
+                   no_bias=False, flatten=True, out=None):
+    return _ret(_npx.fully_connected(
+        data, weight, None if no_bias else bias, num_hidden=num_hidden,
+        flatten=flatten), out)
+
+
+def Convolution(data, weight=None, bias=None, kernel=None, stride=None,
+                dilate=None, pad=None, num_filter=None, num_group=1,
+                workspace=1024, no_bias=False, cudnn_tune=None,
+                cudnn_off=False, layout=None, out=None):
+    return _ret(_npx.convolution(
+        data, weight, None if no_bias else bias, kernel=kernel,
+        stride=stride, dilate=dilate, pad=pad, num_filter=num_filter,
+        num_group=num_group, layout=layout or "NCHW"), out)
+
+
+def Deconvolution(data, weight=None, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, target_shape=None,
+                  num_filter=None, num_group=1, workspace=512, no_bias=True,
+                  cudnn_tune=None, cudnn_off=False, layout=None, out=None):
+    return _ret(_npx.deconvolution(
+        data, weight, None if no_bias else bias, kernel=kernel,
+        stride=stride, dilate=dilate, pad=pad, adj=adj,
+        num_filter=num_filter, num_group=num_group,
+        layout=layout or "NCHW"), out)
+
+
+def Pooling(data, kernel=None, pool_type="max", global_pool=False,
+            cudnn_off=False, pooling_convention="valid", stride=None,
+            pad=None, p_value=2, count_include_pad=True, layout=None,
+            out=None):
+    return _ret(_npx.pooling(
+        data, kernel=kernel, pool_type=pool_type, stride=stride, pad=pad,
+        global_pool=global_pool, count_include_pad=count_include_pad,
+        layout=layout or "NCHW",
+        pooling_convention=pooling_convention), out)
+
+
+def BatchNorm(data, gamma=None, beta=None, moving_mean=None, moving_var=None,
+              eps=1e-3, momentum=0.9, fix_gamma=True, use_global_stats=False,
+              output_mean_var=False, axis=1, cudnn_off=False, out=None):
+    return _ret(_npx.batch_norm(
+        data, gamma, beta, moving_mean, moving_var, eps=eps,
+        momentum=momentum, fix_gamma=fix_gamma,
+        use_global_stats=use_global_stats,
+        output_mean_var=output_mean_var, axis=axis), out)
+
+
+def LayerNorm(data, gamma=None, beta=None, axis=-1, eps=1e-5, out=None):
+    return _ret(_npx.layer_norm(data, gamma, beta, axis=axis, eps=eps), out)
+
+
+def InstanceNorm(data, gamma=None, beta=None, eps=1e-3, out=None):
+    return _ret(_npx.instance_norm(data, gamma, beta, eps=eps), out)
+
+
+def GroupNorm(data, gamma=None, beta=None, num_groups=1, eps=1e-5, out=None):
+    return _ret(_npx.group_norm(data, gamma, beta, num_groups=num_groups,
+                                eps=eps), out)
+
+
+def L2Normalization(data, eps=1e-10, mode="instance", out=None):
+    return _ret(_npx.l2_normalization(data, eps=eps, mode=mode), out)
+
+
+def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, out=None):
+    return _ret(invoke(_lm.lrn, (data,),
+                       dict(alpha=alpha, beta=beta, knorm=knorm, nsize=nsize),
+                       name="LRN"), out)
+
+
+def Dropout(data, p=0.5, mode="training", axes=None, cudnn_off=False,
+            out=None):
+    return _ret(_npx.dropout(data, p=p, axes=axes,
+                             mode=None if mode == "training" else mode), out)
+
+
+def Embedding(data, weight=None, input_dim=None, output_dim=None,
+              dtype="float32", sparse_grad=False, out=None):
+    return _ret(_npx.embedding(data, weight, input_dim=input_dim,
+                               output_dim=output_dim, dtype=dtype,
+                               sparse_grad=sparse_grad), out)
+
+
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25,
+              lower_bound=0.125, upper_bound=0.334, out=None):
+    return _ret(_npx.leaky_relu(data, gamma, act_type=act_type, slope=slope,
+                                lower_bound=lower_bound,
+                                upper_bound=upper_bound), out)
+
+
+def Pad(data, mode="constant", pad_width=None, constant_value=0.0, out=None):
+    return _ret(invoke(_lm.pad, (data,),
+                       dict(mode=mode, pad_width=tuple(pad_width),
+                            constant_value=constant_value), name="Pad"), out)
+
+
+pad = Pad
+
+
+def Crop(*data, offset=(0, 0), h_w=(0, 0), center_crop=False, num_args=None,
+         out=None):
+    like = data[1] if len(data) > 1 else None
+    args = (data[0],) if like is None else (data[0], like)
+
+    def lower(d, lk=None):
+        return _lm.crop(d, offset=tuple(offset), h_w=tuple(h_w),
+                        center_crop=center_crop, like=lk)
+    return _ret(invoke(lower, args, name="Crop"), out)
+
+
+def UpSampling(*data, scale=2, sample_type="nearest", num_args=None,
+               workspace=512, num_filter=0, multi_input_mode="concat",
+               out=None):
+    ups = [invoke(_lm.upsampling, (d,),
+                  dict(scale=scale, sample_type=sample_type),
+                  name="UpSampling") for d in data[:1]] + \
+          [_nd(d) for d in data[1:]]
+    if len(ups) == 1:
+        return _ret(ups[0], out)
+    return _ret(invoke(lambda *a: jnp.concatenate(a, axis=1), tuple(ups),
+                       name="UpSampling"), out)
+
+
+def SequenceMask(data, sequence_length=None, use_sequence_length=False,
+                 value=0.0, axis=0, out=None):
+    return _ret(_npx.sequence_mask(data, sequence_length,
+                                   use_sequence_length=use_sequence_length,
+                                   value=value, axis=axis), out)
+
+
+def SequenceLast(data, sequence_length=None, use_sequence_length=False,
+                 axis=0, out=None):
+    return _ret(_npx.sequence_last(data, sequence_length,
+                                   use_sequence_length=use_sequence_length,
+                                   axis=axis), out)
+
+
+def SequenceReverse(data, sequence_length=None, use_sequence_length=False,
+                    axis=0, out=None):
+    return _ret(_npx.sequence_reverse(data, sequence_length,
+                                      use_sequence_length=use_sequence_length,
+                                      axis=axis), out)
+
+
+def RNN(data, parameters=None, state=None, state_cell=None,
+        sequence_length=None, state_size=None, num_layers=1,
+        bidirectional=False, mode="lstm", p=0.0, state_outputs=False,
+        projection_size=None, use_sequence_length=False,
+        lstm_state_clip_min=None, lstm_state_clip_max=None,
+        lstm_state_clip_nan=False, out=None):
+    """Fused multi-layer RNN (`src/operator/rnn.cc`); data layout TNC;
+    parameters are the flat packed vector (weights then biases)."""
+    args = (data, parameters, state) + (
+        (state_cell,) if mode == "lstm" else ())
+
+    def lower(d, w, s, c=None):
+        return _lm.rnn(d, w, s, state_cell=c, state_size=state_size,
+                       num_layers=num_layers, bidirectional=bidirectional,
+                       mode=mode, p=p)
+    res = invoke(lower, args, name="RNN")
+    if not state_outputs:
+        return res[0]
+    return list(res)
+
+
+def SoftmaxOutput(data, label=None, grad_scale=1.0, ignore_label=-1.0,
+                  multi_output=False, use_ignore=False, preserve_shape=False,
+                  normalization="null", out_grad=False, smooth_alpha=0.0,
+                  out=None):
+    return _ret(invoke(
+        _lm.softmax_output, (data, label),
+        dict(grad_scale=grad_scale, ignore_label=ignore_label,
+             multi_output=multi_output, use_ignore=use_ignore,
+             normalization=normalization, smooth_alpha=smooth_alpha),
+        name="SoftmaxOutput"), out)
+
+
+Softmax = SoftmaxOutput  # ancient alias (reference keeps it too)
+
+
+def LinearRegressionOutput(data, label=None, grad_scale=1.0, out=None):
+    return _ret(invoke(_lm.linear_regression_output, (data, label),
+                       dict(grad_scale=grad_scale),
+                       name="LinearRegressionOutput"), out)
+
+
+def MAERegressionOutput(data, label=None, grad_scale=1.0, out=None):
+    return _ret(invoke(_lm.mae_regression_output, (data, label),
+                       dict(grad_scale=grad_scale),
+                       name="MAERegressionOutput"), out)
+
+
+def LogisticRegressionOutput(data, label=None, grad_scale=1.0, out=None):
+    return _ret(invoke(_lm.logistic_regression_output, (data, label),
+                       dict(grad_scale=grad_scale),
+                       name="LogisticRegressionOutput"), out)
+
+
+def SVMOutput(data, label=None, margin=1.0, regularization_coefficient=1.0,
+              use_linear=False, out=None):
+    return _ret(invoke(_lm.svm_output, (data, label),
+                       name="SVMOutput"), out)
+
+
+def softmax_cross_entropy(data, label, out=None):
+    return _ret(invoke(_lm.softmax_cross_entropy, (data, label),
+                       name="softmax_cross_entropy"), out)
+
+
+def BlockGrad(data, out=None):
+    return _ret(invoke(jax.lax.stop_gradient, (data,), name="BlockGrad"), out)
+
+
+stop_gradient = BlockGrad
+make_loss = _npx.make_loss
+MakeLoss = make_loss
+smooth_l1 = _npx.smooth_l1
+log_softmax = _npx.log_softmax
+softmax = _npx.softmax
+
+
+def softmin(data, axis=-1, out=None):
+    return _ret(_npx.softmax(_nd(data) * -1, axis=axis), out)
+
+
+def relu(data, out=None):
+    return _ret(_npx.relu(data), out)
+
+
+def sigmoid(data, out=None):
+    return _ret(_npx.sigmoid(data), out)
+
+
+def identity(data, out=None):
+    return _ret(invoke(lambda d: d, (data,), name="identity"), out)
+
+
+copy = identity  # noqa: A001
+
+
+def IdentityAttachKLSparseReg(data, sparseness_target=0.1, penalty=0.001,
+                              momentum=0.9, out=None):
+    return identity(data, out)
+
+
+def Custom(*data, op_type=None, **kwargs):
+    """Bridge into the python CustomOp registry (`operator.py`)."""
+    from ..operator import invoke_custom
+    return invoke_custom(*[_nd(d) for d in data], op_type=op_type, **kwargs)
+
+
+# spatial ops (already TPU-lowered in ops/spatial.py)
+SpatialTransformer = _npx.spatial_transformer
+GridGenerator = _npx.grid_generator
+BilinearSampler = _npx.bilinear_sampler
+ROIPooling = _npx.roi_pooling
+im2col = _npx.im2col
+col2im = _npx.col2im
+
+
+def CTCLoss(data, label, data_lengths=None, label_lengths=None,
+            use_data_lengths=False, use_label_lengths=False,
+            blank_label="first", out=None):
+    from ..gluon.loss import CTCLoss as _G
+    ls = _G(layout="TNC", label_layout="NT")
+    return _ret(ls(_nd(data), _nd(label),
+                   _nd(data_lengths) if use_data_lengths else None,
+                   _nd(label_lengths) if use_label_lengths else None), out)
+
+
+ctc_loss = CTCLoss
+
+
+# ---------------------------------------------------------------------------
+# misc kernels
+# ---------------------------------------------------------------------------
+
+def add_n(*args, out=None):
+    return _ret(invoke(_lm.add_n, args, name="add_n"), out)
+
+
+ElementWiseSum = add_n
+
+
+def all_finite(data, init_output=True, out=None):
+    return _ret(invoke(_lm.all_finite, (data,), name="all_finite",
+                       differentiable=False), out)
+
+
+multi_all_finite = _npx.multi_all_finite
+
+
+def cast_storage(data, stype="default", out=None):
+    from . import sparse as _sp
+    if stype == "default":
+        if isinstance(data, _sp._SparseNDArray):
+            return _ret(data.tostype("default"), out)
+        return _ret(_nd(data), out)
+    arr = data if isinstance(data, NDArray) else _nd(data)
+    return arr.tostype(stype)
+
+
+def zeros_like(data, out=None):
+    return _ret(invoke(jnp.zeros_like, (data,), name="zeros_like",
+                       differentiable=False), out)
+
+
+def ones_like(data, out=None):
+    return _ret(invoke(jnp.ones_like, (data,), name="ones_like",
+                       differentiable=False), out)
+
+
+def zeros(shape, ctx=None, dtype="float32", out=None):
+    return _ret(_nd(jnp.zeros(shape, dtype)), out)
+
+
+def ones(shape, ctx=None, dtype="float32", out=None):
+    return _ret(_nd(jnp.ones(shape, dtype)), out)
+
+
+def full(shape, val, ctx=None, dtype="float32", out=None):
+    return _ret(_nd(jnp.full(shape, val, dtype)), out)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32",
+           out=None):
+    a = jnp.arange(start, stop, step, dtype)
+    if repeat > 1:
+        a = jnp.repeat(a, repeat)
+    return _ret(_nd(a), out)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32", out=None):  # noqa: N803
+    return _ret(_nd(jnp.eye(int(N), int(M) if M else None, k, dtype=dtype)),
+                out)
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer update kernels — mutate-output contract: `out` (and the
+# state inputs) are rebound to the updated values, matching the reference's
+# in-place semantics (`src/operator/optimizer_op.cc`)
+# ---------------------------------------------------------------------------
+
+def _f(v, default):
+    return default if v is None else float(v)
+
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True, out=None):
+    new_w = invoke(_lm.sgd_update, (weight, grad),
+                   dict(lr=_f(lr, 0.0), wd=_f(wd, 0.0),
+                        rescale_grad=_f(rescale_grad, 1.0),
+                        clip_gradient=_f(clip_gradient, -1.0)),
+                   name="sgd_update", differentiable=False)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True,
+                   out=None):
+    new_w, new_mom = invoke(
+        _lm.sgd_mom_update, (weight, grad, mom),
+        dict(lr=_f(lr, 0.0), momentum=_f(momentum, 0.0), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="sgd_mom_update", differentiable=False)
+    _inplace(mom, new_mom)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    new_w, new_mom = invoke(
+        _lm.nag_mom_update, (weight, grad, mom),
+        dict(lr=_f(lr, 0.0), momentum=_f(momentum, 0.0), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="nag_mom_update", differentiable=False)
+    _inplace(mom, new_mom)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True, out=None):
+    new_w, new_mean, new_var = invoke(
+        _lm.adam_update, (weight, grad, mean, var),
+        dict(lr=_f(lr, 0.0), beta1=_f(beta1, 0.9), beta2=_f(beta2, 0.999),
+             epsilon=_f(epsilon, 1e-8), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="adam_update", differentiable=False)
+    _inplace(mean, new_mean)
+    _inplace(var, new_var)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0,
+                   out=None):
+    new_w, new_n = invoke(
+        _lm.rmsprop_update, (weight, grad, n),
+        dict(lr=_f(lr, 0.0), gamma1=_f(gamma1, 0.95),
+             epsilon=_f(epsilon, 1e-8), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0),
+             clip_weights=_f(clip_weights, -1.0)),
+        name="rmsprop_update", differentiable=False)
+    _inplace(n, new_n)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0, out=None):
+    new_w, new_n, new_g, new_delta = invoke(
+        _lm.rmspropalex_update, (weight, grad, n, g, delta),
+        dict(lr=_f(lr, 0.0), gamma1=_f(gamma1, 0.95),
+             gamma2=_f(gamma2, 0.9), epsilon=_f(epsilon, 1e-8),
+             wd=_f(wd, 0.0), rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0),
+             clip_weights=_f(clip_weights, -1.0)),
+        name="rmspropalex_update", differentiable=False)
+    _inplace(n, new_n)
+    _inplace(g, new_g)
+    _inplace(delta, new_delta)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    new_w, new_z, new_n = invoke(
+        _lm.ftrl_update, (weight, grad, z, n),
+        dict(lr=_f(lr, 0.0), lamda1=_f(lamda1, 0.01), beta=_f(beta, 1.0),
+             wd=_f(wd, 0.0), rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="ftrl_update", differentiable=False)
+    _inplace(z, new_z)
+    _inplace(n, new_n)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, out=None):
+    new_w = invoke(
+        _lm.signsgd_update, (weight, grad),
+        dict(lr=_f(lr, 0.0), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="signsgd_update", differentiable=False)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0, out=None):
+    new_w, new_mom = invoke(
+        _lm.signum_update, (weight, grad, mom),
+        dict(lr=_f(lr, 0.0), momentum=_f(momentum, 0.0), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0), wd_lh=_f(wd_lh, 0.0)),
+        name="signum_update", differentiable=False)
+    _inplace(mom, new_mom)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True, out=None):
+    new_w, new_w32 = invoke(
+        _lm.mp_sgd_update, (weight, grad, weight32),
+        dict(lr=_f(lr, 0.0), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="mp_sgd_update", differentiable=False)
+    _inplace(weight32, new_w32)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True,
+                      out=None):
+    new_w, new_mom, new_w32 = invoke(
+        _lm.mp_sgd_mom_update, (weight, grad, mom, weight32),
+        dict(lr=_f(lr, 0.0), momentum=_f(momentum, 0.0), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="mp_sgd_mom_update", differentiable=False)
+    _inplace(mom, new_mom)
+    _inplace(weight32, new_w32)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+# ---------------------------------------------------------------------------
+# legacy random ops (`src/operator/random/sample_op.cc`): random_* draw a
+# fixed shape; sample_* broadcast over array-valued params
+# ---------------------------------------------------------------------------
+
+def random_uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None,
+                   out=None):
+    from .. import numpy as _mxnp
+    return _ret(_mxnp.random.uniform(low, high, size=tuple(shape)).astype(
+        dtype), out)
+
+
+def random_normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None,
+                  out=None):
+    from .. import numpy as _mxnp
+    return _ret(_mxnp.random.normal(loc, scale, size=tuple(shape)).astype(
+        dtype), out)
+
+
+def random_gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32", ctx=None,
+                 out=None):
+    from .. import numpy as _mxnp
+    return _ret((_mxnp.random.standard_gamma(alpha, size=tuple(shape))
+                 * beta).astype(dtype), out)
+
+
+def random_exponential(lam=1.0, shape=(1,), dtype="float32", ctx=None,
+                       out=None):
+    from .. import numpy as _mxnp
+    return _ret(_mxnp.random.exponential(1.0 / lam,
+                                         size=tuple(shape)).astype(dtype),
+                out)
+
+
+def random_poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    from .. import numpy as _mxnp
+    return _ret(_mxnp.random.poisson(lam, size=tuple(shape)).astype(dtype),
+                out)
+
+
+def random_randint(low, high, shape=(1,), dtype="int32", ctx=None, out=None):
+    from .. import numpy as _mxnp
+    return _ret(_mxnp.random.randint(low, high,
+                                     size=tuple(shape)).astype(dtype), out)
+
+
+def random_negative_binomial(k=1, p=1.0, shape=(1,), dtype="float32",
+                             ctx=None, out=None):
+    from .. import numpy as _mxnp
+    return _ret(_mxnp.random.negative_binomial(
+        k, p, size=tuple(shape)).astype(dtype), out)
+
+
+def _expand(p, tail):
+    return p.reshape(p.shape + (1,) * len(tail)) if tail else p
+
+
+def sample_uniform(low, high=None, shape=(), dtype="float32", ctx=None,
+                   out=None):
+    """Per-element parameterized draws: out.shape = low.shape + shape
+    (`src/operator/random/multisample_op.cc`)."""
+    from .. import numpy as _mxnp
+    lo, hi = _nd(low), _nd(high if high is not None else 1.0)
+    tail = tuple(shape) if shape else ()
+    u = _mxnp.random.uniform(0.0, 1.0, size=tuple(lo.shape) + tail)
+    res = u * (_expand(hi, tail) - _expand(lo, tail)) + _expand(lo, tail)
+    return _ret(res.astype(dtype), out)
+
+
+def sample_normal(mu, sigma=None, shape=(), dtype="float32", ctx=None,
+                  out=None):
+    from .. import numpy as _mxnp
+    m, s = _nd(mu), _nd(sigma if sigma is not None else 1.0)
+    tail = tuple(shape) if shape else ()
+    z = _mxnp.random.normal(0.0, 1.0, size=tuple(m.shape) + tail)
+    res = z * _expand(s, tail) + _expand(m, tail)
+    return _ret(res.astype(dtype), out)
+
+
+class _LegacyRandom:  # noqa: E302
+    """`mx.nd.random` submodule with legacy kwargs (shape=, ctx=)."""
+    uniform = staticmethod(random_uniform)
+    normal = staticmethod(random_normal)
+    gamma = staticmethod(random_gamma)
+    exponential = staticmethod(random_exponential)
+    poisson = staticmethod(random_poisson)
+    randint = staticmethod(random_randint)
+    negative_binomial = staticmethod(random_negative_binomial)
+
+    @staticmethod
+    def seed(s):
+        from .. import random as _r
+        _r.seed(s)
+
+    @staticmethod
+    def shuffle(data, **kwargs):
+        from .. import numpy as _mxnp
+        return _mxnp.random.permutation(_nd(data))
+
+    @staticmethod
+    def multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
+        from .. import numpy as _mxnp
+        return _mxnp.random.multinomial(1, _nd(data), size=shape or None)
+
+
+random = _LegacyRandom()
+
+
+# public surface = every op defined above; incidental imports (jnp, onp,
+# invoke, ...) stay private so mx.nd forwarding can't leak them
+import types as _types  # noqa: E402
+
+__all__ = sorted(
+    n for n, v in list(globals().items())
+    if not n.startswith("_") and not isinstance(v, _types.ModuleType)
+    and n not in ("NDArray", "invoke", "current_context", "annotations")
+)

@@ -1,0 +1,547 @@
+"""Pure-XLA lowerings for the legacy (pre-numpy) op surface.
+
+Reference: the generated ``mx.nd.*`` wrappers over
+`src/operator/` registered ops (`python/mxnet/ndarray/register.py:265-277`
+generates the Python surface; kernels live in `src/operator/nn/*.cc`,
+`src/operator/tensor/*.cc`, `src/operator/optimizer_op.cc`).
+
+Everything here is a pure function over jax arrays with static attrs —
+the NDArray-facing wrappers in ``mxnet_tpu/ndarray/legacy.py`` dispatch
+through ``ops.invoke`` so the autograd tape records them like any other op.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# reductions with the legacy `exclude` convention
+# (`src/operator/tensor/broadcast_reduce_op.h` ReduceAxesParam)
+# ---------------------------------------------------------------------------
+
+
+def _norm_axes(axis, ndim, exclude):
+    if axis is None:
+        axes = tuple(range(ndim))
+    elif isinstance(axis, int):
+        axes = (axis % ndim,)
+    else:
+        axes = tuple(a % ndim for a in axis)
+    if exclude:
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def reduce_op(data, axis=None, keepdims=False, exclude=False, op="sum"):
+    axes = _norm_axes(axis, data.ndim, exclude)
+    fn = {"sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+          "max": jnp.max, "min": jnp.min, "nansum": jnp.nansum,
+          "nanprod": jnp.nanprod}[op]
+    return fn(data, axis=axes, keepdims=keepdims)
+
+
+def norm(data, ord=2, axis=None, keepdims=False):  # noqa: A002
+    """`src/operator/tensor/broadcast_reduce_norm_value.cc` — L1/L2 only."""
+    if axis is None:
+        axes = tuple(range(data.ndim))
+    elif isinstance(axis, int):
+        axes = (axis % data.ndim,)
+    else:
+        axes = tuple(a % data.ndim for a in axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axes, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=keepdims))
+
+
+def moments(data, axes=None, keepdims=False):
+    """`src/operator/nn/moments.cc`."""
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=axes, keepdims=keepdims)
+    if not keepdims:
+        mean = jnp.squeeze(mean, axis=axes) if axes is not None else \
+            jnp.squeeze(mean)
+    return mean, var
+
+
+# ---------------------------------------------------------------------------
+# legacy Reshape with special codes (`src/operator/tensor/matrix_op-inl.h`
+# ReshapeParam: 0 copy, -1 infer, -2 copy rest, -3 merge two, -4 split)
+# ---------------------------------------------------------------------------
+
+
+def infer_legacy_reshape(src_shape, target, reverse=False):
+    src = list(src_shape)
+    tgt = list(target)
+    if reverse:
+        # read both right-to-left; -4's two split dims keep their order
+        groups, i = [], 0
+        while i < len(tgt):
+            if tgt[i] == -4:
+                groups.append(tgt[i:i + 3])
+                i += 3
+            else:
+                groups.append([tgt[i]])
+                i += 1
+        tgt = [v for g in reversed(groups) for v in g]
+        src = src[::-1]
+    out, i_src, i = [], 0, 0
+    while i < len(tgt):
+        v = tgt[i]
+        if v == 0:
+            out.append(src[i_src]); i_src += 1
+        elif v == -1:
+            out.append(-1); i_src += 1
+        elif v == -2:
+            out.extend(src[i_src:]); i_src = len(src)
+        elif v == -3:
+            out.append(src[i_src] * src[i_src + 1]); i_src += 2
+        elif v == -4:
+            a, b = tgt[i + 1], tgt[i + 2]
+            d = src[i_src]
+            if a == -1:
+                a = d // b
+            elif b == -1:
+                b = d // a
+            out.extend([a, b]); i_src += 1; i += 2
+        else:
+            out.append(v); i_src += 1
+        i += 1
+    if -1 in out:
+        known = 1
+        for v in out:
+            if v != -1:
+                known *= v
+        total = 1
+        for v in src_shape:
+            total *= v
+        out[out.index(-1)] = total // max(known, 1)
+    if reverse:
+        out = out[::-1]
+    return tuple(out)
+
+
+def legacy_reshape(data, shape=None, reverse=False):
+    return jnp.reshape(data, infer_legacy_reshape(data.shape, shape, reverse))
+
+
+# ---------------------------------------------------------------------------
+# indexing / slicing (`src/operator/tensor/matrix_op.cc`)
+# ---------------------------------------------------------------------------
+
+
+def slice_op(data, begin=None, end=None, step=None):
+    ix = []
+    step = step or ()
+    for d in range(data.ndim):
+        b = begin[d] if begin is not None and d < len(begin) else None
+        e = end[d] if end is not None and d < len(end) else None
+        s = step[d] if d < len(step) and step[d] is not None else None
+        ix.append(slice(b, e, s))
+    return data[tuple(ix)]
+
+
+def slice_axis(data, axis=0, begin=0, end=None):
+    ix = [slice(None)] * data.ndim
+    ix[axis] = slice(begin, end)
+    return data[tuple(ix)]
+
+
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    n = a.shape[axis]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+def batch_take(a, indices):
+    """`src/operator/tensor/indexing_op.cc` batch_take: out[i] = a[i, idx[i]]."""
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+def broadcast_axis(data, axis=(), size=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(data.shape)
+    for a, s in zip(axes, sizes):
+        shape[a] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+def broadcast_to(data, shape=None):
+    tgt = tuple(s if t == 0 else t
+                for t, s in zip(shape, data.shape[-len(shape):])) \
+        if len(shape) == data.ndim else tuple(shape)
+    tgt = tuple(d if t == 0 else t for t, d in zip(tgt, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+def reverse(data, axis=0):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=axes)
+
+
+def depth_to_space(data, block_size):
+    """`src/operator/tensor/matrix_op.cc` DepthToSpace (NCHW, DCR mode)."""
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+def space_to_depth(data, block_size):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+# ---------------------------------------------------------------------------
+# training heads with custom backward semantics
+# (`src/operator/softmax_output.cc`, `src/operator/regression_output.cc`)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False,
+                   normalization="null", smooth_alpha=0.0):
+    """Forward = softmax; backward = (p - onehot(label)) * grad_scale,
+    ignoring the upstream gradient (the reference's training-head
+    contract, `src/operator/softmax_output-inl.h`)."""
+    axis = 1 if (multi_output or data.ndim > 2) else -1
+    return jax.nn.softmax(data, axis=axis)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                        use_ignore, normalization, smooth_alpha):
+    out = softmax_output(data, label, grad_scale, ignore_label, multi_output,
+                         use_ignore, normalization, smooth_alpha)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, multi_output, use_ignore,
+                        normalization, smooth_alpha, res, _ct):
+    p, label = res
+    axis = 1 if (multi_output or p.ndim > 2) else -1
+    k = p.shape[axis]
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, k, axis=axis, dtype=p.dtype)
+    if smooth_alpha:
+        onehot = onehot * (1.0 - smooth_alpha) + smooth_alpha / (k - 1) * \
+            (1.0 - onehot)
+    g = p - onehot
+    valid = None
+    if use_ignore:
+        keep = (label != ignore_label).astype(p.dtype)
+        g = g * jnp.expand_dims(keep, axis=axis)
+        valid = jnp.maximum(keep.sum(), 1.0)
+    if normalization == "batch":
+        g = g / p.shape[0]
+    elif normalization == "valid":
+        g = g / (valid if valid is not None
+                 else jnp.asarray(float(lab.size), p.dtype))
+    return (g * grad_scale).astype(p.dtype), jnp.zeros_like(label)
+
+
+softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+def _regression_head(transform, grad_fn):
+    @jax.custom_vjp
+    def head(data, label, grad_scale=1.0):
+        return transform(data)
+
+    def fwd(data, label, grad_scale):
+        return transform(data), (data, label, grad_scale)
+
+    def bwd(res, _ct):
+        data, label, grad_scale = res
+        # reference scales by grad_scale / num_output where num_output is
+        # elements per sample (`regression_output-inl.h:201-207`)
+        num_output = max(label.size // label.shape[0], 1)
+        g = grad_fn(data, label) * (grad_scale / num_output)
+        return g.astype(data.dtype), jnp.zeros_like(label), None
+    head.defvjp(fwd, bwd)
+    return head
+
+
+linear_regression_output = _regression_head(
+    lambda d: d, lambda d, l: d - l.reshape(d.shape))
+mae_regression_output = _regression_head(
+    lambda d: d, lambda d, l: jnp.sign(d - l.reshape(d.shape)))
+logistic_regression_output = _regression_head(
+    jax.nn.sigmoid, lambda d, l: jax.nn.sigmoid(d) - l.reshape(d.shape))
+
+
+def softmax_cross_entropy(data, label):
+    """`src/operator/loss_binary_op.cc` — scalar summed CE."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return -jnp.sum(picked).reshape(1)
+
+
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Forward identity (`src/operator/svm_output.cc`)."""
+    return data
+
+
+# ---------------------------------------------------------------------------
+# LRN (`src/operator/nn/lrn.cc`)
+# ---------------------------------------------------------------------------
+
+
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    sq = jnp.square(data)
+    half = nsize // 2
+    window = [1] * data.ndim
+    window[1] = nsize
+    pads = [(0, 0)] * data.ndim
+    pads[1] = (half, half)
+    ssum = lax.reduce_window(sq, 0.0, lax.add, window, [1] * data.ndim, pads)
+    return data / jnp.power(knorm + alpha / nsize * ssum, beta)
+
+
+# ---------------------------------------------------------------------------
+# Pad / Crop / UpSampling (`src/operator/pad.cc`, `crop.cc`,
+# `upsampling.cc`)
+# ---------------------------------------------------------------------------
+
+
+def pad(data, mode="constant", pad_width=None, constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(data.ndim)]
+    if mode == "constant":
+        return jnp.pad(data, pw, constant_values=constant_value)
+    return jnp.pad(data, pw, mode={"edge": "edge", "reflect": "reflect"}[mode])
+
+
+def crop(data, offset=(0, 0), h_w=(0, 0), center_crop=False, like=None):
+    th, tw = (like.shape[2], like.shape[3]) if like is not None else h_w
+    h, w = data.shape[2], data.shape[3]
+    if center_crop:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = offset
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+def upsampling(data, scale=2, sample_type="nearest"):
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    return jax.image.resize(data, (n, c, h * scale, w * scale), "bilinear")
+
+
+# ---------------------------------------------------------------------------
+# fused RNN op (`src/operator/rnn.cc` / rnn-inl.h).  Parameter packing:
+# all weights (layer-major, direction, i2h then h2h), then all biases.
+# Cell math shared with gluon/rnn/rnn_layer.py so the two paths agree.
+# ---------------------------------------------------------------------------
+
+
+def _rnn_gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def rnn(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+        state_outputs=False, sequence_length=None):
+    from ..gluon.rnn.rnn_layer import _run_single_direction
+
+    ng = _rnn_gates(mode)
+    H = state_size
+    ndir = 2 if bidirectional else 1
+    t, n, input_size = data.shape
+
+    # unpack the flat parameter vector (shapes are static)
+    offs = 0
+    weights, biases = [], []
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * ndir
+        for _d in range(ndir):
+            w_i2h = parameters[offs:offs + ng * H * in_sz].reshape(
+                ng * H, in_sz)
+            offs += ng * H * in_sz
+            w_h2h = parameters[offs:offs + ng * H * H].reshape(ng * H, H)
+            offs += ng * H * H
+            weights.append((w_i2h, w_h2h))
+    for layer in range(num_layers):
+        for _d in range(ndir):
+            b_i2h = parameters[offs:offs + ng * H]
+            offs += ng * H
+            b_h2h = parameters[offs:offs + ng * H]
+            offs += ng * H
+            biases.append((b_i2h, b_h2h))
+
+    x = data
+    out_h, out_c = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(ndir):
+            k = layer * ndir + d
+            w_i2h, w_h2h = weights[k]
+            b_i2h, b_h2h = biases[k]
+            h0 = state[k]
+            c0 = state_cell[k] if mode == "lstm" else jnp.zeros_like(state[k])
+            y, hT, cT = _run_single_direction(
+                mode, x, h0, c0, w_i2h, b_i2h, w_h2h, b_h2h,
+                reverse=(d == 1))
+            outs.append(y)
+            out_h.append(hT)
+            if mode == "lstm":
+                out_c.append(cT)
+        x = outs[0] if ndir == 1 else jnp.concatenate(outs, axis=-1)
+    hs = jnp.stack(out_h)
+    if mode == "lstm":
+        return x, hs, jnp.stack(out_c)
+    return x, hs
+
+
+# ---------------------------------------------------------------------------
+# optimizer update kernels (`src/operator/optimizer_op.cc`).  These are
+# the raw fused kernels the reference Updater calls; the python Optimizer
+# pre-scales lr (e.g. Adam bias correction happens in python, not here).
+# ---------------------------------------------------------------------------
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient, wd, weight):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    return weight - lr * g
+
+
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    return (weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon),
+            new_mean, new_var)
+
+
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n
+
+
+def rmspropalex_update(weight, grad, n, g_state, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_g = (1 - gamma1) * g + gamma1 * g_state
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(
+        new_n - jnp.square(new_g) + epsilon)
+    w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n, new_g, new_delta
+
+
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(new_z) > lamda1,
+        -(new_z - jnp.sign(new_z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd),
+        0.0).astype(weight.dtype)
+    return w, new_z, new_n
+
+
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight * (1 - lr * wd) - lr * jnp.sign(g)
+
+
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return w, new_mom
+
+
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient,
+                   wd, weight32)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad.astype(jnp.float32), rescale_grad, clip_gradient,
+                   wd, weight32)
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+# ---------------------------------------------------------------------------
+# misc (`src/operator/tensor/elemwise_sum.cc`, `contrib/all_finite.cc`,
+# `src/operator/tensor/amp_cast.cc`)
+# ---------------------------------------------------------------------------
+
+
+def add_n(*arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
+def all_finite(data, init_output=True):
+    return jnp.isfinite(data).all().reshape(1).astype(jnp.float32)
+
+
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(data.dtype)

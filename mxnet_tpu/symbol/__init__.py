@@ -30,13 +30,20 @@ _OP_REGISTRY = {}   # op name -> callable over NDArrays/arrays
 class Symbol:
     """A node in the symbolic graph (reference `symbol.py` Symbol)."""
 
-    def __init__(self, op, inputs, attrs=None, name=None, nout=1, index=0):
+    def __init__(self, op, inputs, attrs=None, name=None, nout=1, index=0,
+                 kw_inputs=None):
         self._op = op                    # None for variables
-        self._inputs = list(inputs)      # Symbol list
+        self._inputs = list(inputs)      # Symbol list (positional)
+        self._kw_inputs = dict(kw_inputs or {})  # name -> Symbol (keyword
+        # tensor args: the canonical legacy style `FullyConnected(data=x,
+        # weight=w, ...)`, reference symbol.py compose)
         self._attrs = dict(attrs or {})
         self._name = name or (op if op else "var")
         self._nout = nout
         self._index = index
+
+    def _all_inputs(self):
+        return list(self._inputs) + list(self._kw_inputs.values())
 
     # -- introspection ------------------------------------------------------
     @property
@@ -52,7 +59,7 @@ class Symbol:
             if id(s) in seen:
                 return
             seen.add(id(s))
-            for i in s._inputs:
+            for i in s._all_inputs():
                 walk(i)
             if s._op is None and not isinstance(s, _ScalarSymbol) \
                     and s._name not in order:
@@ -68,7 +75,7 @@ class Symbol:
             if id(s) in seen:
                 return
             seen.add(id(s))
-            for i in s._inputs:
+            for i in s._all_inputs():
                 walk(i)
             nodes.append(s)
         walk(self)
@@ -145,7 +152,8 @@ class Symbol:
             else:
                 fn = _OP_REGISTRY[s._op]
                 ins = [ev(i) for i in s._inputs]
-                out = fn(*ins, **s._attrs)
+                kw_ins = {k: ev(v) for k, v in s._kw_inputs.items()}
+                out = fn(*ins, **kw_ins, **s._attrs)
                 if isinstance(out, NDArray):
                     out = out._data
                 elif isinstance(out, (tuple, list)):
@@ -174,13 +182,22 @@ class Symbol:
     # -- shape/type inference ----------------------------------------------
     def infer_shape(self, **shapes):
         """Shapes of (args, outputs, aux) given input shapes — via
-        jax.eval_shape, replacing the nnvm InferShape pass."""
+        jax.eval_shape, replacing the nnvm InferShape pass.  Per-arg
+        dtypes honor ``var(dtype=...)`` so integer-typed inputs
+        (take/one_hot indices, embeddings) infer correctly."""
         names = self.list_arguments()
+        dtypes = {}
+        for s in self.get_internals()._outputs:
+            if s._op is None and not isinstance(s, _ScalarSymbol):
+                dt = getattr(s, "_dtype", None)
+                if dt is not None:
+                    dtypes[s._name] = onp.dtype(dt)
         specs = {}
         for n in names:
             if n not in shapes:
                 raise ValueError(f"infer_shape needs a shape for '{n}'")
-            specs[n] = jax.ShapeDtypeStruct(tuple(shapes[n]), onp.float32)
+            specs[n] = jax.ShapeDtypeStruct(tuple(shapes[n]),
+                                            dtypes.get(n, onp.float32))
         out = jax.eval_shape(lambda env: self._eval(env), specs)
         outs = out if isinstance(out, tuple) else (out,)
         return ([tuple(shapes[n]) for n in names],
@@ -196,9 +213,14 @@ class Symbol:
             if id(s) in index:
                 return index[id(s)]
             ins = [walk(i) for i in s._inputs]
+            kw_ins = {k: walk(v) for k, v in s._kw_inputs.items()}
             idx = len(nodes)
             entry = {"op": s._op, "name": s._name, "inputs": ins,
                      "attrs": s._attrs}
+            if kw_ins:
+                entry["kw_inputs"] = kw_ins
+            if s._nout != 1:
+                entry["nout"] = s._nout
             if isinstance(s, _ScalarSymbol):
                 v = s._value
                 entry["op"] = "_scalar"
@@ -346,7 +368,12 @@ def _register(name, fn):
                 sym_inputs.append(a)
             else:
                 sym_inputs.append(_ScalarSymbol(a))
-        return Symbol(name, sym_inputs, kwargs, name=name_attr or name)
+        # keyword tensor args (`FullyConnected(data=x, weight=w)`) become
+        # named graph inputs, not attrs
+        kw_inputs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        attrs = {k: v for k, v in kwargs.items() if k not in kw_inputs}
+        return Symbol(name, sym_inputs, attrs, name=name_attr or name,
+                      kw_inputs=kw_inputs)
     builder.__name__ = name
     return builder
 
@@ -357,6 +384,7 @@ def loads(json_str):
     built = {}
     for idx, node in enumerate(data["nodes"]):
         ins = [built[i] for i in node["inputs"]]
+        kw_ins = {k: built[i] for k, i in node.get("kw_inputs", {}).items()}
         if node["op"] is None:
             built[idx] = var(node["name"])
         elif node["op"] == "_scalar":
@@ -368,7 +396,8 @@ def loads(json_str):
             built[idx] = Group(ins)
         else:
             built[idx] = Symbol(node["op"], ins, node["attrs"],
-                                name=node["name"])
+                                name=node["name"],
+                                nout=node.get("nout", 1), kw_inputs=kw_ins)
     return built[data["head"]]
 
 
@@ -382,6 +411,7 @@ def _populate():
 
     from .. import numpy as mxnp
     from .. import numpy_extension as mxnpx
+    from ..ndarray import legacy as mxlegacy
 
     # arithmetic primitives used by operator overloads
     _register("_plus", lambda a, b: a + b)
@@ -391,7 +421,12 @@ def _populate():
     _register("_power", lambda a, b: a ** b)
 
     g = globals()
-    for ns in (mxnp, mxnpx):
+    # mx.sym IS the legacy symbol API (reference `symbol/register.py`
+    # mirrors `ndarray/register.py`), so the legacy surface registers LAST
+    # and overrides colliding np/npx names (sum w/ exclude, legacy dot
+    # transpose flags, float-dtype comparisons, Reshape codes, ...)
+    for ns in (mxnp, mxnpx, mxlegacy):
+        override = ns is mxlegacy
         for attr in dir(ns):
             if attr.startswith("_"):
                 continue
@@ -402,18 +437,26 @@ def _populate():
                         "set_np", "reset_np", "use_np", "is_np_array",
                         "invoke", "apply_aux_update", "is_recording",
                         "is_training", "cpu", "gpu", "tpu",
-                        "current_context", "num_gpus", "num_tpus"):
+                        "current_context", "num_gpus", "num_tpus",
+                        "random", "Custom"):
                 continue
-            if attr not in g:
+            if attr.endswith("_update"):
+                continue  # mutate-output optimizer kernels: no symbolic form
+            if attr not in g or override:
                 g[attr] = _register(attr, fn)
-                __all__.append(attr)
+                if attr not in __all__:
+                    __all__.append(attr)
+
+    # multi-output legacy ops need nout on the built Symbol so indexing works
+    def _slice_channel_builder(data, num_outputs=1, axis=1,
+                               squeeze_axis=False, name=None):
+        sym = Symbol("SliceChannel", [data],
+                     {"num_outputs": num_outputs, "axis": axis,
+                      "squeeze_axis": squeeze_axis},
+                     name=name or "SliceChannel", nout=num_outputs)
+        return sym
+    g["SliceChannel"] = _slice_channel_builder
+    g["split"] = _slice_channel_builder
 
 
 _populate()
-
-# reference CamelCase aliases commonly used in legacy symbol scripts
-FullyConnected = globals().get("fully_connected")
-Activation = globals().get("activation")
-Convolution = globals().get("convolution")
-Pooling = globals().get("pooling")
-SoftmaxOutput = None  # legacy training-head op: use make_loss + softmax

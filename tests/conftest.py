@@ -47,6 +47,12 @@ def _seed_rng(request):
     mx.waitall()
 
 
+@pytest.fixture
+def rng():
+    """Per-test numpy Generator seeded by the autouse seed fixture."""
+    return onp.random.default_rng(onp.random.randint(0, 2 ** 31))
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "seed: fixed-seed test")
     config.addinivalue_line("markers", "serial: serial-only test")
