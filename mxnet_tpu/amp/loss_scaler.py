@@ -18,12 +18,15 @@ class LossScaler:
         self._unskipped = 0
 
     def has_overflow(self, params):
-        """Check grads for inf/nan (reference checks via multi_all_finite)."""
+        """Check grads for inf/nan (reference checks via multi_all_finite).
+        Row-sparse grads check only their stored rows — no densify."""
         if not self._dynamic:
             return False
+        from ..ndarray.sparse import RowSparseNDArray
         for p in params:
             for g in p.list_grad():
-                a = g.asnumpy()
+                a = onp.asarray(g.data) if isinstance(g, RowSparseNDArray) \
+                    else g.asnumpy()
                 if not onp.isfinite(a).all():
                     return True
         return False

@@ -122,23 +122,26 @@ class Dropout(HybridBlock):
 
 
 class Embedding(HybridBlock):
+    """Reference `basic_layers.py` Embedding.  ``sparse_grad=True`` keeps
+    the weight gradient row-sparse on the eager path (reference
+    `Embedding(sparse_grad=True)` + row_sparse Trainer flow,
+    `python/mxnet/gluon/trainer.py:385-409`); storage stays dense (XLA)."""
+
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False):
         super().__init__()
-        if sparse_grad:
-            raise NotImplementedError(
-                "sparse_grad embeddings are a row_sparse optimization for "
-                "CPU parameter servers; on TPU dense gather/scatter is the "
-                "fast path (SURVEY.md §7)")
         self._input_dim = input_dim
         self._output_dim = output_dim
-        self.weight = Parameter("weight", shape=(input_dim, output_dim),
-                                dtype=dtype,
-                                init=_resolve_init(weight_initializer))
+        self._sparse_grad = sparse_grad
+        self.weight = Parameter(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=_resolve_init(weight_initializer),
+            grad_stype="row_sparse" if sparse_grad else "default")
 
     def forward(self, x):
         return npx.embedding(x, self.weight.data(), input_dim=self._input_dim,
-                             output_dim=self._output_dim)
+                             output_dim=self._output_dim,
+                             sparse_grad=self._sparse_grad)
 
 
 class BatchNorm(HybridBlock):

@@ -72,10 +72,14 @@ class Parameter:
         if not differentiable:
             grad_req = "null"
         self._grad_req = grad_req
-        if stype != "default" or grad_stype != "default":
+        if stype != "default":
             raise NotImplementedError(
-                "sparse parameter storage is not supported on TPU "
-                "(SURVEY.md §7: XLA has no sparse buffers)")
+                "sparse parameter *storage* is not supported on TPU "
+                "(SURVEY.md §7: XLA has no sparse buffers); row_sparse "
+                "*gradients* are — use grad_stype='row_sparse'")
+        if grad_stype not in ("default", "row_sparse"):
+            raise ValueError(f"unsupported grad_stype {grad_stype!r}")
+        self._grad_stype = grad_stype
         self._data = None   # dict Context -> NDArray
         self._grad = None
         self._deferred_init = None  # (init, ctx_list, default_init)
@@ -170,7 +174,8 @@ class Parameter:
     def _init_grad(self):
         self._grad = {}
         for c, arr in self._data.items():
-            arr.attach_grad(self._grad_req)
+            arr.attach_grad(self._grad_req,
+                            stype=getattr(self, "_grad_stype", "default"))
             self._grad[c] = arr.grad
 
     def finish_deferred_init(self):
@@ -261,8 +266,12 @@ class Parameter:
     def zero_grad(self):
         if self._grad is None:
             return
+        from ..ndarray.sparse import RowSparseNDArray
         for g in self._grad.values():
-            g._rebind(jnp.zeros(g.shape, g.dtype))
+            if isinstance(g, RowSparseNDArray):
+                g._clear()
+            else:
+                g._rebind(jnp.zeros(g.shape, g.dtype))
 
     def reset_ctx(self, ctx):
         ctx = [Context(c) for c in (ctx if isinstance(ctx, (list, tuple)) else [ctx])]

@@ -187,31 +187,45 @@ class Trainer:
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
-            ws, gs = param.list_data(), param.list_grad()
-            sts = self._states[i]
-            if not isinstance(sts, list):
-                sts = [sts]
-            if len(sts) != len(ws):
-                # device set changed since states were created (reset_ctx):
-                # rebuild this parameter's states to match
-                sts = [self._optimizer.create_state_multi_precision(i, w)
-                       for w in ws]
-                self._states[i] = sts if len(sts) > 1 else sts[0]
-            for dev_id, (w, g, st) in enumerate(zip(ws, gs, sts)):
-                # per-device update counts (reference
-                # `Optimizer._set_current_context`)
-                self._optimizer._set_current_context(dev_id)
-                self._optimizer.update([i], [w], [g], [st])
-            self._optimizer._set_current_context(0)
+            self._eager_param_update(i, param)
+
+    def _eager_param_update(self, i, param):
+        ws, gs = param.list_data(), param.list_grad()
+        sts = self._states[i]
+        if not isinstance(sts, list):
+            sts = [sts]
+        if len(sts) != len(ws):
+            # device set changed since states were created (reset_ctx):
+            # rebuild this parameter's states to match
+            sts = [self._optimizer.create_state_multi_precision(i, w)
+                   for w in ws]
+            self._states[i] = sts if len(sts) > 1 else sts[0]
+        for dev_id, (w, g, st) in enumerate(zip(ws, gs, sts)):
+            # per-device update counts (reference
+            # `Optimizer._set_current_context`)
+            self._optimizer._set_current_context(dev_id)
+            self._optimizer.update([i], [w], [g], [st])
+        self._optimizer._set_current_context(0)
 
     # -- the fused path ----------------------------------------------------
     def _try_fused_update(self):
         if getattr(self._optimizer, "supports_fused", True) is False:
             return False
+        # row_sparse-grad params take the lazy eager path (reference
+        # trainer.py routes row_sparse through sparse push/pull); the rest
+        # still fuse into one XLA program
+        sparse_idxs = [
+            i for i, p in enumerate(self._params)
+            if p.grad_req != "null"
+            and getattr(p, "_grad_stype", "default") != "default"]
         idxs = [i for i, p in enumerate(self._params)
-                if p.grad_req != "null" and len(p.list_ctx()) == 1]
-        if len(idxs) != sum(1 for p in self._params if p.grad_req != "null"):
+                if p.grad_req != "null" and len(p.list_ctx()) == 1
+                and i not in sparse_idxs]
+        if len(idxs) + len(sparse_idxs) != \
+                sum(1 for p in self._params if p.grad_req != "null"):
             return False
+        for i in sparse_idxs:
+            self._eager_param_update(i, self._params[i])
         if not idxs:
             return True
         optimizer = self._optimizer

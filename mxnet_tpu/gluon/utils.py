@@ -51,9 +51,16 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
 
 def clip_global_norm(arrays, max_norm, check_isfinite=True):
     """Rescale arrays so their joint L2 norm is at most max_norm (in place,
-    like the reference)."""
+    like the reference).  Row-sparse grads contribute/scale only their
+    stored rows — O(touched rows), as in the reference's sparse path."""
+    from ..ndarray.sparse import RowSparseNDArray
+
     assert len(arrays) > 0
-    total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+
+    def _vals(a):
+        return a.data if isinstance(a, RowSparseNDArray) else a._data
+
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(_vals(a).astype(jnp.float32)))
                          for a in arrays))
     total_host = float(total)
     if check_isfinite and not onp.isfinite(total_host):
@@ -64,7 +71,10 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
     scale = max_norm / (total_host + 1e-8)
     if scale < 1.0:
         for a in arrays:
-            a._rebind(a._data * scale)
+            if isinstance(a, RowSparseNDArray):
+                a._set_rows(a.indices, a.data * scale)
+            else:
+                a._rebind(a._data * scale)
     return total_host if check_isfinite else total
 
 

@@ -47,7 +47,7 @@ class LocalKVStore(KVStoreBase):
                 raise MXNetError(f"key {k} not initialized")
             src = self._store[k]
             for dst in _as_list(o):
-                src.as_in_ctx(dst.ctx).copyto(dst)
+                _copy_into(src, dst)
 
     def set_optimizer(self, optimizer):
         from ..optimizer import Updater
@@ -68,7 +68,7 @@ class LocalKVStore(KVStoreBase):
             reduced = _reduce(v)
             for dst in _as_list(o):
                 if dst is not reduced:
-                    reduced.as_in_ctx(dst.ctx).copyto(dst)
+                    _copy_into(reduced, dst)
 
     @staticmethod
     def is_capable(capability):
@@ -118,10 +118,48 @@ def _int_key(k):
 
 def _reduce(v):
     vals = _as_list(v)
+    from ..ndarray.sparse import RowSparseNDArray
+    if isinstance(vals[0], RowSparseNDArray):
+        # row_sparse reduce: gather to the first copy's device (the dense
+        # path's as_in_ctx analogue), concat + duplicate-row sum
+        # (reference `comm.h` ReduceRowSparse)
+        import jax
+        import jax.numpy as jnp
+        from ..ops.sparse_grad import reduce_rows
+        dev = next(iter(vals[0].data.devices()))
+        idx = jnp.concatenate(
+            [jax.device_put(jnp.asarray(x.indices), dev) for x in vals])
+        dat = jnp.concatenate(
+            [jax.device_put(jnp.asarray(x.data), dev).astype(vals[0].dtype)
+             for x in vals])
+        ridx, rdat = reduce_rows(idx, dat)
+        return RowSparseNDArray(rdat, ridx, vals[0].shape, vals[0].dtype)
     acc = vals[0]
     for x in vals[1:]:
         acc = acc + x.as_in_ctx(acc.ctx)
     return acc
+
+
+def _copy_into(src, dst):
+    from ..ndarray.sparse import RowSparseNDArray
+    if isinstance(dst, RowSparseNDArray):
+        import jax
+        import jax.numpy as jnp
+        dev = next(iter(dst.data.devices()))
+        if isinstance(src, RowSparseNDArray):
+            dst._set_rows(jax.device_put(src.indices, dev),
+                          jax.device_put(src.data, dev))
+        else:  # densified source into a sparse slot: keep nonzero rows
+            d = jax.device_put(src._data, dev)
+            nz = jnp.nonzero(jnp.any(d.reshape(d.shape[0], -1) != 0,
+                                     axis=1))[0]
+            dst._set_rows(nz, d[nz])
+        return
+    if isinstance(src, RowSparseNDArray):
+        from ..ndarray.ndarray import NDArray
+        NDArray(src.dense_data()).copyto(dst)
+        return
+    src.as_in_ctx(dst.ctx).copyto(dst)
 
 
 def _normalize(key, value):

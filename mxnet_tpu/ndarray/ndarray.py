@@ -250,11 +250,20 @@ class NDArray:
     # ------------------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
         """Allocate a gradient buffer; marks this array as a leaf variable
-        (reference: `python/mxnet/autograd.py:196` mark_variables)."""
+        (reference: `python/mxnet/autograd.py:196` mark_variables).
+        ``stype='row_sparse'`` allocates a device-backed RowSparseNDArray
+        buffer so wide-embedding grads stay O(touched rows)."""
         if grad_req not in ("write", "add", "null"):
             raise ValueError(f"invalid grad_req {grad_req!r}")
         self._node = None  # leaves are detached from any previous graph
-        self._grad = NDArray(jnp.zeros(self.shape, self.dtype), ctx=self._ctx)
+        if stype in (None, "default"):
+            self._grad = NDArray(jnp.zeros(self.shape, self.dtype),
+                                 ctx=self._ctx)
+        elif stype == "row_sparse":
+            from . import sparse as _sparse
+            self._grad = _sparse.zeros("row_sparse", self.shape, self.dtype)
+        else:
+            raise ValueError(f"unsupported grad stype {stype!r}")
         self._grad_req = grad_req
         return self
 
@@ -263,7 +272,12 @@ class NDArray:
         return self._grad
 
     def zero_grad(self):
-        if self._grad is not None:
+        if self._grad is None:
+            return
+        from .sparse import RowSparseNDArray
+        if isinstance(self._grad, RowSparseNDArray):
+            self._grad._clear()
+        else:
             self._grad._rebind(jnp.zeros(self.shape, self.dtype))
 
     def detach(self):

@@ -66,6 +66,13 @@ class _SparseNDArray:
         raise ValueError(
             f"cannot convert {self.stype} directly to {stype!r}")
 
+    def copy(self):
+        if self.stype == "row_sparse":
+            return RowSparseNDArray(self.data, self.indices, self._shape,
+                                    self._dtype)
+        return CSRNDArray(self.data, self.indices, self.indptr, self._shape,
+                          self._dtype)
+
 
 class CSRNDArray(_SparseNDArray):
     """Compressed sparse row matrix (reference `CSRNDArray`)."""
@@ -110,22 +117,50 @@ class CSRNDArray(_SparseNDArray):
 
 class RowSparseNDArray(_SparseNDArray):
     """First-dim-sparse tensor (reference `RowSparseNDArray`): `data`
-    holds only the rows listed in `indices`."""
+    holds only the rows listed in `indices`.
+
+    Device-backed: ``data``/``indices`` are jax arrays, so a row-sparse
+    gradient never leaves HBM — the optimizers consume it as one XLA
+    scatter over the touched rows (`ops/sparse_grad.py`)."""
 
     stype = "row_sparse"
 
     def __init__(self, data, indices, shape, dtype=None):
-        data = onp.asarray(data)
+        import jax.numpy as jnp
+
+        data = jnp.asarray(data)
         super().__init__(shape, dtype or data.dtype)
         self.data = data.astype(self._dtype)
-        self.indices = onp.asarray(indices, onp.int32)
+        if isinstance(indices, jax.Array):
+            self.indices = indices.astype(jnp.int32)
+        else:  # host list/tuple/ndarray (possibly empty)
+            self.indices = jnp.asarray(onp.asarray(indices, onp.int32))
         assert self.data.shape[0] == self.indices.shape[0]
         assert self.data.shape[1:] == self._shape[1:]
 
+    def _set_rows(self, indices, values):
+        """In-place rebind (the engine's sparse grad-buffer write; object
+        identity is preserved for Trainer's list_grad captures)."""
+        import jax.numpy as jnp
+
+        self.indices = jnp.asarray(indices).astype(jnp.int32)
+        self.data = jnp.asarray(values).astype(self._dtype)
+
+    def _clear(self):
+        import jax.numpy as jnp
+
+        self.indices = jnp.zeros((0,), jnp.int32)
+        self.data = jnp.zeros((0,) + self._shape[1:], self._dtype)
+
+    def dense_data(self):
+        """Dense jax array (scatter; duplicates summed)."""
+        import jax.numpy as jnp
+
+        out = jnp.zeros(self._shape, self._dtype)
+        return out.at[self.indices].add(self.data)
+
     def asnumpy(self):
-        out = onp.zeros(self._shape, self._dtype)
-        out[self.indices] = self.data
-        return out
+        return onp.asarray(self.dense_data())
 
 
 def csr_matrix(arg1, shape=None, dtype=None):
@@ -203,6 +238,7 @@ def retain(rs, indices):
     if not isinstance(rs, RowSparseNDArray):
         raise TypeError("retain expects a RowSparseNDArray")
     want = onp.asarray(indices, onp.int32)
-    mask = onp.isin(rs.indices, want)
-    return RowSparseNDArray(rs.data[mask], rs.indices[mask], rs.shape,
+    have = onp.asarray(rs.indices)
+    mask = onp.isin(have, want)
+    return RowSparseNDArray(onp.asarray(rs.data)[mask], have[mask], rs.shape,
                             rs.dtype)

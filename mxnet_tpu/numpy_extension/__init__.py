@@ -63,7 +63,23 @@ log_softmax = _op(_nn.log_softmax, "log_softmax")
 masked_softmax = _op(_nn.masked_softmax, "masked_softmax")
 masked_log_softmax = _op(_nn.masked_log_softmax, "masked_log_softmax")
 leaky_relu = _op(_nn.leaky_relu, "leaky_relu")
-embedding = _op(_nn.embedding, "embedding")
+_dense_embedding = _op(_nn.embedding, "embedding")
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False):
+    """Embedding lookup.  With ``sparse_grad=True`` on the eager tape, the
+    recorded backward emits a row-sparse cotangent (O(batch·dim) HBM, not
+    O(vocab·dim)) — see `ops/sparse_grad.py`; under a hybridize trace the
+    dense path runs and XLA fuses the scatter."""
+    if sparse_grad and is_recording():
+        from ..ops.sparse_grad import sparse_embedding
+        from ..ndarray.ndarray import NDArray as _ND
+        if isinstance(weight, _ND) and not isinstance(
+                weight._data, jax.core.Tracer):
+            return sparse_embedding(data, weight, dtype=dtype)
+    return _dense_embedding(data, weight, input_dim=input_dim,
+                            output_dim=output_dim, dtype=dtype)
 one_hot = _op(_nn.one_hot, "one_hot", differentiable=False)
 pick = _op(_nn.pick, "pick")
 topk = _op(_nn.topk, "topk", differentiable=False)

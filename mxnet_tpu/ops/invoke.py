@@ -383,6 +383,12 @@ def _accumulate_and_write(heads, head_grads, retain_graph, create_graph,
         in_grads = _node_vjp(node, full_cts, create_graph)
         for (arr, pnode, pidx), g in zip(node.parents, in_grads):
             if pnode is not None:
+                from .sparse_grad import RowSparseCT
+
+                if isinstance(g, RowSparseCT):
+                    # sparse cotangents exist only for leaf params; an
+                    # interior node needs the dense form to keep flowing
+                    g = g.to_dense()
                 add_node_ct(pnode, pidx, g)
                 pending[pnode] -= 1
                 if pending[pnode] == 0:
@@ -394,17 +400,25 @@ def _accumulate_and_write(heads, head_grads, retain_graph, create_graph,
             node.fun = None
             node.flat_const = None
 
+    from .sparse_grad import RowSparseCT
+
     if variables is not None:
         out = []
         for v in variables:
             entry = leaf_grads.get(id(v))
             g = entry[1] if entry is not None else jnp.zeros(v.shape, v.dtype)
-            out.append(_as_nd(g, v._ctx, create_graph))
+            if isinstance(g, RowSparseCT):
+                out.append(_sparse_ct_to_nd(g, v))  # already a container
+            else:
+                out.append(_as_nd(g, v._ctx, create_graph))
         return out
 
     # write into .grad honoring grad_req
     for arr, g in leaf_grads.values():
         if arr._grad is None or arr._grad_req == "null":
+            continue
+        if isinstance(g, RowSparseCT) or _is_row_sparse(arr._grad):
+            _write_sparse_grad(arr, g)
             continue
         g_nd = _as_nd(g, arr._ctx, create_graph)
         if arr._grad_req == "add":
@@ -412,6 +426,55 @@ def _accumulate_and_write(heads, head_grads, retain_graph, create_graph,
         else:
             arr._grad._rebind(_raw(g_nd))
     return None
+
+
+def _is_row_sparse(x):
+    from ..ndarray.sparse import RowSparseNDArray
+
+    return isinstance(x, RowSparseNDArray)
+
+
+def _sparse_ct_to_nd(ct, v):
+    from ..ndarray.sparse import RowSparseNDArray
+
+    r = ct.reduced()
+    return RowSparseNDArray(r.values, r.indices, r.shape)
+
+
+def _write_sparse_grad(arr, g):
+    """Write/accumulate into a row_sparse gradient buffer in place
+    (reference: row_sparse grad_req handling in `ndarray.cc` CopyFromTo /
+    the sparse kUpdate path).  Falls back to densifying when the buffer is
+    dense but the cotangent arrived sparse."""
+    from .sparse_grad import RowSparseCT
+    from ..ndarray.sparse import RowSparseNDArray
+
+    buf = arr._grad
+    if not isinstance(buf, RowSparseNDArray):
+        dense = g.to_dense() if isinstance(g, RowSparseCT) else _raw(g)
+        if arr._grad_req == "add":
+            buf._rebind(buf._data + dense)
+        else:
+            buf._rebind(dense)
+        return
+    if isinstance(g, RowSparseCT):
+        if arr._grad_req == "add" and buf.indices.size:
+            merged = RowSparseCT(
+                jnp.concatenate([jnp.asarray(buf.indices), g.indices]),
+                jnp.concatenate([jnp.asarray(buf.data), g.values]),
+                g.shape).reduced()
+        else:
+            merged = g.reduced()
+        buf._set_rows(merged.indices, merged.values)
+    else:
+        # dense cotangent into a sparse buffer: keep only nonzero rows
+        dense = _raw(g)
+        if arr._grad_req == "add" and buf.indices.size:
+            dense = dense.at[jnp.asarray(buf.indices)].add(
+                jnp.asarray(buf.data))
+        nz = jnp.nonzero(jnp.any(dense.reshape(dense.shape[0], -1) != 0,
+                                 axis=1))[0].astype(jnp.int32)
+        buf._set_rows(nz, dense[nz])
 
 
 def _raw(x):
@@ -426,6 +489,10 @@ def _as_nd(g, ctx, keep_node=False):
 
 
 def _add_ct(a, b):
+    from .sparse_grad import RowSparseCT, add_cts
+
+    if isinstance(a, RowSparseCT) or isinstance(b, RowSparseCT):
+        return add_cts(a, b)
     if _is_nd(a) or _is_nd(b):
         return invoke(jnp.add, (a, b), name="_backward_add")
     return a + b
@@ -537,6 +604,12 @@ def _node_vjp(node, cotangents, create_graph):
         node.fun, node.flat_const, node.treedef, node.diff_idx,
     )
     if fun is None:
+        if node.vjp_fn is not None:
+            # a custom node (e.g. sparse_embedding) that never carried the
+            # re-derivable forward — not the freed-graph case
+            raise NotImplementedError(
+                f"create_graph=True through '{node.name}' is not supported "
+                "(higher-order grads need the dense path)")
         raise RuntimeError("graph has been freed; use retain_graph=True")
 
     def bwd(*xs_and_ct):

@@ -1,0 +1,110 @@
+"""Row-sparse gradients for wide embeddings.
+
+Reference: `Embedding(sparse_grad=True)`
+(`python/mxnet/gluon/nn/basic_layers.py` Embedding), row_sparse gradient
+flow through the Trainer (`python/mxnet/gluon/trainer.py:385-409`) and the
+row_sparse optimizer kernels (`src/operator/optimizer_op.cc`).
+
+TPU-native design: XLA buffers are dense, but the *gradient of a wide
+embedding* never needs materializing as a (vocab, dim) dense array — the
+tape records a custom node whose backward emits a :class:`RowSparseCT`
+(device-resident ``(indices, values)`` pair).  The autograd engine
+(`ops/invoke.py`) accumulates these cotangents sparsely, writes them into
+a ``RowSparseNDArray`` gradient buffer, and the optimizers apply them as
+one XLA scatter-add over the touched rows — the same lazy-update
+semantics as the reference's row_sparse kernels, at HBM cost O(batch·dim)
+instead of O(vocab·dim).
+
+This sparse path engages on the imperative (eager tape) path only; under
+``hybridize()``/``FusedTrainStep`` the whole step is one XLA program and
+grads are dense by construction (XLA fuses the scatter into the update).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import invoke as _inv
+
+
+class RowSparseCT:
+    """Device-side row-sparse cotangent: ``values[k]`` is the gradient of
+    row ``indices[k]``; ``shape`` is the full dense shape.  Indices may
+    repeat (the engine reduces duplicates when writing the grad buffer)."""
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices, values, shape):
+        self.indices = indices          # (k,) int32 jax array
+        self.values = values            # (k, *shape[1:]) jax array
+        self.shape = tuple(shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense(self):
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def reduced(self):
+        """Unique-ified copy (sorted unique indices, duplicate rows
+        summed) — the reference's canonical row_sparse form."""
+        idx, vals = reduce_rows(self.indices, self.values)
+        return RowSparseCT(idx, vals, self.shape)
+
+
+def reduce_rows(indices, values):
+    """Sum duplicate rows; returns (sorted unique indices, summed values).
+    Eager-only (output shape is data-dependent)."""
+    uniq, inv = jnp.unique(indices, return_inverse=True)
+    summed = jax.ops.segment_sum(values, inv.reshape(-1),
+                                 num_segments=int(uniq.shape[0]))
+    return uniq.astype(jnp.int32), summed
+
+
+def add_cts(a, b):
+    """Accumulate two cotangents where at least one is row-sparse."""
+    a_sp = isinstance(a, RowSparseCT)
+    b_sp = isinstance(b, RowSparseCT)
+    if a_sp and b_sp:
+        return RowSparseCT(
+            jnp.concatenate([a.indices, b.indices]),
+            jnp.concatenate([a.values, b.values]), a.shape)
+    sp, dn = (a, b) if a_sp else (b, a)
+    dn = dn._data if _inv._is_nd(dn) else dn
+    return dn.at[sp.indices].add(sp.values)
+
+
+def sparse_embedding(data, weight, dtype=None):
+    """Embedding lookup whose recorded backward is row-sparse.
+
+    Forward is the same MXU gather as the dense path; only the tape node
+    differs.  ``create_graph`` (higher-order) over this node is not
+    supported — use the dense path for that.
+    """
+    idx = data._data.astype(jnp.int32) if _inv._is_nd(data) else \
+        jnp.asarray(data).astype(jnp.int32)
+    w_nd = weight
+    w_data = w_nd._data
+    out = jnp.take(w_data, idx, axis=0)
+    if dtype is not None:
+        out = out.astype(dtype)
+
+    record = (_inv._state.recording and _inv._attached(w_nd))
+    node = None
+    if record:
+        vshape = w_data.shape
+        vdtype = w_data.dtype
+
+        def vjp_fn(ct):
+            flat_idx = idx.reshape(-1)
+            vals = ct.reshape((-1,) + vshape[1:]).astype(vdtype)
+            return (RowSparseCT(flat_idx, vals, vshape),)
+
+        node = _inv.Node(
+            "sparse_embedding", vjp_fn,
+            [(w_nd, w_nd._node, getattr(w_nd, "_node_idx", 0))],
+            [jax.ShapeDtypeStruct(out.shape, out.dtype)],
+        )
+    return _inv._wrap_out(out, w_nd._ctx, node, "sparse_embedding")

@@ -140,6 +140,8 @@ class LAMB(Optimizer):
     `lamb_update_phase1/2` in optimizer_op.cc) — the BERT-pretraining
     optimizer from BASELINE.json config 4."""
 
+    lazy_sparse = False  # trust-ratio couples rows; sparse grads densify
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, lower_bound=None, upper_bound=None,
                  bias_correction=True, **kwargs):
@@ -181,6 +183,8 @@ class LAMB(Optimizer):
 @register
 class LANS(Optimizer):
     """LAMB with normalized gradients (reference `lans.py`)."""
+
+    lazy_sparse = False  # trust-ratio couples rows; sparse grads densify
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-6, **kwargs):

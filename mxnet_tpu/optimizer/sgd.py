@@ -124,6 +124,8 @@ class SGLD(Optimizer):
 class LARS(Optimizer):
     """Layer-wise adaptive rate scaling (reference `lars.py`)."""
 
+    lazy_sparse = False  # trust-ratio couples rows; sparse grads densify
+
     def __init__(self, learning_rate=0.1, momentum=0.0, eta=0.001,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)

@@ -1,0 +1,254 @@
+"""Row-sparse gradients end-to-end (VERDICT r1 #4).
+
+Reference: `Embedding(sparse_grad=True)`, Trainer row_sparse flow
+(`python/mxnet/gluon/trainer.py:385-409`), row_sparse optimizer kernels
+(`src/operator/optimizer_op.cc`), `cast_storage`
+(`src/operator/tensor/cast_storage.cc`).
+"""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def test_sparse_embedding_grad_is_row_sparse():
+    vocab, dim = 50, 4
+    w = mx.np.array(onp.random.RandomState(0).rand(vocab, dim).astype("f"))
+    w.attach_grad(stype="row_sparse")
+    idx = mx.np.array(onp.array([[3, 7], [3, 11]]), dtype="int32")
+    with mx.autograd.record():
+        out = mx.npx.embedding(idx, w, sparse_grad=True)
+        loss = (out * 2.0).sum()
+    loss.backward()
+    g = w.grad
+    assert isinstance(g, RowSparseNDArray)
+    assert sorted(_np(g.indices).tolist()) == [3, 7, 11]
+    dense = _np(g)
+    exp = onp.zeros((vocab, dim), "f")
+    exp[3] = 4.0  # row 3 looked up twice, duplicates summed
+    exp[7] = 2.0
+    exp[11] = 2.0
+    onp.testing.assert_allclose(dense, exp)
+
+
+def test_sparse_grad_accumulate_add():
+    vocab, dim = 20, 3
+    w = mx.np.array(onp.ones((vocab, dim), "f"))
+    w.attach_grad(grad_req="add", stype="row_sparse")
+    for rows in ([1, 2], [2, 5]):
+        idx = mx.np.array(onp.array(rows), dtype="int32")
+        with mx.autograd.record():
+            loss = mx.npx.embedding(idx, w, sparse_grad=True).sum()
+        loss.backward()
+    g = _np(w.grad)
+    exp = onp.zeros((vocab, dim), "f")
+    exp[[1, 5]] = 1.0
+    exp[2] = 2.0
+    onp.testing.assert_allclose(g, exp)
+    w.zero_grad()
+    assert w.grad.indices.size == 0 and _np(w.grad).sum() == 0
+
+
+def test_gluon_embedding_sparse_matches_dense_training():
+    """A wide-embedding model trains identically sparse vs dense with
+    stateless SGD + wd=0 — the case where lazy row updates are exactly
+    dense-equivalent (reference dist_sync_kvstore row_sparse checks).
+    Stateful optimizers (Adam) intentionally diverge on untouched rows:
+    that lazy semantics is covered by test_lazy_update_skips_untouched_rows
+    and test_lazy_adam_updates_touched_state_only."""
+    vocab, dim, steps = 100, 8, 4
+    rs = onp.random.RandomState(7)
+    batches = [rs.randint(0, vocab, (6,)).astype("i") for _ in range(steps)]
+    targets = [rs.rand(6, 1).astype("f") for _ in range(steps)]
+
+    results = {}
+    for sparse in (False, True):
+        mx.random.seed(11)
+        net = mx.gluon.nn.HybridSequential()
+        emb = mx.gluon.nn.Embedding(vocab, dim, sparse_grad=sparse)
+        dense_head = mx.gluon.nn.Dense(1)
+        net.add(emb)
+        net.add(dense_head)
+        net.initialize()
+        trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.05, "wd": 0.0})
+        for x, y in zip(batches, targets):
+            xa = mx.np.array(x, dtype="int32")
+            ya = mx.np.array(y)
+            with mx.autograd.record():
+                loss = ((net(xa) - ya) ** 2).mean()
+            loss.backward()
+            trainer.step(1)
+        results[sparse] = {k: p.data().asnumpy()
+                           for k, p in net.collect_params().items()}
+        if sparse:
+            g = emb.weight.grad()
+            assert isinstance(g, RowSparseNDArray), \
+                "sparse path must produce a row_sparse grad buffer"
+            # grad rows bounded by batch vocabulary, not the full table
+            assert g.indices.shape[0] <= 6
+
+    for k in results[False]:
+        onp.testing.assert_allclose(
+            results[True][k], results[False][k], rtol=2e-4, atol=2e-5,
+            err_msg=f"param {k} diverged between sparse and dense")
+
+
+def test_lazy_update_skips_untouched_rows():
+    """With wd>0 the lazy path must decay ONLY touched rows (reference
+    lazy_update/row_sparse sgd semantics)."""
+    vocab, dim = 10, 2
+    emb = mx.gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    w0 = emb.weight.data().asnumpy().copy()
+    trainer = mx.gluon.Trainer(emb.collect_params(), "sgd",
+                               {"learning_rate": 0.1, "wd": 0.5})
+    idx = mx.np.array(onp.array([2, 4]), dtype="int32")
+    with mx.autograd.record():
+        loss = emb(idx).sum()
+    loss.backward()
+    trainer.step(1)
+    w1 = emb.weight.data().asnumpy()
+    touched = [2, 4]
+    untouched = [i for i in range(vocab) if i not in touched]
+    onp.testing.assert_allclose(w1[untouched], w0[untouched],
+                                err_msg="untouched rows must not decay")
+    assert not onp.allclose(w1[touched], w0[touched])
+    exp = w0[touched] - 0.1 * (1.0 + 0.5 * w0[touched])
+    onp.testing.assert_allclose(w1[touched], exp, rtol=1e-5)
+
+
+def test_lazy_adam_updates_touched_state_only():
+    """Lazy Adam: mean/var of untouched rows stay zero (the reference's
+    row_sparse adam kernel contract)."""
+    vocab, dim = 12, 2
+    emb = mx.gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    trainer = mx.gluon.Trainer(emb.collect_params(), "adam",
+                               {"learning_rate": 0.01})
+    idx = mx.np.array(onp.array([0, 5]), dtype="int32")
+    with mx.autograd.record():
+        loss = emb(idx).sum()
+    loss.backward()
+    trainer.step(1)
+    (mean, var) = trainer._states[0]
+    m = mean.asnumpy()
+    assert onp.abs(m[[0, 5]]).sum() > 0
+    onp.testing.assert_allclose(
+        m[[i for i in range(vocab) if i not in (0, 5)]], 0.0)
+
+
+def test_cast_storage_round_trip():
+    x = onp.zeros((6, 3), "f")
+    x[1] = 1.5
+    x[4] = -2.0
+    d = mx.np.array(x)
+    rs = d.tostype("row_sparse")
+    assert rs.stype == "row_sparse"
+    assert sorted(onp.asarray(rs.indices).tolist()) == [1, 4]
+    back = rs.tostype("default")
+    onp.testing.assert_allclose(_np(back), x)
+    # legacy op spelling
+    rs2 = nd.cast_storage(d, "row_sparse")
+    onp.testing.assert_allclose(_np(rs2), x)
+    d2 = nd.cast_storage(rs2, "default")
+    onp.testing.assert_allclose(_np(d2), x)
+
+
+def test_retain_and_kvstore_sparse_reduce():
+    from mxnet_tpu.ndarray import sparse as sp
+    rs = sp.row_sparse_array(
+        (onp.array([[1., 1.], [2., 2.], [3., 3.]], "f"), [1, 3, 5]),
+        shape=(8, 2))
+    kept = sp.retain(rs, [1, 5])
+    assert sorted(onp.asarray(kept.indices).tolist()) == [1, 5]
+    onp.testing.assert_allclose(_np(kept)[3], 0)
+
+    kv = mx.kv.create("local")
+    a = sp.row_sparse_array((onp.array([[1., 1.]], "f"), [2]), shape=(6, 2))
+    b = sp.row_sparse_array((onp.array([[2., 2.]], "f"), [2]), shape=(6, 2))
+    out = sp.zeros("row_sparse", (6, 2))
+    kv.init("emb", a)
+    kv.pushpull("emb", [a, b], out=out)
+    dense = _np(out)
+    exp = onp.zeros((6, 2), "f")
+    exp[2] = 3.0
+    onp.testing.assert_allclose(dense, exp)
+
+
+def test_sparse_grad_flows_dense_through_hybridize():
+    """Under hybridize the step is one XLA program; sparse_grad falls back
+    to the dense path and numerics still match."""
+    vocab, dim = 30, 4
+    net = mx.gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    net.initialize()
+    idx = mx.np.array(onp.array([1, 2, 3]), dtype="int32")
+    eager = net(idx).asnumpy()
+    net.hybridize()
+    hyb = net(idx).asnumpy()
+    onp.testing.assert_allclose(eager, hyb, rtol=1e-6)
+
+
+def test_review_regressions_grad_api_and_clip():
+    """autograd.grad(), zero_grad, clip_global_norm, and multi-device
+    pushpull all handle row_sparse grads (r2 code-review findings)."""
+    vocab, dim = 16, 3
+    emb = mx.gluon.nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    idx = mx.np.array(onp.array([1, 3, 1]), dtype="int32")
+
+    # autograd.grad returns a RowSparseNDArray, not a crash
+    w = emb.weight.data()
+    with mx.autograd.record():
+        loss = emb(idx).sum()
+    (g,) = mx.autograd.grad(loss, [w])
+    assert isinstance(g, RowSparseNDArray)
+    exp = onp.zeros((vocab, dim), "f")
+    exp[1] = 2.0
+    exp[3] = 1.0
+    onp.testing.assert_allclose(_np(g), exp)
+
+    # Parameter.zero_grad on a sparse buffer
+    with mx.autograd.record():
+        emb(idx).sum().backward()
+    assert emb.weight.grad().indices.size > 0
+    emb.zero_grad()
+    assert emb.weight.grad().indices.size == 0
+
+    # clip_global_norm over a mixed dense/sparse grad list
+    with mx.autograd.record():
+        emb(idx).sum().backward()
+    dense = mx.np.array(onp.full((2, 2), 100.0, "f"))
+    dense.attach_grad()
+    with mx.autograd.record():
+        (dense * 3).sum().backward()
+    total = mx.gluon.utils.clip_global_norm(
+        [emb.weight.grad(), dense.grad], 1.0)
+    assert total > 1.0
+    vals = onp.asarray(emb.weight.grad().data)
+    assert onp.abs(vals).max() < 1.0
+
+    # duplicate indices in a hand-built grad reduce before the row update
+    import mxnet_tpu.optimizer as opt
+    w2 = mx.np.array(onp.zeros((4, 2), "f"))
+    rs = RowSparseNDArray(onp.array([[1., 1.], [2., 2.]], "f"), [2, 2],
+                          (4, 2))
+    sgd = opt.SGD(learning_rate=1.0)
+    sgd.update([0], [w2], [rs], [()])
+    onp.testing.assert_allclose(_np(w2)[2], [-3.0, -3.0])
+
+
+def test_create_graph_through_sparse_embedding_raises_clearly():
+    import pytest
+    emb = mx.gluon.nn.Embedding(8, 2, sparse_grad=True)
+    emb.initialize()
+    idx = mx.np.array(onp.array([1]), dtype="int32")
+    with mx.autograd.record():
+        loss = emb(idx).sum()
+    with pytest.raises(NotImplementedError, match="sparse_embedding"):
+        loss.backward(create_graph=True)
