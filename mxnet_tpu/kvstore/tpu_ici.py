@@ -39,17 +39,25 @@ __all__ = ["TPUICIStore"]
 
 @functools.lru_cache(maxsize=None)
 def _allreduce_fn(n_dev, shape, dtype):
-    """Compile a sum-allreduce over a 1-d mesh of the first n_dev devices."""
+    """Compile a sum-allreduce over a 1-d mesh of the first n_dev devices.
+
+    The input is a (n_dev, *shape) array sharded one slice per device;
+    ``shard_map`` + ``psum`` makes XLA emit a ring all-reduce over ICI,
+    and the output keeps the same sharding — every device holds the sum
+    locally, so writing back to the per-device copies is transfer-free.
+    """
+    from jax.experimental.shard_map import shard_map
+
     devices = jax.devices()[:n_dev]
     mesh = Mesh(onp.asarray(devices), ("dev",))
-
-    @jax.jit
-    def allreduce(stacked):
-        # stacked: (n_dev, *shape) sharded over 'dev'; psum over the axis
-        return jnp.sum(stacked, axis=0)
-
     sharding = NamedSharding(mesh, P("dev"))
-    return allreduce, sharding
+
+    reduce_local = shard_map(
+        lambda x: jax.lax.psum(x, "dev"), mesh,
+        in_specs=P("dev"), out_specs=P("dev"))
+    allreduce = jax.jit(reduce_local,
+                        in_shardings=sharding, out_shardings=sharding)
+    return allreduce, sharding, mesh
 
 
 def _quantize_2bit(x, residual, threshold):
@@ -121,6 +129,13 @@ class TPUICIStore(KVStoreBase):
         # out=None means update the pushed arrays in place (Trainer path)
         targets = vals if out is None else \
             (out if isinstance(out, (list, tuple)) else [out])
+        if isinstance(reduced, list):
+            # per-device reduced copies from the allreduce: same-device
+            # writes, no cross-chip transfer
+            for o, r in zip(targets, reduced):
+                if o is not r:
+                    r.copyto(o)
+            return None
         for o in targets:
             if o is not reduced:
                 reduced.as_in_ctx(o.ctx).copyto(o)
@@ -148,23 +163,29 @@ class TPUICIStore(KVStoreBase):
         return NDArray(out, ctx=vals[0].ctx)
 
     def _reduce_copies(self, vals):
-        """Sum per-device copies with one compiled allreduce (ICI ring)."""
+        """Sum per-device copies with one compiled allreduce (ICI ring).
+
+        Returns one NDArray per input copy, each holding the reduced value
+        on that copy's device (the psum output shard) — no gather through
+        a hub device."""
         n = len(vals)
-        shape = vals[0].shape
+        shape = tuple(vals[0].shape)
         dtype = str(vals[0].dtype)
-        allreduce, sharding = _allreduce_fn(n, shape, dtype)
-        try:
-            stacked = jnp.stack(
-                [jax.device_put(v._data, sharding.mesh.devices.flat[i])
-                 for i, v in enumerate(vals)])
-            out = allreduce(stacked)
-        except Exception:
-            # fallback: tree-reduce through the first device
-            acc = vals[0]._data
-            for v in vals[1:]:
-                acc = acc + jax.device_put(v._data, list(acc.devices())[0])
-            out = acc
-        return NDArray(out, ctx=vals[0].ctx)
+        allreduce, sharding, mesh = _allreduce_fn(n, shape, dtype)
+        mesh_devs = list(mesh.devices.flat)
+        pieces = [
+            jax.device_put(v._data.reshape((1,) + shape), mesh_devs[i])
+            for i, v in enumerate(vals)
+        ]
+        stacked = jax.make_array_from_single_device_arrays(
+            (n,) + shape, sharding, pieces)
+        summed = allreduce(stacked)
+        # addressable_shards[i].data is the sum, resident on device i
+        by_dev = {s.device: s.data for s in summed.addressable_shards}
+        return [
+            NDArray(by_dev[mesh_devs[i]].reshape(shape), ctx=vals[i].ctx)
+            for i in range(n)
+        ]
 
     @staticmethod
     def is_capable(capability):
