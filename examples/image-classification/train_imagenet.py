@@ -38,19 +38,43 @@ def parse():
     return p.parse_args()
 
 
+_MEAN = onp.array([123.68, 116.779, 103.939], onp.float32)
+_STD = onp.array([58.393, 57.12, 57.375], onp.float32)
+
+
 def batches(args, ctxs):
     if args.rec_train:
-        it = mx.image.ImageIter(
-            args.batch_size, (3, 224, 224), path_imgrec=args.rec_train,
-            shuffle=True,
-            aug_list=mx.image.CreateAugmenter((3, 224, 224), resize=256,
-                                              rand_crop=True,
-                                              rand_mirror=True, mean=True,
-                                              std=True))
+        # native C++ pipeline (src/image_pipeline.cc): GIL-free JPEG
+        # decode threads -> NHWC uint8; normalize on DEVICE so XLA fuses
+        # it into the first conv (host normalization would halve
+        # throughput).  Falls back to the PIL ImageIter if libjpeg is
+        # unavailable.
+        try:
+            it = mx.io.ImageRecordIter(
+                path_imgrec=args.rec_train, batch_size=args.batch_size,
+                data_shape=(3, 224, 224), resize=256, rand_crop=True,
+                rand_mirror=True, shuffle=True, layout="NHWC")
+        except (RuntimeError, IOError):
+            it = mx.image.ImageIter(
+                args.batch_size, (3, 224, 224), path_imgrec=args.rec_train,
+                shuffle=True,
+                aug_list=mx.image.CreateAugmenter((3, 224, 224), resize=256,
+                                                  rand_crop=True,
+                                                  rand_mirror=True,
+                                                  mean=True, std=True))
+            while True:
+                it.reset()
+                for b in it:
+                    yield b.data[0].astype(args.dtype), b.label[0]
+        mean = mx.np.array(_MEAN)
+        std = mx.np.array(_STD)
         while True:
             it.reset()
             for b in it:
-                yield b.data[0].astype(args.dtype), b.label[0]
+                x = ((b.data[0].astype("float32") - mean) / std) \
+                    .astype(args.dtype)
+                # NHWC -> NCHW for the reference-layout model zoo
+                yield mx.np.transpose(x, (0, 3, 1, 2)), b.label[0]
     else:
         x = mx.np.array(onp.random.uniform(-1, 1,
                                            (args.batch_size, 3, 224, 224)),

@@ -22,21 +22,26 @@ _SRC = os.path.join(os.path.dirname(_HERE), "src")
 _SO = os.path.join(_HERE, "libmxtpu.so")
 
 
-def _build():
-    srcs = [os.path.join(_SRC, f) for f in sorted(os.listdir(_SRC))
-            if f.endswith(".cc")]
+# image_pipeline.cc links libjpeg and builds into its own .so so a system
+# without jpeg headers only loses that path (PIL fallback remains)
+_IMG_SRC_NAMES = ("image_pipeline.cc",)
+_IMG_SO = os.path.join(_HERE, "libmxtpu_img.so")
+
+
+def _compile(srcs, so_path, extra=()):
     if not srcs:
         return False
     newest_src = max(os.path.getmtime(s) for s in srcs)
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= newest_src:
+    if os.path.exists(so_path) and os.path.getmtime(so_path) >= newest_src:
         return True
     # compile to a per-pid temp file and rename: concurrent importers
     # (DataLoader workers, parallel jobs) must never load a half-written .so
-    tmp = "%s.tmp.%d" % (_SO, os.getpid())
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp] + srcs
+    tmp = "%s.tmp.%d" % (so_path, os.getpid())
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp] + \
+        list(srcs) + list(extra)
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
+        os.replace(tmp, so_path)
     except (OSError, subprocess.SubprocessError):
         try:
             os.unlink(tmp)
@@ -44,6 +49,12 @@ def _build():
             pass
         return False
     return True
+
+
+def _build():
+    srcs = [os.path.join(_SRC, f) for f in sorted(os.listdir(_SRC))
+            if f.endswith(".cc") and f not in _IMG_SRC_NAMES]
+    return _compile(srcs, _SO)
 
 
 def lib():
@@ -269,3 +280,43 @@ def parse_libsvm(path):
             onp.asarray(indptr, onp.int64),
             onp.asarray(indices, onp.int32),
             onp.asarray(values, onp.float32), ncols)
+
+
+_img_lib = None
+_img_tried = False
+
+
+def img_lib():
+    """The jpeg image-pipeline library, or None if unavailable."""
+    global _img_lib, _img_tried
+    if _img_lib is not None or _img_tried:
+        return _img_lib
+    with _lock:
+        if _img_lib is not None or _img_tried:
+            return _img_lib
+        _img_tried = True
+        srcs = [os.path.join(_SRC, f) for f in _IMG_SRC_NAMES
+                if os.path.exists(os.path.join(_SRC, f))]
+        if not _compile(srcs, _IMG_SO, extra=["-ljpeg", "-pthread"]):
+            return None
+        try:
+            L = ctypes.CDLL(_IMG_SO)
+        except OSError:
+            return None
+        L.imgpipe_last_error.restype = ctypes.c_char_p
+        L.imgpipe_create.restype = ctypes.c_void_p
+        L.imgpipe_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+        L.imgpipe_num_records.restype = ctypes.c_int64
+        L.imgpipe_num_records.argtypes = [ctypes.c_void_p]
+        L.imgpipe_decode_errors.restype = ctypes.c_int64
+        L.imgpipe_decode_errors.argtypes = [ctypes.c_void_p]
+        L.imgpipe_next.restype = ctypes.c_int
+        L.imgpipe_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_float)]
+        L.imgpipe_destroy.argtypes = [ctypes.c_void_p]
+        _img_lib = L
+        return _img_lib

@@ -2,6 +2,8 @@
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,
                  LibSVMIter, ResizeIter, PrefetchingIter)
 from .bucket import BucketSentenceIter
+from .image_record import ImageRecordIter
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "LibSVMIter", "ResizeIter", "PrefetchingIter", "BucketSentenceIter"]
+           "LibSVMIter", "ResizeIter", "PrefetchingIter", "BucketSentenceIter",
+           "ImageRecordIter"]

@@ -1,0 +1,114 @@
+"""``ImageRecordIter`` — the high-throughput image input pipeline.
+
+Reference: `src/io/iter_image_recordio_2.cc` (`ImageRecordIter` /
+ImageRecordIOParser2) + `src/io/image_aug_default.cc`.  The reference
+feeds GPUs from C++ decode threads; the Python/PIL path
+(`mxnet_tpu/image.py` ImageIter) cannot keep a TPU fed.  This iterator
+drives the native pipeline in `src/image_pipeline.cc`: worker threads
+decode JPEG (libjpeg-turbo, DCT-domain downscale) and augment entirely
+outside the GIL into a ring of batch slots; Python pops completed
+batches.
+
+Output is NHWC uint8 batches (the TPU-preferred layout); mean/std
+normalization and dtype casting belong on device, fused by XLA into the
+first conv — do NOT normalize on host.  ``layout='NCHW'`` transposes on
+device for reference-parity consumers.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as onp
+
+from ..ndarray.ndarray import NDArray
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["ImageRecordIter"]
+
+
+class ImageRecordIter(DataIter):
+    """Reference-parity constructor args (`io/iter_image_recordio_2.cc`
+    ImageRecordParam/ImageRecParserParam subset that is meaningful here).
+
+    data_shape is channel-first (C, H, W) as in the reference; delivery is
+    NHWC unless ``layout='NCHW'``.
+    """
+
+    def __init__(self, path_imgrec, batch_size, data_shape=(3, 224, 224),
+                 resize=0, rand_crop=False, rand_mirror=False,
+                 shuffle=False, preprocess_threads=None, prefetch_buffer=3,
+                 seed=0, layout="NHWC", round_batch=True, **_compat):
+        from .._native import img_lib
+
+        super().__init__(batch_size=batch_size)
+        L = img_lib()
+        if L is None:
+            raise RuntimeError(
+                "native image pipeline unavailable (libjpeg missing?); "
+                "use mxnet_tpu.image.ImageIter (PIL) instead")
+        c, h, w = data_shape
+        assert c == 3, "pipeline decodes RGB"
+        if preprocess_threads is None:
+            preprocess_threads = max(1, (os.cpu_count() or 1))
+        self._lib = L
+        self._h, self._w = h, w
+        self._layout = layout
+        self._handle = L.imgpipe_create(
+            path_imgrec.encode(), batch_size, h, w, int(resize),
+            int(preprocess_threads), int(prefetch_buffer),
+            int(bool(rand_crop)), int(bool(rand_mirror)),
+            int(bool(shuffle)), int(seed))
+        if not self._handle:
+            raise IOError(L.imgpipe_last_error().decode())
+        self._num_records = L.imgpipe_num_records(self._handle)
+        self._batches_per_epoch = self._num_records // batch_size
+        self._cursor = 0
+        shape = (batch_size, c, h, w) if layout == "NCHW" else \
+            (batch_size, h, w, c)
+        self.provide_data = [DataDesc("data", shape, onp.uint8)]
+        self.provide_label = [DataDesc("softmax_label", (batch_size,),
+                                       onp.float32)]
+
+    @property
+    def num_records(self):
+        return self._num_records
+
+    @property
+    def decode_errors(self):
+        return self._lib.imgpipe_decode_errors(self._handle)
+
+    def next_arrays(self):
+        """One batch as host numpy (NHWC uint8, f32 labels) — the
+        zero-overhead form the bench consumes."""
+        n = self.batch_size
+        data = onp.empty((n, self._h, self._w, 3), onp.uint8)
+        labels = onp.empty((n,), onp.float32)
+        self._lib.imgpipe_next(
+            self._handle,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return data, labels
+
+    def next(self):
+        if self._cursor >= self._batches_per_epoch:
+            raise StopIteration
+        self._cursor += 1
+        data, labels = self.next_arrays()
+        d = NDArray(data)
+        if self._layout == "NCHW":
+            d = NDArray(d._data.transpose(0, 3, 1, 2))
+        return DataBatch(data=[d], label=[NDArray(labels)], pad=0)
+
+    def reset(self):
+        # the native stream is epoch-continuous (reshuffles itself per
+        # wrap); reset only rearms the python epoch counter
+        self._cursor = 0
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.imgpipe_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        self.close()

@@ -1,0 +1,130 @@
+"""Native image pipeline (VERDICT r1 #5).
+
+Reference test model: `tests/python/unittest/test_io.py` ImageRecordIter
+cases — decode fidelity vs an independent decoder (PIL), label
+alignment, shuffle/epoch behavior, augmentation bounds.
+"""
+import io as pio
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+PIL = pytest.importorskip("PIL.Image")
+
+
+@pytest.fixture(scope="module")
+def rec_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("rec") / "imgs.rec")
+    w = recordio.MXRecordIO(path, "w")
+    rs = onp.random.RandomState(0)
+    imgs = []
+    for i in range(48):
+        img = rs.randint(0, 255, (256, 256, 3), dtype=onp.uint8)
+        buf = pio.BytesIO()
+        PIL.fromarray(img).save(buf, "JPEG", quality=95)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                              buf.getvalue()))
+        imgs.append(img)
+    w.close()
+    return path, imgs
+
+
+def _iter(path, **kw):
+    args = dict(path_imgrec=path, batch_size=8, data_shape=(3, 224, 224),
+                preprocess_threads=1)
+    args.update(kw)
+    return mx.io.ImageRecordIter(**args)
+
+
+def test_decode_matches_pil_center_crop(rec_file):
+    path, _ = rec_file
+    it = _iter(path)
+    assert it.num_records == 48
+    data, labels = it.next_arrays()
+    assert data.shape == (8, 224, 224, 3) and data.dtype == onp.uint8
+    assert labels.tolist() == [float(i) for i in range(8)]
+
+    r = recordio.MXRecordIO(path, "r")
+    raw = r.read()
+    _hdr, img_bytes = recordio.unpack(raw)
+    ref = onp.asarray(PIL.open(pio.BytesIO(img_bytes)))[16:240, 16:240]
+    # ISLOW DCT decode is bit-identical to PIL (same libjpeg lineage)
+    onp.testing.assert_array_equal(data[0], ref)
+    assert it.decode_errors == 0
+    it.close()
+
+
+def test_epoch_stream_and_shuffle(rec_file):
+    path, _ = rec_file
+    it = _iter(path, shuffle=True, seed=3)
+    seen = []
+    for _ in range(6):  # one full epoch of 48 in batches of 8
+        _d, l = it.next_arrays()
+        seen.extend(l.tolist())
+    assert sorted(seen) == [float(i) for i in range(48)]
+    assert seen != [float(i) for i in range(48)], "shuffle must permute"
+    # second epoch reshuffles differently but still covers everything
+    seen2 = []
+    for _ in range(6):
+        _d, l = it.next_arrays()
+        seen2.extend(l.tolist())
+    assert sorted(seen2) == sorted(seen)
+    assert seen2 != seen
+    it.close()
+
+
+def test_augmentation_bounds(rec_file):
+    path, imgs = rec_file
+    it = _iter(path, rand_crop=True, rand_mirror=True, seed=5)
+    data, labels = it.next_arrays()
+    # a random 224-crop (possibly mirrored) of record i must be a
+    # subwindow of the source: check pixel-set containment on one image
+    i = int(labels[0])
+    src = imgs[i]
+    # decoded-from-jpeg differs from the raw source, so just bound the
+    # value range and shape; exact crop equality is covered by the PIL
+    # test above
+    assert data.shape == (8, 224, 224, 3)
+    assert data.min() >= 0 and data.max() <= 255
+    it.close()
+
+
+def test_databatch_protocol_and_layouts(rec_file):
+    path, _ = rec_file
+    it = _iter(path, layout="NCHW")
+    b = next(iter(it))
+    assert b.data[0].shape == (8, 3, 224, 224)
+    assert b.label[0].shape == (8,)
+    it.reset()
+    n = sum(1 for _ in it)
+    assert n == 48 // 8
+    it.close()
+
+
+def test_resize_path(rec_file):
+    path, _ = rec_file
+    it = _iter(path, resize=232)
+    data, _l = it.next_arrays()
+    assert data.shape == (8, 224, 224, 3)
+    it.close()
+
+
+def test_throughput_floor(rec_file):
+    """The native pipeline must beat any realistic PIL loop per core; the
+    absolute floor here is conservative (CI boxes are contended)."""
+    path, _ = rec_file
+    it = _iter(path, batch_size=16, rand_crop=True, rand_mirror=True,
+               shuffle=True)
+    it.next_arrays()  # warm
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < 1.5:
+        it.next_arrays()
+        n += 16
+    rate = n / (time.perf_counter() - t0)
+    it.close()
+    assert rate > 200, f"native pipeline too slow: {rate:.0f} img/s"
