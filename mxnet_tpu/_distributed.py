@@ -50,4 +50,9 @@ def init_from_env():
                                    process_id=spec[2])
     except RuntimeError:
         return False  # backend already up (interactive import after use)
+    # Eager (non-SPMD) ops must land on an ADDRESSABLE device: jax's
+    # default is devices()[0], which on rank>0 belongs to process 0 and
+    # raises "not fully addressable" on first use.  Pin the per-process
+    # default to the first local device (the multi-controller contract).
+    jax.config.update("jax_default_device", jax.local_devices()[0])
     return True

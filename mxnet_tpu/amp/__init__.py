@@ -20,23 +20,75 @@ import numpy as onp
 from ..ndarray.ndarray import NDArray
 from .loss_scaler import LossScaler
 
-__all__ = ["init", "init_trainer", "convert_hybrid_block", "LossScaler",
+__all__ = ["init", "init_trainer", "convert_hybrid_block", "convert_model",
+           "LossScaler",
            "scale_loss", "unscale"]
 
 _initialized = False
 _target_dtype = None
+_patched = []  # (module, name, original) for _reset()
 
-# reference: python/mxnet/amp/lists/symbol_fp16.py FP16_FUNCS (the
-# matmul/conv family that is numerically safe in half precision)
-_CAST_FUNCS = [
+# ---------------------------------------------------------------------------
+# The reference's curated per-dtype lists
+# (`python/mxnet/amp/lists/symbol_fp16.py:20-200`), mapped onto this
+# package's namespaces.  Three classes matter here:
+#
+# * TARGET ops (reference FP16_FUNCS): matmul/conv family — f32 inputs are
+#   cast DOWN to the target dtype.
+# * F32 ops (reference FP32_FUNCS): numerically sensitive — half inputs
+#   are cast UP to f32 and the result stays f32 (the reference inserts
+#   amp_cast fp32 the same way).
+# * WIDEST (reference WIDEST_TYPE_CASTS): binary ops cast to the widest
+#   input type — a NO-OP here: mx.np follows numpy promotion, so
+#   bf16+f32 already computes in f32.  Nothing to patch.
+#
+# The reference's FP16_FP32_FUNCS ("safe in either") are likewise
+# untouched: they run in whatever dtype arrives.
+# ---------------------------------------------------------------------------
+
+_TARGET_FUNCS = [
+    # FP16_FUNCS: Convolution, Deconvolution, FullyConnected, RNN,
+    # _linalg_gemm(2), _npi_matmul, _npi_einsum
     ("numpy_extension", ["convolution", "deconvolution", "fully_connected",
                          "batch_dot"]),
     ("numpy", ["matmul", "dot", "einsum", "tensordot", "inner", "outer"]),
+    ("ndarray.legacy", ["FullyConnected", "Convolution", "Deconvolution",
+                        "RNN", "batch_dot", "dot"]),
+]
+
+_F32_FUNCS = [
+    # FP32_FUNCS: exp/log family, power family, reductions & statistics,
+    # norms, softmax family, losses, linalg decompositions, gamma family,
+    # ordering ops
+    ("numpy", ["exp", "expm1", "log", "log10", "log2", "log1p", "square",
+               "reciprocal", "power", "sum", "nansum", "prod", "nanprod",
+               "mean", "std", "var", "cumsum", "trace", "average",
+               "arccos", "arcsin", "cosh", "sinh", "tan", "arctanh",
+               "sqrt", "cbrt", "argsort", "sort"]),
+    ("numpy_extension", ["softmax", "log_softmax", "masked_softmax",
+                         "masked_log_softmax", "layer_norm", "group_norm",
+                         "instance_norm", "l2_normalization", "smooth_l1",
+                         "topk", "gamma", "gammaln", "erfinv",
+                         "khatri_rao"]),
+    ("ndarray.legacy", ["sum", "mean", "prod", "nansum", "nanprod", "max",
+                        "min", "norm", "moments", "softmin", "rsqrt",
+                        "rcbrt", "reciprocal", "LRN", "InstanceNorm",
+                        "LayerNorm", "GroupNorm", "L2Normalization",
+                        "SoftmaxActivation", "softmax_cross_entropy",
+                        "smooth_l1", "CTCLoss", "argsort", "topk",
+                        "softmax", "log_softmax"]),
+]
+
+# CONDITIONAL_FP32_FUNCS: Activation(act_type='softrelu')
+_CONDITIONAL_F32 = [
+    ("numpy_extension", "activation", "act_type", ("softrelu",)),
+    ("ndarray.legacy", "Activation", "act_type", ("softrelu",)),
 ]
 
 
 def init(target_dtype="bfloat16"):
-    """Patch compute ops to run in ``target_dtype`` (reference `amp.py:98`)."""
+    """Patch op namespaces per the reference lists (reference `amp.py:98`:
+    the same monkey-patch mechanism over generated wrappers)."""
     global _initialized, _target_dtype
     if _initialized:
         return
@@ -46,24 +98,69 @@ def init(target_dtype="bfloat16"):
 
     import importlib
 
-    for mod_name, names in _CAST_FUNCS:
+    def patch(mod_name, name, wrapper):
         mod = importlib.import_module(f"mxnet_tpu.{mod_name}")
+        orig = getattr(mod, name, None)
+        if orig is None or getattr(orig, "_amp_wrapped", None) is not None:
+            return
+        _patched.append((mod, name, orig))
+        setattr(mod, name, wrapper(orig))
+
+    for mod_name, names in _TARGET_FUNCS:
         for name in names:
-            orig = getattr(mod, name, None)
-            if orig is None:
-                continue
-            setattr(mod, name, _wrap_cast(orig, target))
+            patch(mod_name, name, lambda fn: _wrap_cast(fn, target))
+    for mod_name, names in _F32_FUNCS:
+        for name in names:
+            patch(mod_name, name, lambda fn: _wrap_cast(fn, onp.float32,
+                                                        up=True))
+    for mod_name, name, key, vals in _CONDITIONAL_F32:
+        patch(mod_name, name,
+              lambda fn, k=key, v=vals: _wrap_conditional(fn, k, v))
     _initialized = True
 
 
-def _wrap_cast(fn, target):
+def _reset():
+    """Undo init() — test hygiene only (the reference has no unpatch)."""
+    global _initialized, _target_dtype
+    for mod, name, orig in reversed(_patched):
+        setattr(mod, name, orig)
+    _patched.clear()
+    _initialized = False
+    _target_dtype = None
+
+
+_HALF_DTYPES = (jnp.bfloat16, onp.float16)
+
+
+def _wrap_cast(fn, target, up=False):
+    """up=False: f32 inputs -> target (FP16_FUNCS).  up=True: half inputs
+    -> f32, result stays f32 (FP32_FUNCS)."""
     def wrapped(*args, **kwargs):
-        cast_args = tuple(
-            a.astype(target) if isinstance(a, NDArray) and
-            a.dtype == onp.float32 else a
-            for a in args)
-        out = fn(*cast_args, **kwargs)
-        return out
+        def cast(a):
+            if not isinstance(a, NDArray):
+                return a
+            if up and a.dtype in _HALF_DTYPES:
+                return a.astype(onp.float32)
+            if not up and a.dtype == onp.float32:
+                return a.astype(target)
+            return a
+        return fn(*tuple(cast(a) for a in args), **kwargs)
+
+    wrapped.__name__ = getattr(fn, "__name__", "amp_op")
+    wrapped._amp_wrapped = fn
+    return wrapped
+
+
+def _wrap_conditional(fn, key, f32_values):
+    """CONDITIONAL_FP32_FUNCS: force f32 only for specific attr values
+    (reference: Activation act_type=softrelu)."""
+    f32 = _wrap_cast(fn, onp.float32, up=True)
+
+    def wrapped(*args, **kwargs):
+        if kwargs.get(key) in f32_values or \
+                any(a in f32_values for a in args if isinstance(a, str)):
+            return f32(*args, **kwargs)
+        return fn(*args, **kwargs)
 
     wrapped.__name__ = getattr(fn, "__name__", "amp_op")
     wrapped._amp_wrapped = fn
@@ -111,3 +208,30 @@ def convert_hybrid_block(block, target_dtype="bfloat16", **_kwargs):
     target = "bfloat16" if str(target_dtype) in ("bfloat16", "bf16") else "float16"
     block.cast(target)
     return block
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  target_dtype_ops=None, fp32_ops=None,
+                  conditional_fp32_ops=None, excluded_sym_names=None,
+                  cast_optional_params=False):
+    """Module-style conversion (reference `amp.py:427` convert_model):
+    returns ``(sym, arg_params, aux_params)`` with f32 params cast to the
+    target dtype.  Graph rewriting is unnecessary here — ``init()``'s
+    namespace patches apply the per-op dtype policy when the symbol
+    evaluates (FP32-list ops up-cast their inputs again), so parameter
+    dtype is the only state to convert.  ``excluded_sym_names`` keeps the
+    listed parameters f32."""
+    target = "bfloat16" if str(target_dtype) in ("bfloat16", "bf16") \
+        else "float16"
+    excluded = set(excluded_sym_names or ())
+
+    def conv(params):
+        out = {}
+        for k, v in params.items():
+            if k not in excluded and v.dtype == onp.float32:
+                out[k] = v.astype(target)
+            else:
+                out[k] = v
+        return out
+
+    return sym, conv(arg_params), conv(aux_params or {})

@@ -131,6 +131,11 @@ def _visible_platforms():
 
 def _devices_for(platform):
     try:
+        if jax.process_count() > 1:
+            # multi-controller SPMD: a Context names a device of THIS
+            # process (the reference's per-worker ctx semantics); global
+            # devices are only ever addressed through shardings
+            return jax.local_devices(backend=platform)
         return jax.devices(platform)
     except RuntimeError:
         return []

@@ -102,8 +102,9 @@ class FusedTrainStep:
         collective."""
         from jax.sharding import NamedSharding, PartitionSpec
 
-        from ..parallel.mesh import shard_parameters
+        from ..parallel.mesh import global_put, shard_parameters
 
+        self._global_put = global_put
         mesh, trainer = self._mesh, self._trainer
         specs = shard_parameters(params, mesh, self._rules)
         names = sorted(params)
@@ -124,7 +125,7 @@ class FusedTrainStep:
             p_shape = self._plist[k].shape
             for s_nd in _as_tuple(trainer._states[i]):
                 sh = self._shardings[k] if s_nd.shape == p_shape else rep
-                s_nd._rebind(jax.device_put(s_nd._data, sh))
+                s_nd._rebind(global_put(s_nd._data, sh))
 
     def _build(self, treedef_id):
         block = self._block
@@ -136,6 +137,9 @@ class FusedTrainStep:
 
         def fused(train_ws, const_pd, states, key, flat_inputs, lrs, wds,
                   ts, rescale, clip, treedef_id):
+            if key.dtype == jnp.uint32:  # multi-process: raw key data
+                key = jax.random.wrap_key_data(key)
+
             def loss_fn(tws):
                 full = list(const_pd)
                 for j, k in enumerate(train_idx):
@@ -194,9 +198,9 @@ class FusedTrainStep:
                     return d
                 if d.shape[0] >= self._dp_size and \
                         d.shape[0] % self._dp_size == 0:
-                    return jax.device_put(
+                    return self._global_put(
                         d, self._data_shardings[min(d.ndim, 8) - 1])
-                return jax.device_put(d, self._rep)
+                return self._global_put(d, self._rep)
             flat = [place(d) for d in flat]
         treedef_id = _intern_treedef(treedef)
         if self._jit is None:
@@ -217,13 +221,24 @@ class FusedTrainStep:
             lrs.append(optimizer._get_lr(i))
             wds.append(optimizer._get_wd(i))
             ts.append(optimizer._index_update_count[i])
-        lrs = jnp.asarray(onp.asarray(lrs, onp.float32))
-        wds = jnp.asarray(onp.asarray(wds, onp.float32))
-        ts = jnp.asarray(onp.asarray(ts, onp.float32))
+        lrs = onp.asarray(lrs, onp.float32)
+        wds = onp.asarray(wds, onp.float32)
+        ts = onp.asarray(ts, onp.float32)
+        key = _rng.new_key()
+        rescale = onp.float32(optimizer.rescale_grad)
+        if self._mesh is not None and not self._rep.is_fully_addressable:
+            # multi-process mesh: every per-step input must be a global
+            # array (identical on all processes — deterministic streams)
+            gp = self._global_put
+            lrs, wds, ts = (gp(v, self._rep) for v in (lrs, wds, ts))
+            rescale = gp(rescale, self._rep)
+            key = gp(onp.asarray(jax.random.key_data(key)), self._rep)
+        else:
+            lrs, wds, ts = (jnp.asarray(v) for v in (lrs, wds, ts))
 
         outs, auxs, new_ws, new_states = self._jit(
-            train_ws, const_pd, states, _rng.new_key(), flat, lrs, wds, ts,
-            jnp.float32(optimizer.rescale_grad), optimizer.clip_gradient,
+            train_ws, const_pd, states, key, flat, lrs, wds, ts,
+            rescale, optimizer.clip_gradient,
             treedef_id)
 
         for j, k in enumerate(self._train_idx):

@@ -7,7 +7,7 @@ collectives (psum/all-gather/reduce-scatter) and schedules them over ICI.
 """
 from .mesh import (
     make_mesh, current_mesh, mesh_scope, data_sharding, replicated_sharding,
-    match_partition_rules, shard_parameters, constrain,
+    match_partition_rules, shard_parameters, constrain, global_put,
     init_distributed,
 )
 from .ring_attention import ring_attention
@@ -17,6 +17,7 @@ from .moe import moe_ffn, init_moe_params, moe_partition_specs, shard_moe_params
 __all__ = [
     "make_mesh", "current_mesh", "mesh_scope", "data_sharding",
     "replicated_sharding", "match_partition_rules", "shard_parameters",
+    "global_put",
     "constrain", "ring_attention", "init_distributed",
     "pipeline_apply", "moe_ffn", "init_moe_params", "moe_partition_specs",
     "shard_moe_params",

@@ -105,20 +105,35 @@ def match_partition_rules(rules, names_to_shapes):
     return out
 
 
+def global_put(value, sharding):
+    """Place host/single-device data under a (possibly multi-process)
+    sharding.  For a fully-addressable mesh this is ``jax.device_put``;
+    across processes each process supplies its addressable shards from
+    the (identical-everywhere) full value — the SPMD data contract of
+    `jax.make_array_from_callback`."""
+    if sharding.is_fully_addressable:
+        return jax.device_put(value, sharding)
+    host = onp.asarray(value)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx])
+
+
 def shard_parameters(params, mesh, rules=None):
     """Place Gluon Parameters onto the mesh.
 
     ``params``: dict name -> Parameter.  Each parameter's array is re-placed
     with a NamedSharding; replicated unless a rule matches.  This is the
     TPU analogue of `kvstore.broadcast` of initial params
-    (`python/mxnet/gluon/trainer.py:164-174`).
+    (`python/mxnet/gluon/trainer.py:164-174`).  Works across processes
+    (multi-host mesh): every process holds identical initial values (same
+    seed), so `global_put` hands each its local shards.
     """
     specs = match_partition_rules(
         rules or [], {k: p.shape for k, p in params.items()})
     for name, p in params.items():
         sharding = NamedSharding(mesh, specs[name])
         arr = p.data()
-        arr._rebind(jax.device_put(arr._data, sharding))
+        arr._rebind(global_put(arr._data, sharding))
     return specs
 
 

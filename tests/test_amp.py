@@ -8,6 +8,8 @@ import subprocess
 import sys
 import textwrap
 
+import jax.numpy as jnp
+
 import mxnet_tpu as mx
 from mxnet_tpu import amp
 
@@ -68,3 +70,68 @@ def test_scale_loss_context():
     # the scaled loss is loss * current scale
     assert float(scaled.asnumpy()) == \
         __import__("pytest").approx(float(out.asnumpy()) * 8.0)
+
+
+def test_amp_reference_list_semantics():
+    """VERDICT r1 #8: conv/FC go bf16, norms/softmax/reductions stay f32,
+    conditional softrelu forces f32 (reference symbol_fp16.py lists)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+
+    amp._reset()
+    amp.init(target_dtype="bfloat16")
+    try:
+        x = mx.np.array(onp.random.rand(4, 8).astype("f"))
+        w = mx.np.array(onp.random.rand(6, 8).astype("f"))
+        b = mx.np.array(onp.zeros(6, "f"))
+
+        # TARGET list: f32 inputs cast down -> bf16 out
+        out = mx.npx.fully_connected(x, w, b, num_hidden=6)
+        assert out.dtype == jnp.bfloat16
+
+        # F32 list: bf16 inputs cast UP -> f32 out
+        h = x.astype("bfloat16")
+        assert mx.npx.softmax(h).dtype == onp.float32
+        assert mx.npx.layer_norm(
+            h, mx.np.ones(8).astype("bfloat16"),
+            mx.np.zeros(8).astype("bfloat16")).dtype == onp.float32
+        assert mx.np.sum(h).dtype == onp.float32
+        assert mx.np.exp(h).dtype == onp.float32
+        assert mx.nd.norm(h).dtype == onp.float32
+        assert mx.nd.mean(h).dtype == onp.float32
+
+        # conditional: softrelu f32, relu stays bf16
+        assert mx.npx.activation(h, act_type="softrelu").dtype == onp.float32
+        assert mx.npx.activation(h, act_type="relu").dtype == jnp.bfloat16
+
+        # widest-type is numpy promotion (documented no-op)
+        assert (h + x).dtype == onp.float32
+
+        # matmul family casts down
+        assert mx.np.matmul(x, x.T).dtype == jnp.bfloat16
+    finally:
+        amp._reset()
+
+    # after reset, patches are gone
+    out = mx.npx.fully_connected(x, w, b, num_hidden=6)
+    assert out.dtype == onp.float32
+
+
+def test_amp_convert_model_params():
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+
+    sym = mx.sym.var("x")
+    args = {"w": mx.np.array(onp.ones((2, 2), "f")),
+            "idx": mx.np.array(onp.array([1, 0]), dtype="int32")}
+    aux = {"m": mx.np.array(onp.zeros((2,), "f"))}
+    s2, a2, x2 = amp.convert_model(sym, args, aux,
+                                   target_dtype="bfloat16",
+                                   excluded_sym_names=["w_excluded"])
+    assert a2["w"].dtype == jnp.bfloat16
+    assert str(a2["idx"].dtype) == "int32"
+    assert x2["m"].dtype == jnp.bfloat16

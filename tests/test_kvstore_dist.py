@@ -220,3 +220,19 @@ def test_tpu_ici_reduce_copies_emits_allreduce():
     stacked = jax.device_put(onp.zeros((n, 3, 2), onp.float32), sharding)
     hlo = allreduce.lower(stacked).compile().as_text()
     assert "all-reduce" in hlo, hlo[:500]
+
+
+def test_four_process_trainer_parity(tmp_path):
+    """VERDICT r1 #9: full FusedTrainStep across 4 local CPU processes
+    (8 global devices) with value-deterministic asserts plus big-array and
+    compression keys (reference tests/nightly/dist_sync_kvstore.py)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "4", "--launcher", "local", sys.executable,
+         os.path.join(REPO, "tests", "dist_scripts", "train_worker.py")],
+        capture_output=True, text=True, timeout=600,
+        env={k: v for k, v in os.environ.items()
+             if k != "PALLAS_AXON_POOL_IPS"})
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    for rank in range(4):
+        assert f"rank {rank} ALL OK" in r.stdout, r.stdout[-2000:]
