@@ -1,0 +1,133 @@
+"""Pretrained weight store.
+
+Reference: `python/mxnet/gluon/model_zoo/model_store.py:29-108` — a
+sha1-verified cache of ``{name}-{short_hash}.params`` files.  The sha1
+table is the reference's own (same checkpoints, same hashes), so weight
+files obtained from the reference ecosystem verify and load here (the
+0x112 loader in `utils/legacy_format.py` reads their binary format).
+
+This environment has no network egress, so ``get_model_file`` is
+local-only: it looks in ``root`` (default ``$MXNET_HOME/models`` or
+``~/.mxnet/models``) and any directory on ``MXNET_TPU_MODEL_REPO``
+(colon-separated), verifying sha1 before returning — the same contract
+as the reference's cache-hit path.  A miss raises with the canonical
+download URL instead of fetching it.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+
+__all__ = ["get_model_file", "purge", "short_hash"]
+
+# reference model_store.py:31-66 (checksum, name) pairs — data, not code
+_model_sha1 = {name: checksum for checksum, name in [
+    ("44335d1f0046b328243b32a26a4fbd62d9057b45", "alexnet"),
+    ("f27dbf2dbd5ce9a80b102d89c7483342cd33cb31", "densenet121"),
+    ("b6c8a95717e3e761bd88d145f4d0a214aaa515dc", "densenet161"),
+    ("2603f878403c6aa5a71a124c4a3307143d6820e9", "densenet169"),
+    ("1cdbc116bc3a1b65832b18cf53e1cb8e7da017eb", "densenet201"),
+    ("ed47ec45a937b656fcc94dabde85495bbef5ba1f", "inceptionv3"),
+    ("9f83e440996887baf91a6aff1cccc1c903a64274", "mobilenet0.25"),
+    ("8e9d539cc66aa5efa71c4b6af983b936ab8701c3", "mobilenet0.5"),
+    ("529b2c7f4934e6cb851155b22c96c9ab0a7c4dc2", "mobilenet0.75"),
+    ("6b8c5106c730e8750bcd82ceb75220a3351157cd", "mobilenet1.0"),
+    ("36da4ff1867abccd32b29592d79fc753bca5a215", "mobilenetv2_1.0"),
+    ("e2be7b72a79fe4a750d1dd415afedf01c3ea818d", "mobilenetv2_0.75"),
+    ("aabd26cd335379fcb72ae6c8fac45a70eab11785", "mobilenetv2_0.5"),
+    ("ae8f9392789b04822cbb1d98c27283fc5f8aa0a7", "mobilenetv2_0.25"),
+    ("a0666292f0a30ff61f857b0b66efc0228eb6a54b", "resnet18_v1"),
+    ("48216ba99a8b1005d75c0f3a0c422301a0473233", "resnet34_v1"),
+    ("0aee57f96768c0a2d5b23a6ec91eb08dfb0a45ce", "resnet50_v1"),
+    ("d988c13d6159779e907140a638c56f229634cb02", "resnet101_v1"),
+    ("671c637a14387ab9e2654eafd0d493d86b1c8579", "resnet152_v1"),
+    ("a81db45fd7b7a2d12ab97cd88ef0a5ac48b8f657", "resnet18_v2"),
+    ("9d6b80bbc35169de6b6edecffdd6047c56fdd322", "resnet34_v2"),
+    ("ecdde35339c1aadbec4f547857078e734a76fb49", "resnet50_v2"),
+    ("18e93e4f48947e002547f50eabbcc9c83e516aa6", "resnet101_v2"),
+    ("f2695542de38cf7e71ed58f02893d82bb409415e", "resnet152_v2"),
+    ("264ba4970a0cc87a4f15c96e25246a1307caf523", "squeezenet1.0"),
+    ("33ba0f93753c83d86e1eb397f38a667eaf2e9376", "squeezenet1.1"),
+    ("dd221b160977f36a53f464cb54648d227c707a05", "vgg11"),
+    ("ee79a8098a91fbe05b7a973fed2017a6117723a8", "vgg11_bn"),
+    ("6bc5de58a05a5e2e7f493e2d75a580d83efde38c", "vgg13"),
+    ("7d97a06c3c7a1aecc88b6e7385c2b373a249e95e", "vgg13_bn"),
+    ("e660d4569ccb679ec68f1fd3cce07a387252a90a", "vgg16"),
+    ("7f01cf050d357127a73826045c245041b0df7363", "vgg16_bn"),
+    ("ad2f660d101905472b83590b59708b71ea22b2e5", "vgg19"),
+    ("f360b758e856f1074a85abd5fd873ed1d98297c3", "vgg19_bn"),
+]}
+
+apache_repo_url = "https://apache-mxnet.s3-accelerate.dualstack.amazonaws.com/"
+_url_format = "{repo_url}gluon/models/{file_name}.zip"
+
+
+def _default_root():
+    return os.path.join(os.environ.get(
+        "MXNET_HOME", os.path.join(os.path.expanduser("~"), ".mxnet")),
+        "models")
+
+
+def short_hash(name):
+    if name not in _model_sha1:
+        raise ValueError(
+            f"Pretrained model for {name} is not available "
+            f"(known: {sorted(_model_sha1)})")
+    return _model_sha1[name][:8]
+
+
+def check_sha1(filename, sha1_hash):
+    """Reference `python/mxnet/gluon/utils.py` check_sha1."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def get_model_file(name, root=None):
+    """Return the verified local path of ``name``'s weight file.
+
+    Looks in ``root``, then each directory on ``MXNET_TPU_MODEL_REPO``
+    (copying a verified hit into ``root``).  Never downloads (no egress);
+    a miss raises with the canonical URL so the user can stage the file.
+    """
+    root = os.path.expanduser(root or _default_root())
+    file_name = f"{name}-{short_hash(name)}"
+    sha1 = _model_sha1[name]
+    path = os.path.join(root, file_name + ".params")
+    if os.path.exists(path):
+        if check_sha1(path, sha1):
+            return path
+        raise IOError(
+            f"{path} exists but its sha1 does not match {sha1}; delete or "
+            "re-stage it")
+    for repo in os.environ.get("MXNET_TPU_MODEL_REPO", "").split(":"):
+        if not repo:
+            continue
+        cand = os.path.join(os.path.expanduser(repo),
+                            file_name + ".params")
+        if os.path.exists(cand) and check_sha1(cand, sha1):
+            os.makedirs(root, exist_ok=True)
+            shutil.copy2(cand, path)
+            return path
+    url = _url_format.format(repo_url=apache_repo_url, file_name=file_name)
+    raise FileNotFoundError(
+        f"pretrained weights for {name!r} not found locally; this "
+        f"environment has no network egress — stage {file_name}.params "
+        f"into {root} (canonical source: {url}) or point "
+        "MXNET_TPU_MODEL_REPO at a directory containing it")
+
+
+def purge(root=None):
+    """Delete cached model files (reference `model_store.py purge`)."""
+    root = os.path.expanduser(root or _default_root())
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

@@ -34,13 +34,26 @@ _models["mobilenet0.5"] = globals()["mobilenet0_5"]
 _models["mobilenet0.25"] = globals()["mobilenet0_25"]
 
 
-def get_model(name, **kwargs):
-    """Create a model by name (reference vision/__init__.py get_model)."""
+def get_model(name, pretrained=False, ctx=None, root=None, **kwargs):
+    """Create a model by name (reference vision/__init__.py get_model).
+
+    ``pretrained=True`` loads sha1-verified reference weights through
+    `model_store.get_model_file` (local-only in this environment; the
+    0x112 loader reads the reference's binary .params format).  Loaded
+    names strip the reference's net-name prefix (``resnetv10_conv0_...``)
+    when present so both reference-saved and locally-saved files work.
+    """
     name = name.lower()
     if name not in _models:
         raise ValueError(
             f"Model {name} is not supported. Available: {sorted(_models)}")
-    return _models[name](**kwargs)
+    net = _models[name](**kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        path = get_model_file(name, root=root)
+        net.load_parameters(path, ctx=ctx, cast_dtype=True,
+                            allow_missing=False, ignore_extra=False)
+    return net
 
 
 __all__ = [n for n in _models if not ("." in n)] + ["get_model"]

@@ -1,0 +1,144 @@
+"""The reference's binary NDArray file format (magic ``0x112``).
+
+Reference: `src/ndarray/ndarray.cc:1962` (``kMXAPINDArrayListMagic``,
+list Save/Load), `:1729` (per-array ``NDArray::Save``: V1/V2/V3 magics,
+TShape/Context serialization), so real MXNet ``.params`` checkpoints and
+``mx.nd.save`` files load directly into this framework (and files saved
+here load in the reference).
+
+Layout (little-endian):
+  u64 0x112, u64 reserved
+  u64 n_arrays, then per array:
+    u32 magic: 0xF993fac8 (V1) / 0xF993fac9 (V2) / 0xF993faca (V3),
+        anything else = legacy ndim
+    [V2/V3] i32 stype (dense = 0 here)
+    TShape: u32 ndim + i64*ndim  (legacy pre-V1: u32*ndim with magic=ndim)
+    Context: i32 dev_type, i32 dev_id
+    i32 type_flag (mshadow dtype code)
+    raw contiguous data
+  u64 n_names, then per name: u64 len + bytes
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as onp
+
+MAGIC = 0x112
+_V1 = 0xF993FAC8
+_V2 = 0xF993FAC9
+_V3 = 0xF993FACA
+
+# mshadow type codes (`3rdparty/mshadow/mshadow/base.h`)
+_TYPE_FLAGS = {
+    0: onp.float32, 1: onp.float64, 2: onp.float16, 3: onp.uint8,
+    4: onp.int32, 5: onp.int8, 6: onp.int64, 7: onp.bool_,
+    8: onp.int16, 9: onp.uint16, 10: onp.uint32, 11: onp.uint64,
+}
+_FLAG_OF = {onp.dtype(v): k for k, v in _TYPE_FLAGS.items()}
+_BF16_FLAG = 12
+
+
+class _Reader:
+    def __init__(self, data):
+        self.b = data
+        self.o = 0
+
+    def read(self, fmt):
+        vals = struct.unpack_from("<" + fmt, self.b, self.o)
+        self.o += struct.calcsize("<" + fmt)
+        return vals if len(vals) > 1 else vals[0]
+
+    def read_tuple(self, fmt):
+        vals = struct.unpack_from("<" + fmt, self.b, self.o)
+        self.o += struct.calcsize("<" + fmt)
+        return vals
+
+    def raw(self, n):
+        out = self.b[self.o:self.o + n]
+        if len(out) != n:
+            raise ValueError("truncated NDArray file")
+        self.o += n
+        return out
+
+
+def _read_shape(r, ndim=None):
+    if ndim is None:
+        ndim = r.read("I")
+    return r.read_tuple("q" * ndim) if ndim else ()
+
+
+def _read_array(r):
+    magic = r.read("I")
+    if magic in (_V2, _V3):
+        stype = r.read("i")
+        if stype != 0:
+            raise NotImplementedError(
+                "sparse storage in 0x112 files is not supported on TPU "
+                "(convert with cast_storage first)")
+        shape = _read_shape(r)
+    elif magic == _V1:
+        shape = _read_shape(r)
+    else:
+        # pre-V1: magic IS ndim, dims are u32
+        ndim = magic
+        shape = r.read_tuple("I" * ndim) if ndim else ()
+    if len(shape) and not all(s >= 0 for s in shape):
+        raise ValueError("negative dimension in saved shape")
+    if magic in (_V2, _V3, _V1) and len(shape) == 0:
+        return onp.zeros((), onp.float32)  # is_none sentinel
+    _dev_type, _dev_id = r.read("ii")
+    type_flag = r.read("i")
+    if type_flag == _BF16_FLAG:
+        import jax.numpy as jnp
+        n = int(onp.prod(shape, dtype=onp.int64)) if shape else 1
+        raw = onp.frombuffer(r.raw(2 * n), dtype=onp.uint16)
+        return raw.view(jnp.bfloat16).reshape(shape)
+    dt = onp.dtype(_TYPE_FLAGS[type_flag])
+    n = int(onp.prod(shape, dtype=onp.int64)) if shape else 1
+    return onp.frombuffer(r.raw(dt.itemsize * n), dtype=dt).reshape(shape)
+
+
+def load_legacy(data):
+    """Parse a 0x112 byte buffer -> (list_of_numpy, list_of_names)."""
+    r = _Reader(data)
+    header, _reserved = r.read("QQ")
+    if header != MAGIC:
+        raise ValueError(f"not an NDArray file (magic {header:#x})")
+    n = r.read("Q")
+    arrays = [_read_array(r) for _ in range(n)]
+    n_names = r.read("Q")
+    names = []
+    for _ in range(n_names):
+        ln = r.read("Q")
+        names.append(r.raw(ln).decode())
+    if names and len(names) != len(arrays):
+        raise ValueError("invalid NDArray file: key/array count mismatch")
+    return arrays, names
+
+
+def save_legacy(arrays, names=()):
+    """Serialize numpy arrays to 0x112 bytes (V2 per-array records, dense,
+    cpu context — the format the reference's `mx.nd.save` emits)."""
+    out = [struct.pack("<QQ", MAGIC, 0), struct.pack("<Q", len(arrays))]
+    for a in arrays:
+        a = onp.ascontiguousarray(a)
+        if str(a.dtype) == "bfloat16":
+            flag = _BF16_FLAG
+            raw = a.view(onp.uint16).tobytes()
+        else:
+            flag = _FLAG_OF[onp.dtype(a.dtype)]
+            raw = a.tobytes()
+        out.append(struct.pack("<I", _V2))
+        out.append(struct.pack("<i", 0))                  # dense stype
+        out.append(struct.pack("<I", a.ndim))
+        out.append(struct.pack("<" + "q" * a.ndim, *a.shape))
+        out.append(struct.pack("<ii", 1, 0))              # cpu:0
+        out.append(struct.pack("<i", flag))
+        out.append(raw)
+    out.append(struct.pack("<Q", len(names)))
+    for name in names:
+        b = name.encode()
+        out.append(struct.pack("<Q", len(b)))
+        out.append(b)
+    return b"".join(out)

@@ -50,6 +50,18 @@ def save_ndarrays(fname, data):
 def load_ndarrays(fname, ctx=None):
     from ..ndarray.ndarray import NDArray
 
+    # the reference's binary format (magic 0x112) loads transparently, so
+    # real MXNet checkpoints / mx.nd.save files import directly
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    if len(head) == 8 and int.from_bytes(head, "little") == 0x112:
+        from .legacy_format import load_legacy
+        with open(fname, "rb") as f:
+            arrays, names = load_legacy(f.read())
+        if names:
+            return {n: NDArray(a, ctx=ctx) for n, a in zip(names, arrays)}
+        return [NDArray(a, ctx=ctx) for a in arrays]
+
     with onp.load(fname, allow_pickle=True) as z:
         names = [n for n in z.files
                  if n not in ("__mxnet_tpu_magic__", "__keys__")]
