@@ -28,9 +28,15 @@ def to_dlpack_for_read(data):
 
 
 def to_dlpack_for_write(data):
-    # XLA buffers are immutable; writers must copy, same net semantics as
-    # the reference's write-dependency version
-    return _export(data)
+    """The reference hands out a buffer the consumer may mutate in place
+    (engine write-var).  XLA buffers are immutable, so aliasing the
+    device buffer would either corrupt what XLA assumes frozen or
+    silently drop the writes — export a HOST COPY instead; call
+    ``from_dlpack`` (or ``NDArray(...)``) on the written result to get
+    the data back onto the device."""
+    import numpy as onp
+
+    return onp.array(data.asnumpy())  # owned, writable
 
 
 def from_dlpack(ext):

@@ -165,11 +165,6 @@ class Block:
         from ..utils.serialization import load_ndarrays
         loaded = load_ndarrays(filename, ctx=ctx)
         params = self._collect_params_with_prefix()
-        if not allow_missing:
-            for name in params:
-                if name not in loaded and params[name]._data is None and \
-                        params[name]._deferred_init is None:
-                    pass  # uninitialized + missing: will fail at use
         for name, param in params.items():
             if name not in loaded:
                 if not allow_missing:
@@ -463,13 +458,20 @@ def _scoped_forward(block, plist, param_datas, key, flat_inputs, treedef,
     return out_datas, aux
 
 
-# treedefs are hashable but not weak-refable; intern them for static_argnums
-_TREEDEFS = {}
+# treedefs are hashable but not weak-refable; intern them for
+# static_argnums.  Keyed by the treedef ITSELF (equality), not hash(td):
+# a hash collision between two structures must map to two ids, or a
+# compiled program would silently reinterpret its inputs.
+_TREEDEFS = {}           # id -> treedef
+_TREEDEF_IDS = {}        # treedef -> id
 
 
 def _intern_treedef(td):
-    key = hash(td)
-    _TREEDEFS[key] = td
+    key = _TREEDEF_IDS.get(td)
+    if key is None:
+        key = len(_TREEDEFS)
+        _TREEDEF_IDS[td] = key
+        _TREEDEFS[key] = td
     return key
 
 

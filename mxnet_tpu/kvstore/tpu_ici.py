@@ -78,6 +78,84 @@ class TPUICIStore(KVStoreBase):
         self._size = jax.process_count()
         self._compression = None
         self._residuals = {}
+        self._hb_stop = None
+        if self._size > 1:
+            self._start_heartbeat()
+
+    # -- failure detection --------------------------------------------------
+    # Reference `KVStore::get_dead_nodes` rides ps-lite's scheduler
+    # heartbeats (`kvstore_dist.h:120`).  XLA/ICI failures surface as
+    # program errors, but DCN-level *process* loss (a host dying between
+    # steps) needs liveness: each process stamps a wall-clock heartbeat
+    # into the jax.distributed coordination KV store; a rank whose stamp
+    # is older than the timeout is reported dead.
+
+    def _kv_client(self):
+        try:
+            from jax._src import distributed
+            return distributed.global_state.client
+        except Exception:
+            return None
+
+    def _start_heartbeat(self):
+        import os
+        import threading
+        import time
+
+        client = self._kv_client()
+        if client is None:
+            return
+        interval = float(os.environ.get("MXNET_HEARTBEAT_INTERVAL", "5"))
+        self._hb_stop = threading.Event()
+        key = f"mxtpu/heartbeat/{self._rank}"
+
+        def beat():
+            while True:
+                try:
+                    try:
+                        client.key_value_delete(key)
+                    except Exception:
+                        pass
+                    client.key_value_set(key, repr(time.time()))
+                except Exception:
+                    pass  # coordinator going down: nothing to report to
+                if self._hb_stop.wait(interval):
+                    return
+
+        t = threading.Thread(target=beat, daemon=True,
+                             name="mxtpu-heartbeat")
+        t.start()
+
+    def get_dead_nodes(self, timeout=60):
+        """Ranks whose heartbeat is older than ``timeout`` seconds
+        (reference `kvstore.py get_dead_nodes`; empty when single
+        process)."""
+        import time
+
+        client = self._kv_client()
+        if client is None or self._size <= 1:
+            return []
+        now = time.time()
+        dead = []
+        for r in range(self._size):
+            try:
+                stamp = client.key_value_try_get(f"mxtpu/heartbeat/{r}")
+            except Exception:
+                stamp = None
+            if stamp is None:
+                # never heartbeat: dead only if it had time to start
+                dead.append(r)
+                continue
+            try:
+                if now - float(stamp) > timeout:
+                    dead.append(r)
+            except ValueError:
+                dead.append(r)
+        return dead
+
+    def close(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
 
     # -- interface ---------------------------------------------------------
     def broadcast(self, key, value, out, priority=0):
@@ -107,14 +185,6 @@ class TPUICIStore(KVStoreBase):
             "threshold": float(compression_params.get("threshold", 0.5)),
         }
         self._residuals = {}
-
-    def get_dead_nodes(self, timeout=60):
-        """Reference `KVStore::get_dead_nodes` (ps-lite liveness,
-        `kvstore_dist.h:120`).  The XLA runtime surfaces chip/host failure
-        as a program error rather than a liveness list, so a live process
-        always reports an empty list."""
-        del timeout
-        return []
 
     def pushpull(self, key, value, out=None, priority=0):
         vals = value if isinstance(value, (list, tuple)) else [value]

@@ -126,7 +126,48 @@ def main():
     check_train_step_parity(rank)
     check_big_array(rank, nproc)
     check_compression(rank)
+    check_failure_detection(rank)
     print(f"rank {rank} ALL OK", flush=True)
+
+
+
+
+def check_failure_detection(rank):
+    """Heartbeat liveness: all 4 ranks alive -> no dead nodes; a stale
+    stamp -> that rank reported dead (reference get_dead_nodes)."""
+    import time
+
+    from mxnet_tpu import kv
+
+    store = kv.create("tpu_ici")
+    deadline = time.time() + 30
+    dead = store.get_dead_nodes(timeout=60)
+    while time.time() < deadline and dead:
+        time.sleep(0.5)
+        dead = store.get_dead_nodes(timeout=60)
+    assert dead == [], dead
+    # barrier (all ranks confirmed liveness) before rank 0 forges a stale
+    # stamp -- otherwise another rank's alive-check could observe it
+    import jax as _jax
+    import numpy as _onp
+    from jax.sharding import Mesh as _M, NamedSharding as _NS, \
+        PartitionSpec as _P
+    mesh = _M(_onp.array(_jax.devices()), ("dp",))
+    one = _jax.make_array_from_process_local_data(
+        _NS(mesh, _P("dp")), _onp.ones((2,), _onp.float32))
+    _jax.jit(lambda a: a.sum(), out_shardings=_NS(mesh, _P()))(
+        one).block_until_ready()
+    # a stamp older than the timeout reads as dead (rank 0 forges one)
+    if rank == 0:
+        c = store._kv_client()
+        try:
+            c.key_value_delete("mxtpu/heartbeat/0")
+        except Exception:
+            pass
+        c.key_value_set("mxtpu/heartbeat/0", repr(time.time() - 10_000))
+        assert 0 in store.get_dead_nodes(timeout=60)
+    store.close()
+    print(f"rank {rank} LIVENESS OK", flush=True)
 
 
 if __name__ == "__main__":
