@@ -72,7 +72,11 @@ from . import libinfo
 from . import log
 from . import name
 from . import operator
+from . import env
 from .libinfo import __version__
+
+# honor the documented MXNET_* environment variables (env.py table)
+env.apply()
 
 # legacy custom-op entry: mx.nd.Custom(data..., op_type="name")
 ndarray.Custom = operator.invoke_custom  # (mx.nd is the same module)

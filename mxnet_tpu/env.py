@@ -1,0 +1,95 @@
+"""Environment-variable configuration surface.
+
+Reference: the 102 documented ``MXNET_*`` variables
+(`docs/static_site/src/pages/api/faq/env_var.md`).  On the TPU rebuild a
+large fraction is owned by XLA/PjRt (memory pools, engine threads, cudnn
+autotune); the table below documents every variable this framework
+actually honors, what it does here, and which reference knobs it
+subsumes.  ``mxnet_tpu.env.describe()`` prints the live table.
+
+Handled at import (see ``apply()`` call in ``mxnet_tpu/__init__``):
+
+=========================== =================================================
+variable                     behavior
+=========================== =================================================
+MXNET_SEED                   seeds the global RNG streams at import
+MXNET_ENGINE_TYPE            ``NaiveEngine`` = synchronous dispatch: every
+                             op blocks until its result is ready, so async
+                             errors surface at the faulting op (the
+                             reference's debug engine); default
+                             ``ThreadedEngine`` = PjRt async streams
+MXNET_EXEC_BULK_EXEC_TRAIN   advisory bulking budget -> engine.set_bulk_size
+MXNET_CPU_WORKER_NTHREADS    default worker count for the native image
+                             pipeline and thread DataLoaders
+MXNET_PROFILER_AUTOSTART     start the profiler at import (chrome trace)
+MXNET_ENFORCE_DETERMINISM    forbid nondeterministic op paths: sets XLA's
+                             deterministic-ops flag before backend init
+MXNET_HOME                   cache root (model_store, datasets)
+MXNET_HEARTBEAT_INTERVAL     kvstore liveness stamp period (seconds)
+MXNET_GPU_MEM_POOL_RESERVE   accepted, no-op (PjRt owns device memory);
+                             use XLA_PYTHON_CLIENT_MEM_FRACTION
+MXNET_STORAGE_FALLBACK_LOG_VERBOSE  accepted, no-op (no storage fallback:
+                             sparse compute is explicit here)
+=========================== =================================================
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["apply", "describe", "is_naive_engine", "cpu_worker_nthreads"]
+
+_naive_engine = False
+
+
+def is_naive_engine():
+    return _naive_engine
+
+
+def cpu_worker_nthreads(default=None):
+    v = os.environ.get("MXNET_CPU_WORKER_NTHREADS")
+    if v is None:
+        return default if default is not None else (os.cpu_count() or 1)
+    return max(1, int(v))
+
+
+def apply():
+    """Read the environment once at package import."""
+    global _naive_engine
+
+    if os.environ.get("MXNET_ENFORCE_DETERMINISM", "0") not in ("0", ""):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_gpu_deterministic_ops" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_gpu_deterministic_ops=true").strip()
+
+    _naive_engine = os.environ.get("MXNET_ENGINE_TYPE") == "NaiveEngine"
+
+    bulk = os.environ.get("MXNET_EXEC_BULK_EXEC_TRAIN")
+    if bulk is not None:
+        from . import engine
+        try:
+            engine.set_bulk_size(int(bulk))
+        except ValueError:
+            pass
+
+    seed = os.environ.get("MXNET_SEED")
+    if seed is not None:
+        from . import random as _rng
+        try:
+            _rng.seed(int(seed))
+        except ValueError:
+            pass
+
+    if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") not in ("0", ""):
+        from . import profiler
+        profiler.set_state("run")
+
+
+def describe():
+    """The live table: (name, current value, honored?)."""
+    names = ["MXNET_SEED", "MXNET_ENGINE_TYPE", "MXNET_EXEC_BULK_EXEC_TRAIN",
+             "MXNET_CPU_WORKER_NTHREADS", "MXNET_PROFILER_AUTOSTART",
+             "MXNET_ENFORCE_DETERMINISM", "MXNET_HOME",
+             "MXNET_HEARTBEAT_INTERVAL", "MXNET_GPU_MEM_POOL_RESERVE",
+             "MXNET_STORAGE_FALLBACK_LOG_VERBOSE"]
+    return [(n, os.environ.get(n), n in __doc__) for n in names]

@@ -50,7 +50,8 @@ class ImageRecordIter(DataIter):
         c, h, w = data_shape
         assert c == 3, "pipeline decodes RGB"
         if preprocess_threads is None:
-            preprocess_threads = max(1, (os.cpu_count() or 1))
+            from ..env import cpu_worker_nthreads
+            preprocess_threads = cpu_worker_nthreads()  # MXNET_CPU_WORKER_NTHREADS
         self._lib = L
         self._h, self._w = h, w
         self._layout = layout

@@ -184,6 +184,7 @@ def invoke(fun, args, kwargs=None, name=None, differentiable=True, wrap=True):
                     prof[0]() - t0)
         else:
             out = fun(*a, **kw)
+        _naive_sync(out)
         return _wrap_out(out, ctx, None, name) if wrap else out
 
     diff_idx = [i for i in nd_idx if _attached(leaves[i]) and _is_float(datas[i])]
@@ -218,6 +219,7 @@ def invoke(fun, args, kwargs=None, name=None, differentiable=True, wrap=True):
         prof[1](name or getattr(fun, "__name__", "op"), t0, prof[0]() - t0)
     else:
         out, vjp_fn = jax.vjp(flat_fun, *[datas[i] for i in diff_idx])
+    _naive_sync(out)
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
     parents = [
         (leaves[i], leaves[i]._node, getattr(leaves[i], "_node_idx", 0))
@@ -236,6 +238,18 @@ def invoke(fun, args, kwargs=None, name=None, differentiable=True, wrap=True):
         diff_idx=diff_idx,
     )
     return _wrap_out(out, ctx, node, name) if wrap else out
+
+
+def _naive_sync(out):
+    """MXNET_ENGINE_TYPE=NaiveEngine: block on every op so async errors
+    surface at the faulting call (reference debug engine semantics)."""
+    from .. import env as _env
+
+    if _env.is_naive_engine():
+        try:
+            jax.block_until_ready(out)
+        except TypeError:
+            pass  # non-array outputs
 
 
 def _wrap_out(out, ctx, node, name):
