@@ -119,6 +119,82 @@ def _time(fn, iters, *, sync):
     return best * 1e6, reliable  # us
 
 
+def _scan_time(fn, datas, target_s=0.15):
+    """Per-op kernel time via `lax.scan` on device.
+
+    The op's output is folded back into its first float input with a
+    ~1e-24 perturbation, so every iteration depends on the previous one
+    (no hoisting/DCE) while numerics stay put.  Returns (us, reliable);
+    ops with no float input fall through as unreliable single-dispatch.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    chain = next((i for i, d in enumerate(datas)
+                  if hasattr(d, "dtype") and d.dtype.kind == "f"), None)
+    if chain is None:
+        return _fallback_single_dispatch(fn, datas)
+
+    def body(carry, _):
+        ins = list(datas)
+        ins[chain] = carry
+        out = fn(*[NDArray(d) for d in ins])
+        leaves = [o._data if isinstance(o, NDArray) else o
+                  for o in (out if isinstance(out, (tuple, list)) else
+                            [out])]
+        leaf = next(l for l in leaves if hasattr(l, "dtype"))
+        dep = jnp.sum(leaf.astype(jnp.float32)) * 1e-24
+        return carry + dep.astype(carry.dtype), None
+
+    def make(k):
+        @jax.jit
+        def run_k(c):
+            c, _ = jax.lax.scan(body, c, None, length=k)
+            return c
+        return run_k
+
+    c0 = datas[chain]
+
+    def drain(x):
+        onp.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0])
+
+    # estimate with a short loop, then size K for ~target_s of device work
+    probe = make(32)
+    drain(probe(c0))  # compile
+    t0 = time.perf_counter()
+    drain(probe(c0))
+    est = max((time.perf_counter() - t0) / 32, 1e-8)
+    k = int(min(max(target_s / est, 64), 100_000))
+    run_k = make(k)
+    drain(run_k(c0))  # compile
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        drain(run_k(c0))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    # the single readback (~100 ms tunneled) must not own the number
+    reliable = best >= 0.5 * target_s
+    return best / k * 1e6, reliable
+
+
+def _fallback_single_dispatch(fn, datas):
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    import jax
+
+    def jfn():
+        out = fn(*[NDArray(d) for d in datas])
+        return out._data if isinstance(out, NDArray) else out
+    jj = jax.jit(lambda: jfn())
+
+    def sync():
+        out = jj()
+        onp.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+    return _time(lambda: jj(), 50, sync=sync)
+
+
 def run(categories=None, iters=50, dtype="float32", warmup=None):
     import mxnet_tpu as mx
     import jax
@@ -133,23 +209,13 @@ def run(categories=None, iters=50, dtype="float32", warmup=None):
         eager_us, eager_ok = _time(lambda: fn(*args), iters,
                                    sync=mx.waitall)
 
-        # jit: the op compiled alone — kernel + PjRt call
+        # jit: the compiled kernel, timed as a DEVICE-SIDE scan loop — one
+        # dispatch runs K data-chained iterations, so the per-op number is
+        # pure kernel time and the tunnel's dispatch latency/jitter divides
+        # away (VERDICT r1: single dispatches made 16/19 rows unreliable)
         from mxnet_tpu.ndarray.ndarray import NDArray
         datas = [a._data for a in args]
-
-        def jit_body(*ds, _fn=fn):
-            out = _fn(*[NDArray(d) for d in ds])
-            return out._data if isinstance(out, NDArray) else out
-        jfn = jax.jit(jit_body)
-
-        def jit_sync():
-            # host readback, not block_until_ready: tunneled backends ack
-            # the latter immediately (see ndarray.waitall)
-            out = jfn(*datas)
-            leaf = jax.tree_util.tree_leaves(out)[0]
-            onp.asarray(leaf.ravel()[0])
-        jit_us, jit_ok = _time(lambda: jfn(*datas), iters,
-                               sync=jit_sync)
+        jit_us, jit_ok = _scan_time(fn, datas)
 
         # fwd+bwd through the tape where the op is differentiable
         bwd_us = None

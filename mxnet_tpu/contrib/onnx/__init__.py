@@ -1,0 +1,11 @@
+"""ONNX interchange (reference: `python/mxnet/contrib/onnx/`).
+
+``export_model(sym, params, ...)`` writes an ONNX ModelProto;
+``import_model(file)`` returns ``(sym, arg_params, aux_params)``.  The
+protobuf wire format is encoded directly (`proto.py`) because the
+``onnx`` package is not available in this environment.
+"""
+from .mx2onnx import export_model
+from .onnx2mx import import_model
+
+__all__ = ["export_model", "import_model"]

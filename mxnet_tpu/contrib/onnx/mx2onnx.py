@@ -1,0 +1,294 @@
+"""Symbol graph -> ONNX export.
+
+Reference: `python/mxnet/contrib/onnx/mx2onnx/` (`export_model`,
+`_export_onnx.py` MXNetGraph + the per-op converter registry in
+`_op_translations.py`).  Same architecture here: walk the Symbol graph
+topologically, run one converter per op to emit NodeProto(s), collect
+parameters as initializers, wrap in Graph/ModelProto — encoded by the
+wire codec in `proto.py` since the `onnx` package is absent.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import proto as P
+
+__all__ = ["export_model"]
+
+_CONVERTERS = {}
+
+
+def register(name):
+    def deco(fn):
+        _CONVERTERS[name] = fn
+        return fn
+    return deco
+
+
+def _tup(attrs, key, default=None):
+    v = attrs.get(key, default)
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        return (int(v),)
+    return tuple(int(x) for x in v)
+
+
+# -- converters (subset mirroring the reference's _op_translations) ---------
+
+@register("FullyConnected")
+@register("fully_connected")
+def _fc(name, ins, attrs):
+    n = attrs.get("num_hidden")
+    del n  # shape is carried by the weight initializer
+    flatten = attrs.get("flatten", True)
+    nodes = []
+    data = ins[0]
+    if flatten:
+        nodes.append(P.node_proto("Flatten", [data], [name + "_flat"],
+                                  name + "_flat", [P.attr_int("axis", 1)]))
+        data = name + "_flat"
+    if len(ins) >= 3 and ins[2] is not None:
+        nodes.append(P.node_proto(
+            "Gemm", [data, ins[1], ins[2]], [name], name,
+            [P.attr_int("transB", 1)]))
+    else:
+        nodes.append(P.node_proto(
+            "Gemm", [data, ins[1]], [name], name,
+            [P.attr_int("transB", 1)]))
+    return nodes
+
+
+@register("Convolution")
+@register("convolution")
+def _conv(name, ins, attrs):
+    kernel = _tup(attrs, "kernel")
+    stride = _tup(attrs, "stride") or (1,) * len(kernel)
+    dilate = _tup(attrs, "dilate") or (1,) * len(kernel)
+    pad = _tup(attrs, "pad") or (0,) * len(kernel)
+    group = int(attrs.get("num_group", 1))
+    a = [P.attr_ints("kernel_shape", kernel),
+         P.attr_ints("strides", stride),
+         P.attr_ints("dilations", dilate),
+         P.attr_ints("pads", pad + pad),
+         P.attr_int("group", group)]
+    return [P.node_proto("Conv", [i for i in ins if i is not None],
+                         [name], name, a)]
+
+
+@register("BatchNorm")
+@register("batch_norm")
+def _bn(name, ins, attrs):
+    a = [P.attr_float("epsilon", float(attrs.get("eps", 1e-3))),
+         P.attr_float("momentum", float(attrs.get("momentum", 0.9)))]
+    return [P.node_proto("BatchNormalization", ins[:5], [name], name, a)]
+
+
+@register("Activation")
+@register("activation")
+def _act(name, ins, attrs):
+    op = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "softrelu": "Softplus", "softsign": "Softsign"}[
+              attrs.get("act_type", "relu")]
+    return [P.node_proto(op, ins[:1], [name], name)]
+
+
+@register("LeakyReLU")
+@register("leaky_relu")
+def _leaky(name, ins, attrs):
+    act = attrs.get("act_type", "leaky")
+    if act == "leaky":
+        return [P.node_proto("LeakyRelu", ins[:1], [name], name,
+                             [P.attr_float("alpha",
+                                           float(attrs.get("slope", 0.25)))])]
+    if act == "elu":
+        return [P.node_proto("Elu", ins[:1], [name], name,
+                             [P.attr_float("alpha",
+                                           float(attrs.get("slope", 0.25)))])]
+    if act == "prelu":
+        return [P.node_proto("PRelu", ins[:2], [name], name)]
+    raise ValueError(f"cannot export LeakyReLU act_type={act}")
+
+
+@register("Pooling")
+@register("pooling")
+def _pool(name, ins, attrs):
+    ptype = attrs.get("pool_type", "max")
+    if attrs.get("global_pool"):
+        op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+        return [P.node_proto(op, ins[:1], [name], name)]
+    kernel = _tup(attrs, "kernel")
+    stride = _tup(attrs, "stride") or kernel
+    pad = _tup(attrs, "pad") or (0,) * len(kernel)
+    a = [P.attr_ints("kernel_shape", kernel),
+         P.attr_ints("strides", stride),
+         P.attr_ints("pads", pad + pad)]
+    op = "MaxPool" if ptype == "max" else "AveragePool"
+    if ptype == "avg":
+        a.append(P.attr_int("count_include_pad",
+                            int(bool(attrs.get("count_include_pad", True)))))
+    return [P.node_proto(op, ins[:1], [name], name, a)]
+
+
+@register("Flatten")
+def _flatten(name, ins, attrs):
+    return [P.node_proto("Flatten", ins[:1], [name], name,
+                         [P.attr_int("axis", 1)])]
+
+
+@register("softmax")
+def _softmax(name, ins, attrs):
+    return [P.node_proto("Softmax", ins[:1], [name], name,
+                         [P.attr_int("axis", int(attrs.get("axis", -1)))])]
+
+
+@register("SoftmaxOutput")
+def _softmax_output(name, ins, attrs):
+    # inference semantics of the training head = plain softmax over axis 1
+    return [P.node_proto("Softmax", ins[:1], [name], name,
+                         [P.attr_int("axis", 1)])]
+
+
+@register("Concat")
+@register("concat")
+def _concat(name, ins, attrs):
+    return [P.node_proto("Concat", ins, [name], name,
+                         [P.attr_int("axis", int(attrs.get("dim", 1)))])]
+
+
+@register("Embedding")
+@register("embedding")
+def _embedding(name, ins, attrs):
+    # ONNX Gather(table, indices); mxnet order is (indices, table)
+    return [P.node_proto("Gather", [ins[1], ins[0]], [name], name,
+                         [P.attr_int("axis", 0)])]
+
+
+@register("Reshape")
+@register("reshape")
+def _reshape(name, ins, attrs, extra_init=None):
+    shape = _tup(attrs, "shape")
+    init = P.tensor_proto(name + "_shape",
+                          onp.asarray(shape, onp.int64))
+    extra_init.append(init)
+    return [P.node_proto("Reshape", [ins[0], name + "_shape"], [name],
+                         name)]
+
+
+@register("transpose")
+def _transpose(name, ins, attrs):
+    axes = _tup(attrs, "axes")
+    a = [P.attr_ints("perm", axes)] if axes else []
+    return [P.node_proto("Transpose", ins[:1], [name], name, a)]
+
+
+@register("Dropout")
+@register("dropout")
+def _dropout(name, ins, attrs):
+    return [P.node_proto("Identity", ins[:1], [name], name)]  # inference
+
+
+for _mx, _ox in [("_plus", "Add"), ("_minus", "Sub"), ("_mul", "Mul"),
+                 ("_div", "Div"), ("broadcast_add", "Add"),
+                 ("broadcast_sub", "Sub"), ("broadcast_mul", "Mul"),
+                 ("broadcast_div", "Div"), ("elemwise_add", "Add"),
+                 ("elemwise_sub", "Sub"), ("elemwise_mul", "Mul"),
+                 ("elemwise_div", "Div"), ("add", "Add"),
+                 ("subtract", "Sub"), ("multiply", "Mul"),
+                 ("true_divide", "Div"), ("dot", "MatMul"),
+                 ("matmul", "MatMul"), ("maximum", "Max"),
+                 ("minimum", "Min")]:
+    def _bin(name, ins, attrs, _op=_ox):
+        return [P.node_proto(_op, ins[:2], [name], name)]
+    _CONVERTERS[_mx] = _bin
+
+for _mx, _ox in [("relu", "Relu"), ("sigmoid", "Sigmoid"),
+                 ("tanh", "Tanh"), ("exp", "Exp"), ("log", "Log"),
+                 ("sqrt", "Sqrt"), ("abs", "Abs"), ("negative", "Neg"),
+                 ("identity", "Identity"), ("BlockGrad", "Identity"),
+                 ("stop_gradient", "Identity"), ("Cast", "Identity")]:
+    def _un(name, ins, attrs, _op=_ox):
+        return [P.node_proto(_op, ins[:1], [name], name)]
+    _CONVERTERS[_mx] = _un
+
+
+# -- graph walk -------------------------------------------------------------
+
+
+def export_model(sym, params, input_shapes=None, input_types=None,
+                 onnx_file_path="model.onnx", opset_version=13,
+                 run_shape_inference=False):
+    """Serialize ``sym`` + ``params`` to an ONNX file (reference
+    `mx2onnx.export_model`).  ``params`` maps free-variable names to
+    NDArrays/arrays; remaining free variables become graph inputs with
+    shapes from ``input_shapes`` (dict name->shape or list in
+    list_arguments order)."""
+    from ...symbol import Symbol, _ScalarSymbol
+
+    params = {k: (v.asnumpy() if hasattr(v, "asnumpy") else onp.asarray(v))
+              for k, v in (params or {}).items()}
+    # strip the Module-era arg:/aux: prefixes
+    params = {k.split(":", 1)[-1]: v for k, v in params.items()}
+
+    args = sym.list_arguments()
+    data_inputs = [a for a in args if a not in params]
+    if isinstance(input_shapes, dict):
+        shape_of = input_shapes
+    else:
+        shape_of = dict(zip(data_inputs, input_shapes or []))
+
+    nodes, initializers, extra_init = [], [], []
+    name_of = {}
+    counter = [0]
+
+    def walk(s):
+        if id(s) in name_of:
+            return name_of[id(s)]
+        if isinstance(s, _ScalarSymbol):
+            nm = f"const_{counter[0]}"
+            counter[0] += 1
+            initializers.append(P.tensor_proto(
+                nm, onp.asarray(s._value, onp.float32)))
+            name_of[id(s)] = nm
+            return nm
+        if s._op is None:
+            name_of[id(s)] = s._name
+            return s._name
+        ins = [walk(i) for i in s._inputs]
+        # keyword tensor inputs follow in their declared order
+        kw = {k: walk(v) for k, v in s._kw_inputs.items()}
+        if kw:
+            order = ("data", "weight", "bias", "gamma", "beta",
+                     "moving_mean", "moving_var", "lhs", "rhs")
+            ins = ins + [kw[k] for k in order if k in kw] + \
+                [v for k, v in kw.items() if k not in order]
+        conv = _CONVERTERS.get(s._op)
+        if conv is None:
+            raise NotImplementedError(
+                f"no ONNX converter for op {s._op!r} (have "
+                f"{sorted(_CONVERTERS)})")
+        nm = s._name if s._name != s._op else f"{s._op}_{counter[0]}"
+        counter[0] += 1
+        try:
+            new_nodes = conv(nm, ins, s._attrs, extra_init=extra_init)
+        except TypeError:
+            new_nodes = conv(nm, ins, s._attrs)
+        nodes.extend(new_nodes)
+        name_of[id(s)] = nm
+        return nm
+
+    out_name = walk(sym)
+
+    for k in args:
+        if k in params:
+            initializers.append(P.tensor_proto(k, params[k]))
+    initializers.extend(extra_init)
+
+    g_inputs = [P.value_info(n, shape_of.get(n, ())) for n in data_inputs]
+    g_outputs = [P.value_info(out_name, ())]
+    graph = P.graph_proto(nodes, "mxnet_tpu_graph", initializers,
+                          g_inputs, g_outputs)
+    blob = P.model_proto(graph, opset=opset_version)
+    with open(onnx_file_path, "wb") as f:
+        f.write(blob)
+    return onnx_file_path

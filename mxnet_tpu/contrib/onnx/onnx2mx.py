@@ -1,0 +1,258 @@
+"""ONNX -> Symbol graph import.
+
+Reference: `python/mxnet/contrib/onnx/onnx2mx/` (`import_model`,
+`import_onnx.py` GraphProto + `_op_translations.py`).  Returns
+``(sym, arg_params, aux_params)`` exactly like the reference, so
+``import_model`` output feeds `sym.bind`/`eval` or `SymbolBlock`-style
+use.  Wire parsing by `proto.py`.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import proto as P
+
+__all__ = ["import_model"]
+
+
+# -- protobuf message readers ------------------------------------------------
+
+def _fields(data):
+    r = P.Reader(data)
+    while not r.eof():
+        yield r.field()
+
+
+def _parse_attr(data):
+    name = None
+    out = {}
+    for f, _w, v in _fields(data):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            out["f"] = P.f32_from_bits(v) if isinstance(v, int) else v
+        elif f == 3:
+            out["i"] = P.signed64(v)
+        elif f == 4:
+            out["s"] = v.decode()
+        elif f == 5:
+            out["t"] = _parse_tensor(v)
+        elif f == 7:
+            out.setdefault("ints", []).append(P.signed64(v))
+    val = out.get("ints")
+    if val is None:
+        val = out.get("i", out.get("f", out.get("s", out.get("t"))))
+    return name, val
+
+
+_NP_OF = {P.FLOAT: onp.float32, P.INT64: onp.int64, P.INT32: onp.int32,
+          11: onp.float64, 10: onp.float16, 9: onp.bool_}
+
+
+def _parse_tensor(data):
+    dims, dtype, raw, name = [], P.FLOAT, b"", ""
+    floats, int32s, int64s = [], [], []
+    for f, _w, v in _fields(data):
+        if f == 1:
+            dims.append(P.signed64(v))
+        elif f == 2:
+            dtype = v
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+        elif f == 4:
+            floats.append(P.f32_from_bits(v))
+        elif f == 5:
+            int32s.append(P.signed64(v))
+        elif f == 7:
+            int64s.append(P.signed64(v))
+    np_dt = _NP_OF.get(dtype, onp.float32)
+    if raw:
+        arr = onp.frombuffer(raw, dtype=np_dt)
+    elif floats:
+        arr = onp.asarray(floats, onp.float32)
+    elif int64s:
+        arr = onp.asarray(int64s, onp.int64)
+    elif int32s:
+        arr = onp.asarray(int32s, onp.int32)
+    else:
+        arr = onp.zeros(0, np_dt)
+    return name, arr.reshape(dims) if dims else arr
+
+
+def _parse_node(data):
+    inputs, outputs, name, op, attrs = [], [], "", "", {}
+    for f, _w, v in _fields(data):
+        if f == 1:
+            inputs.append(v.decode())
+        elif f == 2:
+            outputs.append(v.decode())
+        elif f == 3:
+            name = v.decode()
+        elif f == 4:
+            op = v.decode()
+        elif f == 5:
+            k, val = _parse_attr(v)
+            attrs[k] = val
+    return dict(op=op, name=name, inputs=inputs, outputs=outputs,
+                attrs=attrs)
+
+
+def _parse_value_info(data):
+    name = ""
+    for f, _w, v in _fields(data):
+        if f == 1:
+            name = v.decode()
+    return name
+
+
+def _parse_graph(data):
+    nodes, inits, g_in, g_out = [], {}, [], []
+    for f, _w, v in _fields(data):
+        if f == 1:
+            nodes.append(_parse_node(v))
+        elif f == 5:
+            nm, arr = _parse_tensor(v)
+            inits[nm] = arr
+        elif f == 11:
+            g_in.append(_parse_value_info(v))
+        elif f == 12:
+            g_out.append(_parse_value_info(v))
+    return nodes, inits, g_in, g_out
+
+
+def _parse_model(data):
+    for f, _w, v in _fields(data):
+        if f == 7:
+            return _parse_graph(v)
+    raise ValueError("no graph in ONNX model")
+
+
+# -- ONNX op -> Symbol builders ---------------------------------------------
+
+
+def _build(node, ins, consts, sym_mod):
+    op = node["op"]
+    a = node["attrs"]
+
+    def tup(key, default=None):
+        v = a.get(key, default)
+        return tuple(v) if v is not None else None
+
+    if op == "Gemm":
+        assert a.get("transB", 0) == 1, "only transB Gemm (FC) supported"
+        return sym_mod.FullyConnected(
+            ins[0], ins[1], ins[2] if len(ins) > 2 else None,
+            num_hidden=None, no_bias=len(ins) <= 2, flatten=False)
+    if op == "MatMul":
+        return sym_mod.dot(ins[0], ins[1])
+    if op == "Conv":
+        pads = tup("pads") or (0, 0, 0, 0)
+        nsp = len(pads) // 2
+        return sym_mod.Convolution(
+            ins[0], ins[1], ins[2] if len(ins) > 2 else None,
+            kernel=tup("kernel_shape"),
+            stride=tup("strides") or (1,) * nsp,
+            dilate=tup("dilations") or (1,) * nsp,
+            pad=pads[:nsp], num_filter=None,
+            num_group=int(a.get("group", 1)),
+            no_bias=len(ins) <= 2)
+    if op == "BatchNormalization":
+        return sym_mod.BatchNorm(
+            ins[0], ins[1], ins[2], ins[3], ins[4],
+            eps=float(a.get("epsilon", 1e-5)),
+            momentum=float(a.get("momentum", 0.9)), fix_gamma=False,
+            use_global_stats=True)
+    if op in ("MaxPool", "AveragePool"):
+        pads = tup("pads") or (0, 0, 0, 0)
+        nsp = len(pads) // 2
+        return sym_mod.Pooling(
+            ins[0], kernel=tup("kernel_shape"),
+            stride=tup("strides") or tup("kernel_shape"),
+            pad=pads[:nsp],
+            pool_type="max" if op == "MaxPool" else "avg",
+            count_include_pad=bool(a.get("count_include_pad", 1)))
+    if op in ("GlobalMaxPool", "GlobalAveragePool"):
+        return sym_mod.Pooling(
+            ins[0], global_pool=True,
+            pool_type="max" if "Max" in op else "avg")
+    if op == "Flatten":
+        return sym_mod.Flatten(ins[0])
+    if op == "Softmax":
+        return sym_mod.softmax(ins[0], axis=int(a.get("axis", -1)))
+    if op == "Concat":
+        return sym_mod.Concat(*ins, dim=int(a.get("axis", 1)))
+    if op == "Gather":
+        return sym_mod.take(ins[0], ins[1],
+                            axis=int(a.get("axis", 0)))
+    if op == "Reshape":
+        shape = consts.get(node["inputs"][1])
+        if shape is None:
+            raise NotImplementedError("dynamic Reshape shape input")
+        return sym_mod.Reshape(ins[0], shape=tuple(int(s) for s in shape))
+    if op == "Transpose":
+        perm = tup("perm")
+        return sym_mod.transpose(ins[0], axes=perm)
+    if op == "LeakyRelu":
+        return sym_mod.LeakyReLU(ins[0], act_type="leaky",
+                                 slope=float(a.get("alpha", 0.01)))
+    if op == "Elu":
+        return sym_mod.LeakyReLU(ins[0], act_type="elu",
+                                 slope=float(a.get("alpha", 1.0)))
+    if op == "PRelu":
+        return sym_mod.LeakyReLU(ins[0], ins[1], act_type="prelu")
+    if op == "Softplus":
+        return sym_mod.Activation(ins[0], act_type="softrelu")
+    simple = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+              "Exp": "exp", "Log": "log", "Sqrt": "sqrt", "Abs": "abs",
+              "Neg": "negative", "Identity": "identity",
+              "Add": "broadcast_add", "Sub": "broadcast_sub",
+              "Mul": "broadcast_mul", "Div": "broadcast_div",
+              "Max": "maximum", "Min": "minimum",
+              "Softsign": "softsign"}
+    if op in simple:
+        return getattr(sym_mod, simple[op])(*ins)
+    raise NotImplementedError(f"no importer for ONNX op {op!r}")
+
+
+def import_model(model_file):
+    """ONNX file -> (sym, arg_params, aux_params) (reference
+    `onnx2mx.import_model` contract)."""
+    from ...ndarray.ndarray import NDArray
+    from ... import symbol as sym_mod
+
+    with open(model_file, "rb") as f:
+        nodes, inits, g_in, g_out = _parse_model(f.read())
+
+    env = {}
+    for name in g_in:
+        env[name] = sym_mod.var(name)
+    for name in inits:
+        env.setdefault(name, sym_mod.var(name))
+
+    aux_names = set()
+    for node in nodes:
+        ins = []
+        for i in node["inputs"]:
+            if i not in env:
+                env[i] = sym_mod.var(i)
+            ins.append(env[i])
+        if node["op"] == "BatchNormalization":
+            # running mean/var (inputs 3,4) are aux state, as in the
+            # reference importer
+            aux_names.update(node["inputs"][3:5])
+        out = _build(node, ins, inits, sym_mod)
+        out._name = node["outputs"][0]
+        env[node["outputs"][0]] = out
+
+    outputs = [env[o] for o in g_out]
+    out_sym = outputs[0] if len(outputs) == 1 else sym_mod.Group(outputs)
+
+    arg_params, aux_params = {}, {}
+    for name, arr in inits.items():
+        if name.startswith("const_") or name.endswith("_shape"):
+            continue  # inlined constants consumed at build time
+        target = aux_params if name in aux_names else arg_params
+        target[name] = NDArray(onp.ascontiguousarray(arr))
+    return out_sym, arg_params, aux_params

@@ -1,0 +1,206 @@
+"""Minimal protobuf wire codec for the ONNX message subset.
+
+Reference: `python/mxnet/contrib/onnx/` depends on the `onnx` pip
+package; this environment has none, so the ModelProto/GraphProto wire
+format (protobuf encoding per `onnx/onnx.proto`, a stable public
+schema) is encoded/decoded directly.  Only the fields the converters in
+`mx2onnx.py` / `onnx2mx.py` produce and consume are modeled.
+
+Field numbers below are copied from onnx.proto (public schema; stable
+across ONNX releases by protobuf compatibility rules).
+"""
+from __future__ import annotations
+
+import struct
+
+# -- wire primitives ---------------------------------------------------------
+
+
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field, value):
+    if value < 0:  # two's-complement 64-bit, as protobuf int64 encodes
+        value += 1 << 64
+    return _tag(field, 0) + _varint(value)
+
+
+def f_bytes(field, data):
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def f_string(field, s):
+    return f_bytes(field, s.encode())
+
+
+def f_msg(field, payload):
+    return f_bytes(field, payload)
+
+
+def f_float(field, v):
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def f_packed_int64(field, values):
+    payload = b"".join(_varint(v + (1 << 64) if v < 0 else v)
+                       for v in values)
+    return f_bytes(field, payload)
+
+
+class Reader:
+    def __init__(self, data):
+        self.b = memoryview(data)
+        self.o = 0
+
+    def eof(self):
+        return self.o >= len(self.b)
+
+    def varint(self):
+        shift = 0
+        val = 0
+        while True:
+            byte = self.b[self.o]
+            self.o += 1
+            val |= (byte & 0x7F) << shift
+            if not byte & 0x80:
+                return val
+            shift += 7
+
+    def field(self):
+        """-> (field_number, wire_type, value) where value is int for
+        varint/fixed, bytes for length-delimited."""
+        key = self.varint()
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            return field, wire, self.varint()
+        if wire == 2:
+            ln = self.varint()
+            out = bytes(self.b[self.o:self.o + ln])
+            self.o += ln
+            return field, wire, out
+        if wire == 5:
+            out = struct.unpack_from("<I", self.b, self.o)[0]
+            self.o += 4
+            return field, wire, out
+        if wire == 1:
+            out = struct.unpack_from("<Q", self.b, self.o)[0]
+            self.o += 8
+            return field, wire, out
+        raise ValueError(f"unsupported wire type {wire}")
+
+
+def signed64(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def parse_packed_int64(data):
+    r = Reader(data)
+    out = []
+    while not r.eof():
+        out.append(signed64(r.varint()))
+    return out
+
+
+def f32_from_bits(bits):
+    return struct.unpack("<f", struct.pack("<I", bits))[0]
+
+
+# -- ONNX message builders (field numbers from onnx.proto) -------------------
+
+# TensorProto.DataType
+FLOAT, INT64, INT32 = 1, 7, 6
+
+
+def tensor_proto(name, arr):
+    """TensorProto: dims=1(repeated int64), data_type=2, raw_data=9,
+    name=8."""
+    import numpy as onp
+
+    a = onp.ascontiguousarray(arr)
+    if a.dtype == onp.float32:
+        dt = FLOAT
+    elif a.dtype == onp.int64:
+        dt = INT64
+    elif a.dtype == onp.int32:
+        dt = INT32
+    else:
+        a = a.astype(onp.float32)
+        dt = FLOAT
+    out = b"".join([
+        b"".join(f_varint(1, d) for d in a.shape),
+        f_varint(2, dt),
+        f_string(8, name),
+        f_bytes(9, a.tobytes()),
+    ])
+    return out
+
+
+def attr_int(name, v):
+    """AttributeProto: name=1, type=20 (INT=2), i=3."""
+    return f_string(1, name) + f_varint(3, v) + f_varint(20, 2)
+
+
+def attr_float(name, v):
+    return f_string(1, name) + f_float(2, v) + f_varint(20, 1)
+
+
+def attr_ints(name, vals):
+    return f_string(1, name) + \
+        b"".join(f_varint(7, v) for v in vals) + f_varint(20, 7)
+
+
+def attr_string(name, s):
+    return f_string(1, name) + f_bytes(4, s.encode()) + f_varint(20, 3)
+
+
+def node_proto(op_type, inputs, outputs, name="", attrs=()):
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    return b"".join(
+        [f_string(1, i) for i in inputs] +
+        [f_string(2, o) for o in outputs] +
+        [f_string(3, name), f_string(4, op_type)] +
+        [f_msg(5, a) for a in attrs])
+
+
+def value_info(name, shape, elem_type=FLOAT):
+    """ValueInfoProto: name=1, type=2 {tensor_type=1 {elem_type=1,
+    shape=2 {dim=1 {dim_value=1}}}}."""
+    dims = b"".join(
+        f_msg(1, f_varint(1, d)) for d in shape)
+    ttype = f_varint(1, elem_type) + f_msg(2, dims)
+    return f_string(1, name) + f_msg(2, f_msg(1, ttype))
+
+
+def graph_proto(nodes, name, initializers, inputs, outputs):
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    return b"".join(
+        [f_msg(1, n) for n in nodes] +
+        [f_string(2, name)] +
+        [f_msg(5, t) for t in initializers] +
+        [f_msg(11, i) for i in inputs] +
+        [f_msg(12, o) for o in outputs])
+
+
+def model_proto(graph, producer="mxnet_tpu", opset=13):
+    """ModelProto: ir_version=1, producer_name=2, graph=7,
+    opset_import=8 {domain=1, version=2}."""
+    opset_id = f_string(1, "") + f_varint(2, opset)
+    return b"".join([
+        f_varint(1, 8),            # IR version 8
+        f_string(2, producer),
+        f_msg(7, graph),
+        f_msg(8, opset_id),
+    ])

@@ -1,0 +1,137 @@
+"""ONNX export/import round trip (VERDICT r1 missing #7).
+
+Reference: `python/mxnet/contrib/onnx/` mx2onnx/onnx2mx.  With no onnx
+package available, correctness is established by (a) round-tripping
+through our own encoder/decoder with numerical equality, and (b)
+checking the emitted wire bytes field-by-field against the onnx.proto
+schema for a known small graph.
+"""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import onnx as mxonnx
+from mxnet_tpu.contrib.onnx import proto as P
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def test_mlp_round_trip(tmp_path):
+    sym = mx.sym
+    rs = onp.random.RandomState(0)
+    x = sym.var("data")
+    h = sym.FullyConnected(data=x, weight=sym.var("w1"), bias=sym.var("b1"),
+                           num_hidden=8, flatten=False)
+    h = sym.Activation(h, act_type="relu")
+    out = sym.FullyConnected(data=h, weight=sym.var("w2"),
+                             bias=sym.var("b2"), num_hidden=3,
+                             flatten=False)
+    out = sym.softmax(out, axis=-1)
+
+    params = {"w1": mx.np.array(rs.rand(8, 5).astype("f")),
+              "b1": mx.np.array(rs.rand(8).astype("f")),
+              "w2": mx.np.array(rs.rand(3, 8).astype("f")),
+              "b2": mx.np.array(rs.rand(3).astype("f"))}
+    data = rs.rand(4, 5).astype("f")
+    ref = out.eval(data=mx.np.array(data), **params)[0]
+
+    path = str(tmp_path / "mlp.onnx")
+    mxonnx.export_model(out, params, input_shapes={"data": (4, 5)},
+                        onnx_file_path=path)
+
+    sym2, args, aux = mxonnx.import_model(path)
+    assert not aux
+    assert sorted(args) == ["b1", "b2", "w1", "w2"]
+    got = sym2.eval(data=mx.np.array(data), **args)[0]
+    onp.testing.assert_allclose(_np(got), _np(ref), rtol=1e-5)
+
+
+def test_convnet_round_trip(tmp_path):
+    sym = mx.sym
+    rs = onp.random.RandomState(1)
+    x = sym.var("data")
+    c = sym.Convolution(data=x, weight=sym.var("cw"), bias=sym.var("cb"),
+                        kernel=(3, 3), num_filter=4, pad=(1, 1))
+    c = sym.Activation(c, act_type="relu")
+    p = sym.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f = sym.Flatten(p)
+    out = sym.FullyConnected(data=f, weight=sym.var("fw"),
+                             bias=sym.var("fb"), num_hidden=2,
+                             flatten=False)
+
+    params = {"cw": mx.np.array((rs.rand(4, 3, 3, 3) * 0.2).astype("f")),
+              "cb": mx.np.array(rs.rand(4).astype("f")),
+              "fw": mx.np.array((rs.rand(2, 4 * 4 * 4) * 0.2).astype("f")),
+              "fb": mx.np.array(rs.rand(2).astype("f"))}
+    data = rs.rand(2, 3, 8, 8).astype("f")
+    ref = out.eval(data=mx.np.array(data), **params)[0]
+
+    path = str(tmp_path / "cnn.onnx")
+    mxonnx.export_model(out, params, input_shapes={"data": (2, 3, 8, 8)},
+                        onnx_file_path=path)
+    sym2, args, _aux = mxonnx.import_model(path)
+    got = sym2.eval(data=mx.np.array(data), **args)[0]
+    onp.testing.assert_allclose(_np(got), _np(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_embedding_reshape_round_trip(tmp_path):
+    sym = mx.sym
+    rs = onp.random.RandomState(2)
+    idx = sym.var("idx")
+    emb = sym.Embedding(data=idx, weight=sym.var("table"), input_dim=10,
+                        output_dim=6)
+    r = sym.Reshape(emb, shape=(-1, 6))
+    bn = sym.BatchNorm(data=r, gamma=sym.var("g"), beta=sym.var("b"),
+                       moving_mean=sym.var("mm"), moving_var=sym.var("mv"),
+                       axis=1, use_global_stats=True, fix_gamma=False)
+    params = {"table": mx.np.array(rs.rand(10, 6).astype("f")),
+              "g": mx.np.array(onp.abs(rs.rand(6)).astype("f")),
+              "b": mx.np.array(rs.rand(6).astype("f")),
+              "mm": mx.np.array(rs.rand(6).astype("f")),
+              "mv": mx.np.array((rs.rand(6) + 0.5).astype("f"))}
+    data = onp.array([[1, 2], [3, 4]], onp.int32)
+    ref = bn.eval(idx=mx.np.array(data, dtype="int32"), **params)[0]
+
+    path = str(tmp_path / "embn.onnx")
+    mxonnx.export_model(bn, params, input_shapes={"idx": (2, 2)},
+                        onnx_file_path=path)
+    sym2, args, aux = mxonnx.import_model(path)
+    assert "mm" in aux and "mv" in aux
+    got = sym2.eval(idx=mx.np.array(data, dtype="int32"), **args, **aux)[0]
+    onp.testing.assert_allclose(_np(got), _np(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_wire_bytes_follow_onnx_schema(tmp_path):
+    """Field-by-field check of the emitted protobuf against onnx.proto
+    numbers: ir_version=1, producer=2, graph=7, opset=8; inside the
+    graph: node=1, initializer=5, input=11, output=12."""
+    sym = mx.sym
+    out = sym.relu(sym.var("x"))
+    path = str(tmp_path / "t.onnx")
+    mxonnx.export_model(out, {}, input_shapes={"x": (2,)},
+                        onnx_file_path=path)
+    with open(path, "rb") as f:
+        blob = f.read()
+    fields = {}
+    r = P.Reader(blob)
+    while not r.eof():
+        f_, _w, v = r.field()
+        fields.setdefault(f_, []).append(v)
+    assert fields[1] == [8]                      # ir_version
+    assert fields[2][0] == b"mxnet_tpu"          # producer_name
+    assert 7 in fields and 8 in fields           # graph + opset
+    g = {}
+    r = P.Reader(fields[7][0])
+    while not r.eof():
+        f_, _w, v = r.field()
+        g.setdefault(f_, []).append(v)
+    assert 1 in g        # at least one node
+    assert 11 in g       # graph input
+    assert 12 in g       # graph output
+    node = {}
+    r = P.Reader(g[1][0])
+    while not r.eof():
+        f_, _w, v = r.field()
+        node.setdefault(f_, []).append(v)
+    assert node[4] == [b"Relu"]                  # op_type field 4
