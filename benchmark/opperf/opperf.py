@@ -160,13 +160,29 @@ def _scan_time(fn, datas, target_s=0.15):
     def drain(x):
         onp.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0])
 
-    # estimate with a short loop, then size K for ~target_s of device work
-    probe = make(32)
-    drain(probe(c0))  # compile
+    # the readback itself costs ~100 ms through the tunnel; measure it on
+    # an already-materialized value and SUBTRACT it everywhere, otherwise
+    # it owns every number (the round-1 failure mode)
+    drain(c0)
+    t_sync = min((lambda t0: (drain(c0), time.perf_counter() - t0)[1])(
+        time.perf_counter()) for _ in range(3))
+
+    # estimate per-iteration cost with two loop lengths: the difference
+    # cancels both the drain and any fixed dispatch cost
+    k_a, k_b = 64, 512
+    pa, pb = make(k_a), make(k_b)
+    drain(pa(c0))
+    drain(pb(c0))  # compile both
     t0 = time.perf_counter()
-    drain(probe(c0))
-    est = max((time.perf_counter() - t0) / 32, 1e-8)
-    k = int(min(max(target_s / est, 64), 100_000))
+    drain(pa(c0))
+    ta = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    drain(pb(c0))
+    tb = time.perf_counter() - t0
+    est = max((tb - ta) / (k_b - k_a), 1e-9)
+
+    # size K so pure op work dwarfs the drain: >= 4*t_sync of kernels
+    k = int(min(max(4 * t_sync / est, 2048), 2_000_000))
     run_k = make(k)
     drain(run_k(c0))  # compile
     best = None
@@ -175,9 +191,9 @@ def _scan_time(fn, datas, target_s=0.15):
         drain(run_k(c0))
         dt = time.perf_counter() - t0
         best = dt if best is None else min(best, dt)
-    # the single readback (~100 ms tunneled) must not own the number
-    reliable = best >= 0.5 * target_s
-    return best / k * 1e6, reliable
+    work = best - t_sync
+    reliable = work >= 2 * t_sync
+    return max(work, 0.0) / k * 1e6, reliable
 
 
 def _fallback_single_dispatch(fn, datas):

@@ -1044,3 +1044,180 @@ __all__ = sorted(
     if not n.startswith("_") and not isinstance(v, _types.ModuleType)
     and n not in ("NDArray", "invoke", "current_context", "annotations")
 )
+
+
+# ---------------------------------------------------------------------------
+# straggler kernels: FTML/LAMB phases, mp_nag, multi-tensor + preloaded
+# optimizer variants, LARS helpers, Correlation
+# (`src/operator/optimizer_op.cc`, `contrib/multi_*.cc`, `correlation.cc`)
+# ---------------------------------------------------------------------------
+
+erf = _npx.erf
+erfinv = _npx.erfinv
+CuDNNBatchNorm = BatchNorm  # cudnn alias: same semantics
+
+
+def ftml_update(weight, grad, d, v, z, lr, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0, out=None):
+    new_w, new_d, new_v, new_z = invoke(
+        _lm.ftml_update, (weight, grad, d, v, z),
+        dict(lr=_f(lr, 0.0), beta1=_f(beta1, 0.6), beta2=_f(beta2, 0.999),
+             epsilon=_f(epsilon, 1e-8), t=int(t), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_grad=_f(clip_grad, -1.0)),
+        name="ftml_update", differentiable=False)
+    _inplace(d, new_d)
+    _inplace(v, new_v)
+    _inplace(z, new_z)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    g, new_mean, new_var = invoke(
+        _lm.lamb_update_phase1, (weight, grad, mean, var),
+        dict(beta1=_f(beta1, 0.9), beta2=_f(beta2, 0.999),
+             epsilon=_f(epsilon, 1e-6), t=int(t),
+             bias_correction=bool(bias_correction), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="lamb_update_phase1", differentiable=False)
+    _inplace(mean, new_mean)
+    _inplace(var, new_var)
+    return _ret(g, out)
+
+
+def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0, out=None):
+    new_w = invoke(
+        _lm.lamb_update_phase2, (weight, g, r1, r2),
+        dict(lr=_f(lr, 0.0), lower_bound=_f(lower_bound, -1.0),
+             upper_bound=_f(upper_bound, -1.0)),
+        name="lamb_update_phase2", differentiable=False)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+mp_lamb_update_phase1 = lamb_update_phase1  # master weights arrive as f32
+mp_lamb_update_phase2 = lamb_update_phase2
+
+
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      out=None):
+    new_w, new_mom, new_w32 = invoke(
+        _lm.mp_nag_mom_update, (weight, grad, mom, weight32),
+        dict(lr=_f(lr, 0.0), momentum=_f(momentum, 0.0), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="mp_nag_mom_update", differentiable=False)
+    _inplace(mom, new_mom)
+    _inplace(weight32, new_w32)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def _multi_update(single, n_state):
+    """Multi-tensor variant over the single-tensor kernel (reference
+    `multi_sgd_update` etc: flattened [w0..wn, g0..gn, s0..sn] inputs,
+    per-tensor lrs/wds)."""
+    def op(*data, lrs=(), wds=(), num_weights=None, rescale_grad=1.0,
+           clip_gradient=-1.0, momentum=0.0, out=None, **kw):
+        n = num_weights if num_weights is not None else \
+            len(data) // (2 + n_state)
+        ws = data[:n]
+        gs = data[n:2 * n]
+        states = [data[(2 + s) * n:(3 + s) * n] for s in range(n_state)]
+        outs = out if out is not None else [_nd(w) for w in ws]
+        for i in range(n):
+            sargs = [st[i] for st in states]
+            single(ws[i], gs[i], *sargs, lr=lrs[i], wd=wds[i],
+                   rescale_grad=rescale_grad, clip_gradient=clip_gradient,
+                   out=outs[i],
+                   **({"momentum": momentum} if n_state else {}))
+        return outs
+    return op
+
+
+multi_sgd_update = _multi_update(sgd_update, 0)
+multi_sgd_mom_update = _multi_update(sgd_mom_update, 1)
+
+
+def _multi_mp_update(single, n_state):
+    def op(*data, lrs=(), wds=(), num_weights=None, rescale_grad=1.0,
+           clip_gradient=-1.0, momentum=0.0, out=None, **kw):
+        n = num_weights if num_weights is not None else \
+            len(data) // (3 + n_state)
+        ws = data[:n]
+        gs = data[n:2 * n]
+        states = [data[(2 + s) * n:(3 + s) * n] for s in range(n_state)]
+        w32s = data[(2 + n_state) * n:(3 + n_state) * n]
+        outs = out if out is not None else [_nd(w) for w in ws]
+        for i in range(n):
+            sargs = [st[i] for st in states]
+            single(ws[i], gs[i], *sargs, w32s[i], lr=lrs[i], wd=wds[i],
+                   rescale_grad=rescale_grad, clip_gradient=clip_gradient,
+                   out=outs[i],
+                   **({"momentum": momentum} if n_state else {}))
+        return outs
+    return op
+
+
+multi_mp_sgd_update = _multi_mp_update(mp_sgd_update, 0)
+multi_mp_sgd_mom_update = _multi_mp_update(mp_sgd_mom_update, 1)
+
+
+def _preloaded(multi):
+    """preloaded_*: lrs/wds arrive as trailing NDArray inputs rather than
+    attrs (`src/operator/contrib/preloaded_multi_sgd.cc`)."""
+    def op(*data, num_weights=None, out=None, **kw):
+        lrs = onp.asarray(_nd(data[-2]).asnumpy()).ravel()
+        wds = onp.asarray(_nd(data[-1]).asnumpy()).ravel()
+        return multi(*data[:-2], lrs=lrs.tolist(), wds=wds.tolist(),
+                     num_weights=num_weights, out=out, **kw)
+    return op
+
+
+preloaded_multi_sgd_update = _preloaded(multi_sgd_update)
+preloaded_multi_sgd_mom_update = _preloaded(multi_sgd_mom_update)
+preloaded_multi_mp_sgd_update = _preloaded(multi_mp_sgd_update)
+preloaded_multi_mp_sgd_mom_update = _preloaded(multi_mp_sgd_mom_update)
+
+
+def multi_sum_sq(*arrays, num_arrays=None, out=None):
+    return _ret(invoke(_lm.multi_sum_sq, arrays, name="multi_sum_sq",
+                       differentiable=False), out)
+
+
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0, out=None):
+    return _ret(invoke(
+        _lm.multi_lars, (lrs, weights_sum_sq, grads_sum_sq, wds),
+        dict(eta=_f(eta, 0.001), eps=_f(eps, 1e-8),
+             rescale_grad=_f(rescale_grad, 1.0)),
+        name="multi_lars", differentiable=False), out)
+
+
+def reset_arrays(*arrays, num_arrays=None):
+    """Zero each array in place (`src/operator/contrib/reset_arrays.cc`)."""
+    for a in arrays:
+        nd_a = _nd(a)
+        nd_a._rebind(jnp.zeros_like(nd_a._data))
+    return None
+
+
+def Correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True, out=None):
+    return _ret(invoke(
+        _lm.correlation, (data1, data2),
+        dict(kernel_size=kernel_size, max_displacement=max_displacement,
+             stride1=stride1, stride2=stride2, pad_size=pad_size,
+             is_multiply=bool(is_multiply)), name="Correlation"), out)
+
+
+# recompute the export list to include everything above
+__all__ = sorted(
+    n for n, v in list(globals().items())
+    if not n.startswith("_") and not isinstance(v, _types.ModuleType)
+    and n not in ("NDArray", "invoke", "current_context", "annotations")
+)

@@ -545,3 +545,112 @@ def all_finite(data, init_output=True):
 
 def argmax_channel(data):
     return jnp.argmax(data, axis=1).astype(data.dtype)
+
+
+def ftml_update(weight, grad, d, v, z, lr, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0):
+    """`src/operator/optimizer_op.cc` ftml_update."""
+    g = grad * rescale_grad + wd * weight
+    if clip_grad is not None and clip_grad > 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight
+    new_w = -new_z / d_t
+    return new_w, d_t, new_v, new_z
+
+
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    """`src/operator/optimizer_op.cc` lamb_update_phase1: the raw update
+    direction before the trust-ratio scaling."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mean_hat = new_mean / (1 - beta1 ** t)
+        var_hat = new_var / (1 - beta2 ** t)
+    else:
+        mean_hat, var_hat = new_mean, new_var
+    g_out = mean_hat / (jnp.sqrt(var_hat) + epsilon) + wd * weight
+    return g_out, new_mean, new_var
+
+
+def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    """phase2: apply the trust ratio r1/r2 computed by the caller."""
+    if lower_bound is not None and lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2, 1.0)
+    return weight - lr * ratio * g
+
+
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    new_w32, new_mom = nag_mom_update(
+        weight32, grad.astype(jnp.float32), mom, lr, momentum, wd,
+        rescale_grad, clip_gradient)
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+def multi_sum_sq(*arrays):
+    """`src/operator/contrib/multi_sum_sq.cc`: per-array sum of squares."""
+    return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32)))
+                      for a in arrays])
+
+
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0):
+    """`src/operator/contrib/multi_lars.cc`: per-layer LARS coefficients."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    trust = jnp.where(
+        jnp.logical_and(w_norm > 0, g_norm > 0),
+        eta * w_norm / (g_norm + wds * w_norm + eps), 1.0)
+    return lrs * trust
+
+
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """`src/operator/correlation.cc` (FlowNet correlation layer): for each
+    displacement d on a stride2 grid within max_displacement, correlate
+    kernel_size patches of data1 with shifted patches of data2; output
+    channel per displacement, normalized by patch volume."""
+    n, c, h, w = data1.shape
+    p = pad_size
+    d1 = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    d2 = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    border = max_displacement + kernel_size // 2
+    out_h = (h + 2 * p - 2 * border + stride1 - 1) // stride1
+    out_w = (w + 2 * p - 2 * border + stride1 - 1) // stride1
+    disps = range(-max_displacement, max_displacement + 1, stride2)
+    khalf = kernel_size // 2
+    planes = []
+    for dy in disps:
+        for dx in disps:
+            if is_multiply:
+                prod = d1 * jnp.roll(d2, shift=(-dy, -dx), axis=(2, 3))
+            else:
+                prod = jnp.abs(
+                    d1 - jnp.roll(d2, shift=(-dy, -dx), axis=(2, 3)))
+            acc = jnp.sum(prod, axis=1)  # (N, H+2p, W+2p)
+            if kernel_size > 1:
+                window = [1, kernel_size, kernel_size]
+                acc = lax.reduce_window(
+                    acc, 0.0, lax.add, window, [1, 1, 1],
+                    [(0, 0), (khalf, khalf), (khalf, khalf)])
+            planes.append(acc)
+    out = jnp.stack(planes, axis=1)  # (N, D*D, H+2p, W+2p)
+    y0 = border
+    x0 = border
+    out = out[:, :, y0:y0 + out_h * stride1:stride1,
+              x0:x0 + out_w * stride1:stride1]
+    return out / (kernel_size * kernel_size * c)

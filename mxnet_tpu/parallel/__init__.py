@@ -11,6 +11,7 @@ from .mesh import (
     init_distributed,
 )
 from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
 from .pipeline import pipeline_apply
 from .moe import moe_ffn, init_moe_params, moe_partition_specs, shard_moe_params
 
@@ -18,7 +19,7 @@ __all__ = [
     "make_mesh", "current_mesh", "mesh_scope", "data_sharding",
     "replicated_sharding", "match_partition_rules", "shard_parameters",
     "global_put",
-    "constrain", "ring_attention", "init_distributed",
+    "constrain", "ring_attention", "ulysses_attention", "init_distributed",
     "pipeline_apply", "moe_ffn", "init_moe_params", "moe_partition_specs",
     "shard_moe_params",
 ]

@@ -432,3 +432,83 @@ def test_sym_infer_shape_int_dtypes():
     emb = sym.take(sym.var("table"), idx, axis=0)
     args, outs, _aux = emb.infer_shape(table=(10, 4), idx=(3,))
     assert outs[0] == (3, 4)
+
+
+def test_multi_tensor_and_lars_kernels(rng):
+    w1 = rng.standard_normal((3, 2)).astype(onp.float32)
+    w2 = rng.standard_normal((4,)).astype(onp.float32)
+    g1 = rng.standard_normal((3, 2)).astype(onp.float32)
+    g2 = rng.standard_normal((4,)).astype(onp.float32)
+
+    outs = nd.multi_sgd_update(nd.array(w1), nd.array(w2), nd.array(g1),
+                               nd.array(g2), lrs=[0.1, 0.2],
+                               wds=[0.0, 0.0], num_weights=2)
+    onp.testing.assert_allclose(_np(outs[0]), w1 - 0.1 * g1, rtol=1e-5)
+    onp.testing.assert_allclose(_np(outs[1]), w2 - 0.2 * g2, rtol=1e-5)
+
+    # preloaded variant: lrs/wds as arrays
+    outs = nd.preloaded_multi_sgd_update(
+        nd.array(w1), nd.array(w2), nd.array(g1), nd.array(g2),
+        nd.array(onp.array([0.1, 0.2], "f")),
+        nd.array(onp.array([0.0, 0.0], "f")), num_weights=2)
+    onp.testing.assert_allclose(_np(outs[0]), w1 - 0.1 * g1, rtol=1e-5)
+
+    ssq = nd.multi_sum_sq(nd.array(w1), nd.array(w2), num_arrays=2)
+    onp.testing.assert_allclose(
+        _np(ssq), [onp.square(w1).sum(), onp.square(w2).sum()], rtol=1e-5)
+
+    lrs = nd.array(onp.array([0.1, 0.1], "f"))
+    new_lrs = nd.multi_lars(lrs, ssq,
+                            nd.multi_sum_sq(nd.array(g1), nd.array(g2),
+                                            num_arrays=2),
+                            nd.array(onp.array([0.0, 0.0], "f")), eta=0.01)
+    exp = 0.1 * 0.01 * onp.sqrt(onp.square(w1).sum()) / \
+        (onp.sqrt(onp.square(g1).sum()) + 1e-8)
+    onp.testing.assert_allclose(_np(new_lrs)[0], exp, rtol=1e-4)
+
+    a = nd.array(onp.ones((2, 2), "f"))
+    nd.reset_arrays(a, num_arrays=1)
+    onp.testing.assert_allclose(_np(a), 0)
+
+
+def test_ftml_and_lamb_kernels(rng):
+    w = rng.standard_normal((4,)).astype(onp.float32)
+    g = rng.standard_normal((4,)).astype(onp.float32)
+    d = nd.zeros((4,)); v = nd.zeros((4,)); z = nd.zeros((4,))
+    out = nd.ftml_update(nd.array(w), nd.array(g), d, v, z, lr=0.01, t=1)
+    assert onp.isfinite(_np(out)).all()
+    assert onp.abs(_np(v)).sum() > 0  # state mutated
+
+    mean = nd.zeros((4,)); var = nd.zeros((4,))
+    gout = nd.lamb_update_phase1(nd.array(w), nd.array(g), mean, var, t=1,
+                                 wd=0.1)
+    # phase1 = mean_hat/sqrt(var_hat)+wd*w with bias correction at t=1
+    exp = g / (onp.abs(g) + 1e-6) + 0.1 * w
+    onp.testing.assert_allclose(_np(gout), exp, rtol=1e-3)
+    r1 = nd.norm(nd.array(w))
+    r2 = nd.norm(gout)
+    new_w = nd.lamb_update_phase2(nd.array(w), gout, r1, r2, lr=0.1)
+    ratio = _np(r1) / _np(r2)
+    onp.testing.assert_allclose(_np(new_w), w - 0.1 * ratio * _np(gout),
+                                rtol=1e-4)
+
+
+def test_correlation_op(rng):
+    """Correlation vs a naive python oracle (kernel 1, displacement 1)."""
+    n, c, h, w = 1, 2, 5, 5
+    d1 = rng.standard_normal((n, c, h, w)).astype(onp.float32)
+    d2 = rng.standard_normal((n, c, h, w)).astype(onp.float32)
+    md, p = 1, 1
+    out = nd.Correlation(nd.array(d1), nd.array(d2), kernel_size=1,
+                         max_displacement=md, stride1=1, stride2=1,
+                         pad_size=p, is_multiply=True)
+    got = _np(out)
+    assert got.shape[1] == (2 * md + 1) ** 2
+    # oracle at center pixel (2,2), displacement (dy=1, dx=0) -> plane 7
+    pad1 = onp.pad(d1, ((0, 0), (0, 0), (p, p), (p, p)))
+    pad2 = onp.pad(d2, ((0, 0), (0, 0), (p, p), (p, p)))
+    y, x = 2 + p, 2 + p
+    exp = (pad1[0, :, y, x] * pad2[0, :, y + 1, x]).sum() / c
+    # output grid starts at border=md (kernel 1): out index = y - border
+    oy, ox = y - md, x - md
+    onp.testing.assert_allclose(got[0, 7, oy, ox], exp, rtol=1e-5)

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# CI entry (reference: ci/build.py + runtime_functions.sh stages).
+# Stages: smoke | test | dryrun | all (default).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+stage="${1:-all}"
+
+export JAX_PLATFORMS=cpu
+export PALLAS_AXON_POOL_IPS=
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+run_smoke()  { bash tools/smoke.sh; }
+run_test()   { python -m pytest tests/ -q -x; }
+run_dryrun() {
+  for n in 8 6 3 2; do
+    python -c "import __graft_entry__ as g; g.dryrun_multichip($n); print('dryrun($n) ok')"
+  done
+}
+
+case "$stage" in
+  smoke)  run_smoke ;;
+  test)   run_test ;;
+  dryrun) run_dryrun ;;
+  all)    run_smoke; run_test; run_dryrun ;;
+  *) echo "unknown stage $stage" >&2; exit 2 ;;
+esac
