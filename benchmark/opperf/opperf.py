@@ -181,16 +181,25 @@ def _scan_time(fn, datas, target_s=0.15):
     tb = time.perf_counter() - t0
     est = max((tb - ta) / (k_b - k_a), 1e-9)
 
-    # size K so pure op work dwarfs the drain: >= 4*t_sync of kernels
-    k = int(min(max(4 * t_sync / est, 2048), 2_000_000))
-    run_k = make(k)
-    drain(run_k(c0))  # compile
+    # size K so pure op work dwarfs the drain (>= 3*t_sync of kernels);
+    # the first estimate is noisy through the tunnel, so rescale K and
+    # remeasure until the window is dominated by op work
+    k = int(min(max(4 * t_sync / est, 4096), 20_000_000))
     best = None
-    for _ in range(3):
-        t0 = time.perf_counter()
-        drain(run_k(c0))
-        dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
+    for _attempt in range(3):
+        run_k = make(k)
+        drain(run_k(c0))  # compile
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            drain(run_k(c0))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        work = best - t_sync
+        if work >= 2 * t_sync or k >= 20_000_000:
+            break
+        k = int(min(max(k * 3 * t_sync / max(work, 1e-4), k * 4),
+                    20_000_000))
     work = best - t_sync
     reliable = work >= 2 * t_sync
     return max(work, 0.0) / k * 1e6, reliable

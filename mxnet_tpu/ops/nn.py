@@ -207,22 +207,95 @@ def adaptive_avg_pool2d(data, output_size, layout="NCHW"):
 # ---------------------------------------------------------------------------
 # normalization (reference: batch_norm.cc, layer_norm.cc, group_norm.cc)
 # ---------------------------------------------------------------------------
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _bn_train_core(data, gamma, beta, moving_mean, moving_var, momentum,
+                   eps, axis):
+    out, _res = _bn_train_fwd(data, gamma, beta, moving_mean, moving_var,
+                              momentum, eps, axis)
+    return out
+
+
+def _bn_shape(data, axis):
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    return tuple(shape)
+
+
+def _bn_train_fwd(data, gamma, beta, moving_mean, moving_var, momentum,
+                  eps, axis):
+    """Single-pass stats (sum, sum-of-squares in f32 — ONE read of the
+    activation, two fused reductions) + scale/shift folding: the big
+    elementwise op is exactly one multiply-add, which XLA fuses into the
+    producing conv's epilogue.  This BN formulation is worth ~1.5x on
+    ResNet-50 training (see benchmark/MFU_ANALYSIS.md): the naive
+    mean/var/normalize chain reads the activation three times."""
+    red_axes = tuple(i for i in range(data.ndim) if i != axis)
+    n = 1
+    for i in red_axes:
+        n *= data.shape[i]
+    cdt = jnp.promote_types(data.dtype, jnp.float32)  # f32 accum; f64 oracle-safe
+    xf = data.astype(cdt)
+    s1 = jnp.sum(xf, axis=red_axes)
+    s2 = jnp.sum(xf * xf, axis=red_axes)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    inv = lax.rsqrt(var + eps)
+    a = gamma.astype(cdt) * inv
+    b = beta.astype(cdt) - mean * a
+    shape = _bn_shape(data, axis)
+    out = (xf * a.reshape(shape) + b.reshape(shape)).astype(data.dtype)
+    new_mean = moving_mean * momentum + \
+        mean.astype(moving_mean.dtype) * (1 - momentum)
+    new_var = moving_var * momentum + \
+        var.astype(moving_var.dtype) * (1 - momentum)
+    return (out, new_mean, new_var), (data, gamma, mean, inv)
+
+
+def _bn_train_bwd(momentum, eps, axis, res, cts):
+    """Hand-written BN backward: two fused reductions over one read of
+    (dy, xhat) plus one elementwise pass — the chain rule through the
+    naive form reads the activation twice more."""
+    data, gamma, mean, inv = res
+    dy, d_mm, d_mv = cts
+    red_axes = tuple(i for i in range(data.ndim) if i != axis)
+    n = 1
+    for i in red_axes:
+        n *= data.shape[i]
+    shape = _bn_shape(data, axis)
+    cdt = jnp.promote_types(data.dtype, jnp.float32)
+    dyf = dy.astype(cdt)
+    xhat = (data.astype(cdt) - mean.reshape(shape)) * \
+        inv.reshape(shape)
+    sum_dy = jnp.sum(dyf, axis=red_axes)
+    sum_dy_xhat = jnp.sum(dyf * xhat, axis=red_axes)
+    a = (gamma.astype(cdt) * inv).reshape(shape)
+    dx = a * (dyf - (sum_dy / n).reshape(shape) -
+              xhat * (sum_dy_xhat / n).reshape(shape))
+    # moving stats carry stop_gradient semantics w.r.t. data (reference
+    # behavior); their cotangents flow only into the old moving buffers
+    return (dx.astype(data.dtype), sum_dy_xhat.astype(gamma.dtype),
+            sum_dy.astype(gamma.dtype),
+            d_mm * momentum, d_mv * momentum)
+
+
+def _bn_train_fwd_rule(data, gamma, beta, moving_mean, moving_var,
+                       momentum, eps, axis):
+    outs, res = _bn_train_fwd(data, gamma, beta, moving_mean, moving_var,
+                              momentum, eps, axis)
+    return outs, res
+
+
+_bn_train_core.defvjp(_bn_train_fwd_rule, _bn_train_bwd)
+
+
 def batch_norm_train(data, gamma, beta, momentum, eps, axis, moving_mean,
                      moving_var):
     """Returns (out, new_moving_mean, new_moving_var)."""
-    red_axes = tuple(i for i in range(data.ndim) if i != axis)
-    mean = jnp.mean(data, axis=red_axes)
-    var = jnp.var(data, axis=red_axes)
-    shape = [1] * data.ndim
-    shape[axis] = data.shape[axis]
-    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(data.dtype)
-    out = (data - mean.reshape(shape)) * inv.reshape(shape)
-    out = out * gamma.reshape(shape) + beta.reshape(shape)
-    m = lax.stop_gradient(mean)
-    v = lax.stop_gradient(var)
-    new_mean = moving_mean * momentum + m * (1 - momentum)
-    new_var = moving_var * momentum + v * (1 - momentum)
-    return out, new_mean, new_var
+    return _bn_train_core(data, gamma, beta, moving_mean, moving_var,
+                          momentum, eps, axis)
 
 
 def batch_norm_inference(data, gamma, beta, moving_mean, moving_var, eps, axis):

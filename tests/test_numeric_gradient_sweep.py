@@ -139,3 +139,41 @@ def test_embedding_and_take_numeric_gradient():
     check_numeric_gradient(
         lambda w: mx.npx.embedding(idx, w, input_dim=5, output_dim=3), [w])
     check_numeric_gradient(lambda w: nd.take(w, idx, axis=0), [w])
+
+
+def test_batch_norm_train_numeric_gradient():
+    """The hand-written single-pass BN VJP (ops/nn.py _bn_train_core) vs
+    finite differences and the naive mean/var formulation."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import nn as _nn
+    from mxnet_tpu.ops.invoke import invoke
+
+    rs = onp.random.RandomState(9)
+    x = mx.np.array(rs.rand(4, 3, 5, 5).astype("f") * 2 - 1)
+    g = mx.np.array((rs.rand(3) + 0.5).astype("f"))
+    b = mx.np.array(rs.rand(3).astype("f"))
+    mm = onp.zeros(3, "f")
+    mv = onp.ones(3, "f")
+
+    def fn(x, g, b):
+        out = invoke(_nn.batch_norm_train,
+                     (x, g, b, 0.9, 1e-5, 1, mx.np.array(mm),
+                      mx.np.array(mv)), name="bn")
+        return out[0]
+
+    check_numeric_gradient(fn, [x, g, b], rtol=2e-2, atol=2e-3)
+
+    # forward + moving stats match the naive formulation
+    out, nm, nv = _nn.batch_norm_train(
+        x._data, g._data, b._data, 0.9, 1e-5, 1,
+        jnp.asarray(mm), jnp.asarray(mv))
+    xf = onp.asarray(x._data)
+    mean = xf.mean(axis=(0, 2, 3))
+    var = xf.var(axis=(0, 2, 3))
+    ref = (xf - mean.reshape(1, 3, 1, 1)) / onp.sqrt(
+        var.reshape(1, 3, 1, 1) + 1e-5) * onp.asarray(g._data).reshape(
+        1, 3, 1, 1) + onp.asarray(b._data).reshape(1, 3, 1, 1)
+    onp.testing.assert_allclose(onp.asarray(out), ref, rtol=2e-4, atol=2e-5)
+    onp.testing.assert_allclose(onp.asarray(nm), 0.1 * mean, rtol=1e-4)
+    onp.testing.assert_allclose(onp.asarray(nv), 0.9 + 0.1 * var, rtol=1e-4)
