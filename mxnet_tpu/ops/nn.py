@@ -307,14 +307,24 @@ def batch_norm_inference(data, gamma, beta, moving_mean, moving_var, eps, axis):
 
 
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
-    mean = jnp.mean(data, axis=axis, keepdims=True)
-    var = jnp.var(data, axis=axis, keepdims=True)
-    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(data.dtype)
-    out = (data - mean) * inv
+    """Single-pass statistics, like `_bn_train_fwd`: sum and
+    sum-of-squares in one fused read (promoted accumulation dtype), then
+    one multiply-add — the naive mean/var/normalize chain reads the
+    activation three times and shows up hard in transformer steps."""
+    cdt = jnp.promote_types(data.dtype, jnp.float32)
+    xf = data.astype(cdt)
+    n = data.shape[axis]
+    s1 = jnp.sum(xf, axis=axis, keepdims=True)
+    s2 = jnp.sum(xf * xf, axis=axis, keepdims=True)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    inv = lax.rsqrt(var + eps)
     shape = [1] * data.ndim
     ax = axis if axis >= 0 else data.ndim + axis
     shape[ax] = data.shape[ax]
-    return out * gamma.reshape(shape) + beta.reshape(shape)
+    a = inv * gamma.reshape(shape).astype(cdt)
+    b = beta.reshape(shape).astype(cdt) - mean * a
+    return (xf * a + b).astype(data.dtype)
 
 
 def group_norm(data, gamma, beta, num_groups, eps=1e-5):
