@@ -167,30 +167,24 @@ def _scan_time(fn, datas, target_s=0.15):
     t_sync = min((lambda t0: (drain(c0), time.perf_counter() - t0)[1])(
         time.perf_counter()) for _ in range(3))
 
-    # estimate per-iteration cost with two loop lengths: the difference
-    # cancels both the drain and any fixed dispatch cost
-    k_a, k_b = 64, 512
-    pa, pb = make(k_a), make(k_b)
-    drain(pa(c0))
-    drain(pb(c0))  # compile both
+    # estimate per-iteration cost from one medium loop (drain subtracted),
+    # then one rescale if op work doesn't yet dominate — each scan length
+    # is a fresh XLA compile through the tunnel, so compiles are budgeted
+    k = 4096
+    run_k = make(k)
+    drain(run_k(c0))  # compile
     t0 = time.perf_counter()
-    drain(pa(c0))
-    ta = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    drain(pb(c0))
-    tb = time.perf_counter() - t0
-    est = max((tb - ta) / (k_b - k_a), 1e-9)
+    drain(run_k(c0))
+    est = max((time.perf_counter() - t0 - t_sync) / k, 1e-9)
 
-    # size K so pure op work dwarfs the drain (>= 3*t_sync of kernels);
-    # the first estimate is noisy through the tunnel, so rescale K and
-    # remeasure until the window is dominated by op work
-    k = int(min(max(4 * t_sync / est, 4096), 20_000_000))
     best = None
-    for _attempt in range(3):
-        run_k = make(k)
-        drain(run_k(c0))  # compile
+    for _attempt in range(2):
+        if best is None:
+            k = int(min(max(3 * t_sync / est, 4096), 20_000_000))
+            run_k = make(k)
+            drain(run_k(c0))  # compile
         best = None
-        for _ in range(3):
+        for _ in range(2):
             t0 = time.perf_counter()
             drain(run_k(c0))
             dt = time.perf_counter() - t0
@@ -200,6 +194,8 @@ def _scan_time(fn, datas, target_s=0.15):
             break
         k = int(min(max(k * 3 * t_sync / max(work, 1e-4), k * 4),
                     20_000_000))
+        run_k = make(k)
+        drain(run_k(c0))
     work = best - t_sync
     reliable = work >= 2 * t_sync
     return max(work, 0.0) / k * 1e6, reliable

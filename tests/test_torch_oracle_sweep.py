@@ -1,0 +1,199 @@
+"""NN-op numerics vs torch (an independent oracle, CPU build).
+
+Reference test model: `tests/python/unittest/test_operator.py` checks
+kernels against scipy/numpy references; torch's CPU kernels serve the
+same role here for the conv/pool/norm families across a parameter grid.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+torch = pytest.importorskip("torch")
+F = torch.nn.functional
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def _t(x):
+    return torch.from_numpy(onp.asarray(x))
+
+
+CONV_GRID = [
+    # (in_c, out_c, kernel, stride, pad, dilate, groups)
+    (3, 8, (3, 3), (1, 1), (1, 1), (1, 1), 1),
+    (4, 6, (5, 3), (2, 1), (2, 0), (1, 1), 1),
+    (4, 8, (3, 3), (1, 1), (1, 1), (2, 2), 1),
+    (6, 6, (3, 3), (2, 2), (1, 1), (1, 1), 3),
+    (8, 8, (1, 1), (1, 1), (0, 0), (1, 1), 8),  # depthwise 1x1
+]
+
+
+@pytest.mark.parametrize("cin,cout,k,s,p,d,g", CONV_GRID)
+def test_convolution_vs_torch(cin, cout, k, s, p, d, g, rng):
+    x = rng.standard_normal((2, cin, 12, 12)).astype(onp.float32)
+    w = (rng.standard_normal((cout, cin // g) + k) * 0.2).astype(onp.float32)
+    b = rng.standard_normal((cout,)).astype(onp.float32)
+    got = _np(nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                             kernel=k, stride=s, pad=p, dilate=d,
+                             num_filter=cout, num_group=g))
+    exp = F.conv2d(_t(x), _t(w), _t(b), stride=s, padding=p, dilation=d,
+                   groups=g).numpy()
+    onp.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+@pytest.mark.parametrize("k,s,p", [((2, 2), (2, 2), (0, 0)),
+                                   ((3, 3), (2, 2), (1, 1)),
+                                   ((3, 2), (1, 2), (0, 1))])
+def test_pooling_vs_torch(ptype, k, s, p, rng):
+    x = rng.standard_normal((2, 3, 10, 10)).astype(onp.float32)
+    got = _np(nd.Pooling(nd.array(x), kernel=k, stride=s, pad=p,
+                         pool_type=ptype))
+    if ptype == "max":
+        exp = F.max_pool2d(_t(x), k, stride=s, padding=p).numpy()
+    else:
+        exp = F.avg_pool2d(_t(x), k, stride=s, padding=p,
+                           count_include_pad=True).numpy()
+    onp.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-5)
+
+
+def test_global_and_deconv_vs_torch(rng):
+    x = rng.standard_normal((2, 4, 7, 9)).astype(onp.float32)
+    got = _np(nd.Pooling(nd.array(x), global_pool=True, pool_type="avg"))
+    exp = _t(x).mean(dim=(2, 3), keepdim=True).numpy()
+    onp.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+
+    w = (rng.standard_normal((4, 5, 3, 3)) * 0.2).astype(onp.float32)
+    got = _np(nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                               stride=(2, 2), pad=(1, 1), num_filter=5,
+                               no_bias=True))
+    exp = F.conv_transpose2d(_t(x), _t(w), stride=2, padding=1).numpy()
+    onp.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-4)
+
+
+def test_norms_vs_torch(rng):
+    x = rng.standard_normal((4, 6, 5, 5)).astype(onp.float32)
+    g = (rng.standard_normal((6,)) * 0.1 + 1).astype(onp.float32)
+    b = rng.standard_normal((6,)).astype(onp.float32)
+
+    # train-mode BN (batch stats)
+    mm = onp.zeros(6, "f")
+    mv = onp.ones(6, "f")
+    with mx.autograd.record(train_mode=True):
+        got = _np(mx.npx.batch_norm(
+            mx.np.array(x), mx.np.array(g), mx.np.array(b),
+            mx.np.array(mm), mx.np.array(mv), eps=1e-5, fix_gamma=False))
+    exp = F.batch_norm(_t(x), None, None, _t(g), _t(b), training=True,
+                       eps=1e-5).numpy()
+    onp.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-4)
+
+    # inference BN (running stats)
+    rmean = rng.standard_normal((6,)).astype(onp.float32)
+    rvar = (onp.abs(rng.standard_normal((6,))) + 0.5).astype(onp.float32)
+    got = _np(mx.npx.batch_norm(
+        mx.np.array(x), mx.np.array(g), mx.np.array(b),
+        mx.np.array(rmean), mx.np.array(rvar), eps=1e-5, fix_gamma=False))
+    exp = F.batch_norm(_t(x), _t(rmean), _t(rvar), _t(g), _t(b),
+                       training=False, eps=1e-5).numpy()
+    onp.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-4)
+
+    # layer norm over last axis
+    xl = rng.standard_normal((3, 7, 16)).astype(onp.float32)
+    gl = (rng.standard_normal((16,)) * 0.1 + 1).astype(onp.float32)
+    bl = rng.standard_normal((16,)).astype(onp.float32)
+    got = _np(mx.npx.layer_norm(mx.np.array(xl), mx.np.array(gl),
+                                mx.np.array(bl), axis=-1, eps=1e-5))
+    exp = F.layer_norm(_t(xl), (16,), _t(gl), _t(bl), eps=1e-5).numpy()
+    onp.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-4)
+
+    # group norm
+    got = _np(mx.npx.group_norm(mx.np.array(x), mx.np.array(g),
+                                mx.np.array(b), num_groups=3, eps=1e-5))
+    exp = F.group_norm(_t(x), 3, _t(g), _t(b), eps=1e-5).numpy()
+    onp.testing.assert_allclose(got, exp, rtol=2e-4, atol=2e-4)
+
+
+def test_activations_and_softmax_vs_torch(rng):
+    x = rng.standard_normal((4, 9)).astype(onp.float32)
+    pairs = [
+        (lambda a: nd.Activation(a, act_type="relu"), F.relu),
+        (lambda a: nd.Activation(a, act_type="sigmoid"), torch.sigmoid),
+        (lambda a: nd.Activation(a, act_type="tanh"), torch.tanh),
+        (lambda a: nd.Activation(a, act_type="softrelu"), F.softplus),
+        (lambda a: nd.LeakyReLU(a, act_type="leaky", slope=0.1),
+         lambda t: F.leaky_relu(t, 0.1)),
+        (lambda a: nd.LeakyReLU(a, act_type="elu", slope=1.0),
+         lambda t: F.elu(t, 1.0)),
+        (lambda a: nd.softmax(a, axis=-1),
+         lambda t: F.softmax(t, dim=-1)),
+        (lambda a: nd.log_softmax(a, axis=-1),
+         lambda t: F.log_softmax(t, dim=-1)),
+        (lambda a: nd.softsign(a), F.softsign),
+    ]
+    for ours, theirs in pairs:
+        onp.testing.assert_allclose(
+            _np(ours(nd.array(x))), theirs(_t(x)).numpy(),
+            rtol=1e-5, atol=1e-6)
+
+
+def test_conv_backward_vs_torch(rng):
+    """Gradients of conv w.r.t. data/weight/bias against torch autograd."""
+    x = rng.standard_normal((2, 3, 8, 8)).astype(onp.float32)
+    w = (rng.standard_normal((4, 3, 3, 3)) * 0.3).astype(onp.float32)
+    b = rng.standard_normal((4,)).astype(onp.float32)
+
+    xa, wa, ba = mx.np.array(x), mx.np.array(w), mx.np.array(b)
+    for a in (xa, wa, ba):
+        a.attach_grad()
+    with mx.autograd.record():
+        out = nd.Convolution(xa, wa, ba, kernel=(3, 3), num_filter=4,
+                             stride=(2, 2), pad=(1, 1))
+        loss = (out * out).sum()
+    loss.backward()
+
+    xt = _t(x).requires_grad_(True)
+    wt = _t(w).requires_grad_(True)
+    bt = _t(b).requires_grad_(True)
+    out_t = F.conv2d(xt, wt, bt, stride=2, padding=1)
+    (out_t * out_t).sum().backward()
+
+    onp.testing.assert_allclose(_np(xa.grad), xt.grad.numpy(),
+                                rtol=1e-3, atol=1e-3)
+    onp.testing.assert_allclose(_np(wa.grad), wt.grad.numpy(),
+                                rtol=1e-3, atol=1e-3)
+    onp.testing.assert_allclose(_np(ba.grad), bt.grad.numpy(),
+                                rtol=1e-3, atol=1e-3)
+
+
+def test_bn_backward_vs_torch(rng):
+    """The hand-written single-pass BN VJP against torch autograd."""
+    x = rng.standard_normal((4, 5, 6, 6)).astype(onp.float32)
+    g = (rng.standard_normal((5,)) * 0.1 + 1).astype(onp.float32)
+    b = rng.standard_normal((5,)).astype(onp.float32)
+
+    xa, ga, ba = mx.np.array(x), mx.np.array(g), mx.np.array(b)
+    for a in (xa, ga, ba):
+        a.attach_grad()
+    cot = rng.standard_normal((4, 5, 6, 6)).astype(onp.float32)
+    with mx.autograd.record(train_mode=True):
+        out = mx.npx.batch_norm(xa, ga, ba,
+                                mx.np.array(onp.zeros(5, "f")),
+                                mx.np.array(onp.ones(5, "f")),
+                                eps=1e-5, fix_gamma=False)
+    out.backward(mx.np.array(cot))
+
+    xt = _t(x).requires_grad_(True)
+    gt = _t(g).requires_grad_(True)
+    bt = _t(b).requires_grad_(True)
+    out_t = F.batch_norm(xt, None, None, gt, bt, training=True, eps=1e-5)
+    out_t.backward(_t(cot))
+    onp.testing.assert_allclose(_np(xa.grad), xt.grad.numpy(),
+                                rtol=2e-3, atol=2e-4)
+    onp.testing.assert_allclose(_np(ga.grad), gt.grad.numpy(),
+                                rtol=2e-3, atol=2e-4)
+    onp.testing.assert_allclose(_np(ba.grad), bt.grad.numpy(),
+                                rtol=2e-3, atol=2e-4)
