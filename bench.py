@@ -111,11 +111,28 @@ def _ensure_bench_rec(n_images=2048, side=256):
     return path
 
 
+RITERS = 20  # recordio window length: the tunnel H2D may be seconds/batch
+
+
+def _timeit(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def _bench_recordio(batch):
-    """ResNet-50 bf16 training fed by the NATIVE RecordIO pipeline
-    (VERDICT r1 #5): C++ JPEG decode threads -> NHWC uint8 -> normalize
-    on device (fused into the program) -> train step.  Decode overlaps
-    the async TPU step; throughput = min(decoder, chip)."""
+    """ResNet-50 bf16 training fed by the NATIVE RecordIO pipeline through
+    prefetch-to-device double buffering (``io.DevicePrefetcher``): C++ JPEG
+    decode threads -> NHWC uint8 -> async H2D for batch N+1 while step N
+    runs -> normalize on device (fused into the program) -> train step.
+
+    With overlap the steady-state law is max(decode, H2D, chip), not the
+    sum; all three component rates are measured and reported so the
+    end-to-end number can be judged against its own bound.  On this
+    environment the chip sits behind a network tunnel whose H2D bandwidth
+    (measured each run, often 8-30 MB/s) is the binding constraint — a real
+    TPU host feeds over PCIe at GB/s where decode would bind instead.  See
+    benchmark/IO_ANALYSIS.md."""
     import mxnet_tpu as mx
     from mxnet_tpu.gluon import loss as gloss
     from mxnet_tpu.gluon.block import HybridBlock
@@ -152,37 +169,91 @@ def _bench_recordio(batch):
                                kvstore="device")
     fused = mx.gluon.FusedTrainStep(mod, trainer)
 
+    pf = mx.io.DevicePrefetcher(it, depth=3, dtypes=(None, onp.int32))
+
     def step():
-        data, labels = it.next_arrays()
-        return fused(mx.np.array(data), mx.np.array(labels, dtype="int32"),
-                     batch_size=batch)
+        x, y = next(pf)
+        return fused(x, y, batch_size=batch)
 
     for _ in range(WARMUP):
         loss = step()
     loss.wait_to_read()
+    mx.waitall()
 
-    import mxnet_tpu as _mx
-    _mx.waitall()
-    # decoder-only rate for the bottleneck analysis; ITERS batches so the
-    # ring's ~3 pre-decoded slots don't inflate the number
+    # --- component rates for the overlap-bound analysis -----------------
+    # (1) decoder-only: ITERS batches so the ring's pre-decoded slots
+    #     don't inflate the number (pf keeps pulling concurrently; pause it
+    #     by measuring through the same prefetcher's source is unfair —
+    #     measure the raw iterator on a fresh handle instead)
+    it2 = mx.io.ImageRecordIter(
+        path_imgrec=rec, batch_size=batch, data_shape=(3, 224, 224),
+        rand_crop=True, rand_mirror=True, shuffle=True)
+    it2.next_arrays()
     t0 = time.perf_counter()
     for _ in range(ITERS):
-        it.next_arrays()
+        data, labels = it2.next_arrays()
     decode_rate = batch * ITERS / (time.perf_counter() - t0)
+    it2.close()
 
-    windows = []
-    for _window in range(3):
+    # (2) true H2D wire rate: K pipelined async puts, then a one-element
+    #     readback of the LAST one (this tunnel acks block_until_ready
+    #     early; only a value fetch proves the bytes landed; pipelining
+    #     amortizes the tunnel round-trip latency out of the estimate).
+    #     The shared tunnel's bandwidth drifts minute to minute, so the
+    #     probe runs before AND after the end-to-end windows; the bound
+    #     uses the best sample (the wire the windows could have seen).
+    import jax as _jax
+    mb = data.nbytes / 2 ** 20
+    buf = _jax.device_put(data)
+    onp.asarray(buf[0, 0, 0])
+    t_rtt = min(_timeit(lambda: onp.asarray(buf[0, 0, 0])) for _ in range(3))
+
+    def h2d_probe(K=4):
         t0 = time.perf_counter()
-        for _ in range(ITERS):
+        bufs = [_jax.device_put(data) for _ in range(K)]
+        onp.asarray(bufs[-1][0, 0, 0])  # wire is FIFO: last lands last
+        return max(time.perf_counter() - t0 - t_rtt, 1e-9) / K
+
+    t_h2d = h2d_probe()
+
+    # (3) chip-only: re-step on one device-resident batch
+    x0, y0 = next(pf)
+    for _ in range(2):
+        fused(x0, y0, batch_size=batch)
+    mx.waitall()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        fused(x0, y0, batch_size=batch)
+    mx.waitall()
+    chip_rate = batch * ITERS / (time.perf_counter() - t0)
+
+    # --- end-to-end through the prefetcher ------------------------------
+    # the ring holds `depth` pre-transferred batches at window start and
+    # (steady-state) at window end, so the preload bias cancels; RITERS
+    # >> depth keeps any residue small
+    windows = []
+    for _window in range(2):
+        t0 = time.perf_counter()
+        for _ in range(RITERS):
             step()
-        _mx.waitall()
-        windows.append(batch * ITERS / (time.perf_counter() - t0))
-    return windows, decode_rate
+        mx.waitall()
+        windows.append(batch * RITERS / (time.perf_counter() - t0))
+    t_h2d = min(t_h2d, h2d_probe())
+    h2d_rate = batch / t_h2d
+    pf.close()
+    bound = min(decode_rate, h2d_rate, chip_rate)
+    return windows, {
+        "decode_only_img_per_s": round(decode_rate, 2),
+        "h2d_mb_per_s": round(mb / t_h2d, 2),
+        "h2d_img_per_s": round(h2d_rate, 2),
+        "chip_only_img_per_s": round(chip_rate, 2),
+        "overlap_bound_img_per_s": round(bound, 2),
+    }
 
 
 def _attempt_recordio(batch):
     try:
-        windows, decode_rate = _bench_recordio(batch)
+        windows, comp = _bench_recordio(batch)
     except Exception as e:
         if "RESOURCE_EXHAUSTED" in str(e):
             sys.exit(42)
@@ -193,10 +264,12 @@ def _attempt_recordio(batch):
         "value": round(img_per_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_per_s / BASELINE_IMG_PER_S, 3),
+        "vs_overlap_bound": round(
+            img_per_s / comp["overlap_bound_img_per_s"], 3),
         "batch": batch,
-        "decode_only_img_per_s": round(decode_rate, 2),
         "window_img_per_s": [round(w, 2) for w in windows],
         "host_cpus": os.cpu_count(),
+        **comp,
     }))
 
 
@@ -250,19 +323,40 @@ def main():
     # OOMs (and the chip's HBM is shared), so each batch size runs in its
     # own subprocess; the first that fits wins
     import subprocess
-    for batch in BATCHES:
-        env = dict(os.environ, BENCH_BATCH=str(batch))
-        if recordio_mode:
-            env["BENCH_MODE"] = "recordio"
-        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              env=env, stdout=subprocess.PIPE, text=True)
-        if proc.returncode == 0:
-            sys.stdout.write(proc.stdout)
-            return
-        if proc.returncode != 42:
-            sys.stderr.write(proc.stdout)
-            sys.exit(proc.returncode)
-    raise RuntimeError("all batch sizes exhausted HBM")
+
+    def run_mode(mode):
+        for batch in BATCHES:
+            env = dict(os.environ, BENCH_BATCH=str(batch))
+            if mode == "recordio":
+                env["BENCH_MODE"] = "recordio"
+            else:
+                env.pop("BENCH_MODE", None)
+            proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                  env=env, stdout=subprocess.PIPE, text=True)
+            if proc.returncode == 0:
+                return json.loads(proc.stdout.strip().splitlines()[-1])
+            if proc.returncode != 42:
+                sys.stderr.write(proc.stdout)
+                sys.exit(proc.returncode)
+        raise RuntimeError("all batch sizes exhausted HBM")
+
+    if recordio_mode:
+        print(json.dumps(run_mode("recordio")))
+        return
+    result = run_mode("synthetic")
+    # the real-data number rides along in the same line (VERDICT r2 #1):
+    # recordio_* keys give end-to-end RecordIO-fed training plus the
+    # measured component rates (decode / tunnel H2D / chip) bounding it
+    try:
+        rec = run_mode("recordio")
+        result["recordio_img_per_s"] = rec["value"]
+        result["recordio_vs_overlap_bound"] = rec["vs_overlap_bound"]
+        for k in ("decode_only_img_per_s", "h2d_mb_per_s", "h2d_img_per_s",
+                  "chip_only_img_per_s", "overlap_bound_img_per_s"):
+            result[k] = rec[k]
+    except Exception as e:  # the headline must not die with the rider
+        result["recordio_error"] = str(e)[:200]
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -48,6 +48,29 @@ def _as_device_batch(batch):
     return batch
 
 
+def _lookahead_device(host_batches, depth):
+    """Issue async H2D transfers ``depth`` batches ahead of the consumer.
+
+    ``jax.device_put`` returns immediately with an in-flight buffer, so
+    converting batch N+1..N+depth *before* yielding batch N lets the wire
+    transfer ride concurrently with the consumer's device compute
+    (reference role: `src/io/iter_prefetcher.h:1`, DataLoader
+    ``pin_memory``)."""
+    from collections import deque
+    q = deque()
+    it = iter(host_batches)
+    exhausted = False
+    while True:
+        while not exhausted and len(q) <= depth:
+            try:
+                q.append(_as_device_batch(next(it)))
+            except StopIteration:
+                exhausted = True
+        if not q:
+            return
+        yield q.popleft()
+
+
 class _Worker:
     """Top-level callable so it pickles for multiprocessing."""
 
@@ -64,10 +87,13 @@ class DataLoader:
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=True, timeout=120,
-                 try_nopython=None, device=None):
+                 try_nopython=None, device=None, prefetch_to_device=False):
         self._dataset = dataset
         self._device = device
         self._pin_memory = pin_memory  # PjRt stages host transfers itself
+        # int = lookahead depth, True = 2 (double buffering)
+        self._prefetch_to_device = int(prefetch_to_device) * (
+            2 if prefetch_to_device is True else 1)
 
         if batch_sampler is None:
             if batch_size is None:
@@ -106,9 +132,17 @@ class DataLoader:
         return self._pool
 
     def __iter__(self):
+        if self._prefetch_to_device:
+            yield from _lookahead_device(self._host_batches(),
+                                         self._prefetch_to_device)
+        else:
+            for b in self._host_batches():
+                yield _as_device_batch(b)
+
+    def _host_batches(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
-                yield _as_device_batch(self._worker(indices))
+                yield self._worker(indices)
             return
 
         pool = self._get_pool()
@@ -126,14 +160,12 @@ class DataLoader:
                 pending.append(submit(indices))
                 if len(pending) >= max_inflight:
                     fut = pending.pop(0)
-                    yield _as_device_batch(
-                        fut.result(self._timeout) if self._thread_pool
-                        else fut.get(self._timeout))
+                    yield (fut.result(self._timeout) if self._thread_pool
+                           else fut.get(self._timeout))
             while pending:
                 fut = pending.pop(0)
-                yield _as_device_batch(
-                    fut.result(self._timeout) if self._thread_pool
-                    else fut.get(self._timeout))
+                yield (fut.result(self._timeout) if self._thread_pool
+                       else fut.get(self._timeout))
         finally:
             for fut in pending:
                 if self._thread_pool:

@@ -112,6 +112,19 @@ class NDArray:
 
     wait_to_write = wait_to_read
 
+    def prefetch_to(self, ctx):
+        """Start an asynchronous copy of this array to ``ctx`` and return
+        the destination NDArray immediately (reference role:
+        `src/io/iter_prefetcher.h:1` / DataLoader ``pin_memory``).
+
+        The returned array's buffer is in flight; any computation consuming
+        it is ordered by PjRt after the transfer completes, so issuing
+        ``prefetch_to`` for batch N+1 before dispatching step N overlaps
+        the H2D wire time with device compute."""
+        from ..context import Context
+        c = Context(ctx)
+        return NDArray(jax.device_put(self._data, c.jax_device()), ctx=c)
+
     # ------------------------------------------------------------------
     # basic properties
     # ------------------------------------------------------------------
