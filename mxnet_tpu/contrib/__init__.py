@@ -4,8 +4,25 @@ from ..ops.contrib import (box_iou, box_nms, bipartite_matching, roi_align,
                            multibox_prior, multibox_target,
                            multibox_detection, boolean_mask, allclose,
                            index_copy, index_add, index_array,
-                           circ_conv, k_smallest_flags, hawkes_ll)
+                           circ_conv, k_smallest_flags, hawkes_ll,
+                           interleaved_matmul_selfatt_qk,
+                           interleaved_matmul_selfatt_valatt,
+                           interleaved_matmul_encdec_qk,
+                           interleaved_matmul_encdec_valatt)
+# control flow lives under mx.nd.contrib in the reference
+# (`python/mxnet/ndarray/contrib.py`: foreach/while_loop/cond)
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
 from . import text
+
+
+def div_sqrt_dim(data):
+    """Rescale by 1/sqrt(last-dim) (reference `_contrib_div_sqrt_dim`,
+    `src/operator/contrib/transformer.cc`)."""
+    import math
+
+    from ..ops.invoke import invoke
+    return invoke(lambda x: x / math.sqrt(x.shape[-1]), (data,),
+                  name="div_sqrt_dim")
 
 # reference CamelCase aliases (mx.nd.contrib.ROIAlign)
 ROIAlign = roi_align
@@ -16,4 +33,7 @@ MultiBoxTarget = multibox_target
 __all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
            "ROIAlign", "multibox_prior", "MultiBoxPrior", "multibox_target", "MultiBoxTarget", "multibox_detection", "MultiBoxDetection",
            "boolean_mask", "allclose", "index_copy", "index_add", "index_array",
-           "circ_conv", "k_smallest_flags", "hawkes_ll"]
+           "circ_conv", "k_smallest_flags", "hawkes_ll",
+           "foreach", "while_loop", "cond", "div_sqrt_dim",
+           "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+           "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt"]

@@ -17,11 +17,21 @@ def _lazy_np():
 
 
 def __getattr__(name):
+    import importlib
+    # sub-namespaces (reference `python/mxnet/ndarray/contrib.py`,
+    # `ndarray/image.py`, `ndarray/linalg.py`): mx.nd.contrib.box_nms,
+    # mx.nd.image.to_tensor, mx.nd.linalg.gemm2, ...
+    if name == "linalg":
+        return importlib.import_module(".linalg", __name__)
+    if name == "image":
+        return importlib.import_module(".image", __name__)
+    if name == "contrib":
+        from .. import contrib as _contrib
+        return _contrib
     # the generated legacy op surface (reference
     # `python/mxnet/ndarray/register.py:265-277`) takes precedence: its
     # arg conventions (exclude=, special reshape codes, CamelCase layer
     # ops, mutate-output optimizer kernels) differ from mx.np
-    import importlib
     _legacy = importlib.import_module(".legacy", __name__)
     if name == "legacy":
         return _legacy

@@ -1,0 +1,35 @@
+"""``mx.nd.image`` — NDArray-facing image operator namespace.
+
+Reference: `python/mxnet/ndarray/image.py` (generated from
+`src/operator/image/`).  Kernels live in `mxnet_tpu/ops/image_ops.py`;
+this module routes NDArrays through the imperative ``invoke`` path so the
+ops participate in the tape/profiler like any other operator.
+"""
+from __future__ import annotations
+
+from ..ops import image_ops as _im
+from ..ops.invoke import invoke
+
+__all__ = list(_im.__all__)
+
+# randomized ops draw host scalars at dispatch; none are differentiable
+# except to_tensor/normalize/resize/crop, which jnp handles through vjp
+_NON_DIFF = {"random_flip_left_right", "random_flip_top_bottom"}
+
+
+def _wrap(name):
+    jf = getattr(_im, name)
+
+    def fn(*args, **kwargs):
+        kwargs.pop("out", None)
+        return invoke(jf, args, kwargs, name=f"image_{name}",
+                      differentiable=name not in _NON_DIFF)
+
+    fn.__name__ = name
+    fn.__doc__ = jf.__doc__
+    return fn
+
+
+_g = globals()
+for _name in __all__:
+    _g[_name] = _wrap(_name)

@@ -1,0 +1,41 @@
+"""``mx.nd.linalg`` — the legacy la_op operator family.
+
+Reference: `python/mxnet/ndarray/linalg.py` (generated from
+`src/operator/tensor/la_op.cc:29-1050`).  NDArray in / NDArray out via the
+imperative ``invoke`` path; kernels live in `mxnet_tpu/ops/la_op.py`.
+NumPy-style names (`mx.np.linalg.*`) remain available as fallthrough for
+scripts that used the aliased surface.
+"""
+from __future__ import annotations
+
+from ..ops import la_op as _la
+from ..ops.invoke import invoke
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "syrk",
+           "gelqf", "syevd", "sumlogdiag", "extractdiag", "makediag",
+           "extracttrian", "maketrian", "inverse", "det", "slogdet"]
+
+
+def _wrap(name):
+    jf = getattr(_la, name)
+
+    def fn(*args, **kwargs):
+        kwargs.pop("out", None)  # reference out= is write-to; rebind covers
+        return invoke(jf, args, kwargs, name=f"linalg_{name}")
+
+    fn.__name__ = name
+    fn.__doc__ = jf.__doc__
+    return fn
+
+
+_g = globals()
+for _name in __all__:
+    _g[_name] = _wrap(_name)
+
+
+def __getattr__(name):
+    from ..numpy import linalg as _np_linalg
+    if hasattr(_np_linalg, name):
+        return getattr(_np_linalg, name)
+    raise AttributeError(
+        f"module 'mxnet_tpu.ndarray.linalg' has no attribute {name!r}")

@@ -36,6 +36,7 @@ __all__ = [
     "ravel_multi_index", "unravel_index", "make_loss", "multi_all_finite",
     "reset_arrays", "grid_generator", "bilinear_sampler",
     "spatial_transformer", "roi_pooling", "im2col", "col2im",
+    "reshape", "nonzero", "index_add", "index_update", "constraint_check",
 ]
 
 seed = _rng.seed
@@ -196,3 +197,146 @@ def save(fname, data):
 def load(fname, ctx=None):
     from ..utils.serialization import load_ndarrays
     return load_ndarrays(fname, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# npx.reshape with data-manipulation codes -1..-6
+# (reference `_npx_reshape`, `src/operator/numpy/np_matrix_op.cc:202-312`
+# NumpyXReshapeInferShape; doc `python/mxnet/_numpy_op_doc.py:563`)
+# ---------------------------------------------------------------------------
+def _npx_reshape_infer(src, target):
+    """Resolve a newshape containing codes -1..-6 against static ``src``."""
+    out = []
+    unknown_axis = -1
+    known_prod = 1
+    src_inx = 0
+    i = 0
+    n = len(target)
+    while i < n:
+        d = target[i]
+        if d == -1:
+            if unknown_axis >= 0:
+                raise ValueError("One and only one dim can be inferred")
+            unknown_axis = len(out)
+            out.append(-1)
+            src_inx += 1
+        elif d == -2:
+            out.append(src[src_inx])
+            known_prod *= src[src_inx]
+            src_inx += 1
+        elif d == -3:
+            if src[src_inx] != 1:
+                raise ValueError(
+                    "-3 index should only be used to skip dimension size 1")
+            src_inx += 1
+        elif d == -4:
+            while src_inx < len(src):
+                known_prod *= src[src_inx]
+                out.append(src[src_inx])
+                src_inx += 1
+        elif d == -5:
+            d1, d2 = src[src_inx], src[src_inx + 1]
+            src_inx += 2
+            known_prod *= d1 * d2
+            out.append(d1 * d2)
+        elif d == -6:
+            d0 = src[src_inx]
+            src_inx += 1
+            d1, d2 = target[i + 1], target[i + 2]
+            i += 2
+            if d1 == -1 and d2 == -1:
+                raise ValueError("Split dims cannot both be -1.")
+            if d1 == -1:
+                d1 = d0 // d2
+            if d2 == -1:
+                d2 = d0 // d1
+            if d1 * d2 != d0:
+                raise ValueError(
+                    f"Split dims {d1}, {d2} do not divide original dim {d0}")
+            known_prod *= d0
+            out.extend([d1, d2])
+        elif d >= 0:
+            known_prod *= d
+            out.append(d)
+            src_inx += 1
+        else:
+            raise ValueError(f"Dimension size must be >= -6, got {d}")
+        i += 1
+    if unknown_axis >= 0:
+        total = 1
+        for s in src:
+            total *= s
+        if known_prod == 0 or total % known_prod:
+            raise ValueError(
+                f"cannot reshape {tuple(src)} into {tuple(target)}")
+        out[unknown_axis] = total // known_prod
+    return tuple(out)
+
+
+def reshape(a, newshape, reverse=False, order="C"):
+    """Reshape with the reference's -1..-6 manipulation codes
+    (`_npx_reshape`); ``reverse=True`` resolves codes right-to-left."""
+    if isinstance(newshape, int):
+        newshape = (newshape,)
+    src = tuple(int(s) for s in a.shape)
+    tgt = tuple(int(t) for t in newshape)
+    if reverse:
+        shape = _npx_reshape_infer(src[::-1], tgt[::-1])[::-1]
+    else:
+        shape = _npx_reshape_infer(src, tgt)
+    return invoke(lambda x: jnp.reshape(x, shape), (a,), name="npx_reshape")
+
+
+def nonzero(a):
+    """Indices of nonzero elements as an (N, ndim) int64-style tensor
+    (reference `_npx_nonzero`, `src/operator/numpy/np_nonzero_op.cc`).
+    Data-dependent output shape: eager-only (documented XLA gap; the
+    reference GPU op synchronizes for the count the same way)."""
+    import numpy as _onp
+
+    host = _onp.asarray(a._data if isinstance(a, NDArray) else a)
+    idx = _onp.argwhere(host)
+    from ..numpy import array as _array
+    return _array(idx.astype(_onp.int64))
+
+
+def index_add(a, ind, val):
+    """Scatter-add ``val`` at positions ``ind`` (reference
+    `_npx_index_add`, doc `python/mxnet/_numpy_op_doc.py:629`): ``ind`` is
+    (ndim_indexed, N) — column k addresses one position; repeated
+    positions accumulate."""
+    def f(x, indices, v):
+        cols = tuple(indices[i] for i in range(indices.shape[0]))
+        vb = jnp.broadcast_to(
+            v, (indices.shape[1],) + x.shape[indices.shape[0]:]) \
+            if v.ndim < x.ndim - indices.shape[0] + 1 else v
+        return x.at[cols].add(vb.astype(x.dtype))
+
+    return invoke(f, (a, ind, val), name="index_add")
+
+
+def index_update(a, ind, val):
+    """Scatter-set variant of :func:`index_add` (reference
+    `_npx_index_update`); last write wins on duplicates."""
+    def f(x, indices, v):
+        cols = tuple(indices[i] for i in range(indices.shape[0]))
+        vb = jnp.broadcast_to(
+            v, (indices.shape[1],) + x.shape[indices.shape[0]:]) \
+            if v.ndim < x.ndim - indices.shape[0] + 1 else v
+        return x.at[cols].set(vb.astype(x.dtype))
+
+    return invoke(f, (a, ind, val), name="index_update")
+
+
+def constraint_check(data, msg="Constraint violated!"):
+    """All-true check on a boolean tensor (reference
+    `_npx_constraint_check`, `src/operator/numpy/np_constraint_check.cc`):
+    raises ValueError(msg) if any element is False, else returns
+    scalar True so it can be multiplied into the graph."""
+    import numpy as _onp
+
+    host = _onp.asarray(data._data if isinstance(data, NDArray) else data)
+    if not bool(host.all()):
+        raise ValueError(msg)
+    from ..numpy import array as _array
+    return _array(True)

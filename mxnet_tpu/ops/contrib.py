@@ -540,3 +540,80 @@ def hawkes_ll(mu, alpha, beta, state, lags, marks, valid_length, max_time):
 
     return invoke(f, (mu, alpha, beta, state, lags, marks, valid_length,
                       max_time), name="hawkes_ll")
+
+
+# ---------------------------------------------------------------------------
+# Interleaved multi-head attention matmuls
+# (reference `src/operator/contrib/transformer.cc:650-830` — the fused
+# projections layout GluonNLP's transformer uses: a single tensor of
+# interleaved q/k/v projections, (seq, batch, heads*head_dim*3))
+# ---------------------------------------------------------------------------
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
+    """(seq, batch, H*D*3) -> scaled q@k^T scores (batch*H, seq, seq)."""
+    def f(qkv):
+        s, b, lin = qkv.shape
+        d = lin // (3 * heads)
+        tmp = qkv.reshape(s, b, heads, 3, d)
+        q = jnp.transpose(tmp[:, :, :, 0, :], (1, 2, 0, 3))
+        q = q.reshape(b * heads, s, d) / jnp.sqrt(jnp.asarray(d, qkv.dtype))
+        k = jnp.transpose(tmp[:, :, :, 1, :], (1, 2, 0, 3))
+        k = k.reshape(b * heads, s, d)
+        return jnp.einsum("bqd,bkd->bqk", q, k)
+
+    return invoke(f, (queries_keys_values,),
+                  name="interleaved_matmul_selfatt_qk")
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads):
+    """attention @ v back to (seq, batch, H*D)."""
+    def f(qkv, att):
+        s, b, lin = qkv.shape
+        d = lin // (3 * heads)
+        tmp = qkv.reshape(s, b, heads, 3, d)
+        v = jnp.transpose(tmp[:, :, :, 2, :], (1, 2, 0, 3))
+        v = v.reshape(b * heads, s, d)
+        out = jnp.matmul(att, v)                      # (b*H, q_seq, d)
+        q_seq = att.shape[1]
+        out = out.reshape(b, heads, q_seq, d)
+        out = jnp.transpose(out, (2, 0, 1, 3))
+        return out.reshape(q_seq, b, heads * d)
+
+    return invoke(f, (queries_keys_values, attention),
+                  name="interleaved_matmul_selfatt_valatt")
+
+
+def interleaved_matmul_encdec_qk(queries, keys_values, heads):
+    """queries (q_seq, batch, H*D), keys_values (kv_seq, batch, H*D*2) ->
+    (batch*H, q_seq, kv_seq)."""
+    def f(q_in, kv):
+        qs, b, lin_q = q_in.shape
+        d = lin_q // heads
+        ks = kv.shape[0]
+        q = jnp.transpose(q_in.reshape(qs, b, heads, d), (1, 2, 0, 3))
+        q = q.reshape(b * heads, qs, d) / jnp.sqrt(jnp.asarray(d, kv.dtype))
+        tmp = kv.reshape(ks, b, heads, 2, d)
+        k = jnp.transpose(tmp[:, :, :, 0, :], (1, 2, 0, 3))
+        k = k.reshape(b * heads, ks, d)
+        return jnp.einsum("bqd,bkd->bqk", q, k)
+
+    return invoke(f, (queries, keys_values),
+                  name="interleaved_matmul_encdec_qk")
+
+
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads):
+    """attention (batch*H, q_seq, kv_seq) @ v from keys_values ->
+    (q_seq, batch, H*D)."""
+    def f(kv, att):
+        ks, b, lin = kv.shape
+        d = lin // (2 * heads)
+        tmp = kv.reshape(ks, b, heads, 2, d)
+        v = jnp.transpose(tmp[:, :, :, 1, :], (1, 2, 0, 3))
+        v = v.reshape(b * heads, ks, d)
+        out = jnp.matmul(att, v)
+        q_seq = att.shape[1]
+        out = out.reshape(b, heads, q_seq, d)
+        out = jnp.transpose(out, (2, 0, 1, 3))
+        return out.reshape(q_seq, b, heads * d)
+
+    return invoke(f, (keys_values, attention),
+                  name="interleaved_matmul_encdec_valatt")

@@ -1,0 +1,275 @@
+"""Device-side image operator family (``mx.nd.image.*``).
+
+Reference: `src/operator/image/image_random.cc` + `resize.cc` + `crop.cc`
+(ops `_image_to_tensor`, `_image_normalize`, flips, random color jitters,
+`_image_adjust_lighting`, `_image_resize`, `_image_crop`, ...).  The
+reference runs per-pixel C++/CUDA loops; here each op is a vectorized jnp
+function (HWC or NHWC input, channel-last, matching the reference's
+layout contract) so XLA fuses the whole augmentation chain.
+
+Randomized variants draw their scalars from the HOST rng
+(`mxnet_tpu.random`) at dispatch time — data-independent, so each call
+traces to the same XLA program with a different constant, exactly like
+the reference's per-call mshadow RNG.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .invoke import invoke
+
+__all__ = [
+    "to_tensor", "normalize", "flip_left_right", "flip_top_bottom",
+    "random_flip_left_right", "random_flip_top_bottom",
+    "random_brightness", "random_contrast", "random_saturation",
+    "random_hue", "random_color_jitter", "adjust_lighting",
+    "random_lighting", "resize", "crop", "random_crop",
+    "random_resized_crop",
+]
+
+_GRAY = jnp.array([0.299, 0.587, 0.114])  # image_random-inl.h:703
+
+# AlexNet PCA lighting eigen table (image_random-inl.h:1021-1025)
+_EIG = onp.array([
+    [55.46 * -0.5675, 4.794 * 0.7192, 1.148 * 0.4009],
+    [55.46 * -0.5808, 4.794 * -0.0045, 1.148 * -0.8140],
+    [55.46 * -0.5836, 4.794 * -0.6948, 1.148 * 0.4203],
+], onp.float32)
+
+
+def _rng():
+    from .. import random as _r
+    return _r.host_rng()
+
+
+def _sat_like(x, ref):
+    if onp.issubdtype(onp.dtype(str(ref.dtype)), onp.integer):
+        info = onp.iinfo(str(ref.dtype))
+        return jnp.clip(jnp.round(x), info.min, info.max).astype(ref.dtype)
+    return x.astype(ref.dtype)
+
+
+# -- layout transforms --------------------------------------------------
+def to_tensor(x):
+    """HWC [0,255] -> CHW float32 [0,1] (`image_random.cc:42`)."""
+    y = x.astype(jnp.float32) / 255.0
+    perm = (2, 0, 1) if x.ndim == 3 else (0, 3, 1, 2)
+    return jnp.transpose(y, perm)
+
+
+def normalize(x, mean=0.0, std=1.0):
+    """Channel-first input (C,H,W)/(N,C,H,W) (`image_random.cc:107`)."""
+    mean = jnp.asarray(mean, x.dtype)
+    std = jnp.asarray(std, x.dtype)
+    if mean.ndim:
+        mean = mean.reshape((-1, 1, 1))
+    if std.ndim:
+        std = std.reshape((-1, 1, 1))
+    return (x - mean) / std
+
+
+# -- flips --------------------------------------------------------------
+def flip_left_right(x):
+    return jnp.flip(x, axis=-2)
+
+
+def flip_top_bottom(x):
+    return jnp.flip(x, axis=-3)
+
+
+def random_flip_left_right(x, p=0.5):
+    return flip_left_right(x) if _rng().uniform() < p else x
+
+
+def random_flip_top_bottom(x, p=0.5):
+    return flip_top_bottom(x) if _rng().uniform() < p else x
+
+
+# -- photometric jitters ------------------------------------------------
+def _adjust_brightness(x, alpha):
+    return _sat_like(x.astype(jnp.float32) * alpha, x)
+
+
+def _adjust_contrast(x, alpha):
+    # reference: blend with the mean gray level of the image
+    f = x.astype(jnp.float32)
+    gray_mean = jnp.mean(jnp.tensordot(f, _GRAY, axes=([-1], [0])),
+                         axis=(-2, -1), keepdims=True)[..., None]
+    return _sat_like(f * alpha + gray_mean * (1.0 - alpha), x)
+
+
+def _adjust_saturation(x, alpha):
+    f = x.astype(jnp.float32)
+    gray = jnp.tensordot(f, _GRAY, axes=([-1], [0]))[..., None]
+    return _sat_like(f * alpha + gray * (1.0 - alpha), x)
+
+
+def _rgb_to_hls(r, g, b):
+    """Vectorized OpenCV-convention RGB->HLS on [0,1] (reference
+    RGB2HLSConvert, `image_random-inl.h:800+`); h in degrees [0,360)."""
+    maxc = jnp.maximum(jnp.maximum(r, g), b)
+    minc = jnp.minimum(jnp.minimum(r, g), b)
+    l = (maxc + minc) * 0.5
+    delta = maxc - minc
+    s_den = jnp.where(l <= 0.5, maxc + minc, 2.0 - maxc - minc)
+    s = jnp.where(delta > 0, delta / jnp.where(s_den == 0, 1.0, s_den), 0.0)
+    dnz = jnp.where(delta == 0, 1.0, delta)
+    rc = (maxc - r) / dnz
+    gc = (maxc - g) / dnz
+    bc = (maxc - b) / dnz
+    h = jnp.where(r == maxc, bc - gc,
+                  jnp.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h * 60.0) % 360.0
+    h = jnp.where(delta == 0, 0.0, h)
+    return h, l, s
+
+
+def _hls_to_rgb(h, l, s):
+    p2 = jnp.where(l <= 0.5, l * (1 + s), l + s - l * s)
+    p1 = 2 * l - p2
+
+    def chan(hh):
+        hh = hh % 360.0 / 60.0
+        sector = jnp.floor(hh)
+        frac = hh - sector
+        up = p1 + (p2 - p1) * frac
+        down = p1 + (p2 - p1) * (1 - frac)
+        return jnp.select(
+            [sector < 1, sector < 2, sector < 3, sector < 4, sector < 5],
+            [up, p2, p2, down, p1], p1)
+
+    r = chan(h + 120.0)
+    g = chan(h)
+    b = chan(h - 120.0)
+    zero_s = s == 0
+    return (jnp.where(zero_s, l, r), jnp.where(zero_s, l, g),
+            jnp.where(zero_s, l, b))
+
+
+def _adjust_hue(x, alpha):
+    """Rotate hue by ``alpha*360`` degrees via HLS (reference
+    AdjustHueImpl, `image_random-inl.h:885-911`)."""
+    f = x.astype(jnp.float32) / 255.0
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    h, l, s = _rgb_to_hls(r, g, b)
+    h = h + alpha * 360.0
+    r2, g2, b2 = _hls_to_rgb(h, l, s)
+    out = jnp.stack([r2, g2, b2], axis=-1) * 255.0
+    return _sat_like(out, x)
+
+
+def random_brightness(x, min_factor, max_factor):
+    return _adjust_brightness(x, float(_rng().uniform(min_factor, max_factor)))
+
+
+def random_contrast(x, min_factor, max_factor):
+    return _adjust_contrast(x, float(_rng().uniform(min_factor, max_factor)))
+
+
+def random_saturation(x, min_factor, max_factor):
+    return _adjust_saturation(x, float(_rng().uniform(min_factor, max_factor)))
+
+
+def random_hue(x, min_factor, max_factor):
+    return _adjust_hue(x, float(_rng().uniform(min_factor, max_factor)))
+
+
+def random_color_jitter(x, brightness=0.0, contrast=0.0, saturation=0.0,
+                        hue=0.0):
+    """Apply the four jitters in random order (`image_random.cc:252`)."""
+    rng = _rng()
+    ops = []
+    if brightness > 0:
+        ops.append(lambda y: _adjust_brightness(
+            y, float(rng.uniform(1 - brightness, 1 + brightness))))
+    if contrast > 0:
+        ops.append(lambda y: _adjust_contrast(
+            y, float(rng.uniform(1 - contrast, 1 + contrast))))
+    if saturation > 0:
+        ops.append(lambda y: _adjust_saturation(
+            y, float(rng.uniform(1 - saturation, 1 + saturation))))
+    if hue > 0:
+        ops.append(lambda y: _adjust_hue(
+            y, float(rng.uniform(-hue, hue))))
+    order = rng.permutation(len(ops)) if ops else []
+    for i in order:
+        x = ops[int(i)](x)
+    return x
+
+
+def adjust_lighting(x, alpha):
+    """PCA lighting shift (`image_random-inl.h:1016-1049`); HWC/NHWC."""
+    alpha = onp.asarray(alpha, onp.float32)
+    pca = _EIG @ alpha.reshape(3)
+    return _sat_like(x.astype(jnp.float32) + jnp.asarray(pca), x)
+
+
+def random_lighting(x, alpha_std=0.05):
+    alpha = _rng().normal(0.0, alpha_std, size=3)
+    return adjust_lighting(x, alpha)
+
+
+# -- geometry -----------------------------------------------------------
+def resize(x, size, keep_ratio=False, interp=1):
+    """Bilinear (interp=1) / nearest (0) resize, HWC or NHWC
+    (`src/operator/image/resize.cc`).  ``size``: int or (w, h)."""
+    batched = x.ndim == 4
+    h, w = (x.shape[1], x.shape[2]) if batched else (x.shape[0], x.shape[1])
+    if isinstance(size, int):
+        if keep_ratio:
+            if h > w:
+                ow, oh = size, int(h * size / w)
+            else:
+                ow, oh = int(w * size / h), size
+        else:
+            ow = oh = size
+    else:
+        ow, oh = size
+    method = "nearest" if interp == 0 else "linear"
+    if batched:
+        shape = (x.shape[0], oh, ow, x.shape[3])
+    else:
+        shape = (oh, ow, x.shape[2])
+    out = jax.image.resize(x.astype(jnp.float32), shape, method=method)
+    return _sat_like(out, x)
+
+
+def crop(x, x0, y0, width, height):
+    """Fixed crop at (x0, y0) of size (width, height), HWC/NHWC
+    (`src/operator/image/crop.cc`)."""
+    if x.ndim == 4:
+        return x[:, y0:y0 + height, x0:x0 + width, :]
+    return x[y0:y0 + height, x0:x0 + width, :]
+
+
+def random_crop(x, size):
+    """Random-position crop to (w, h) = ``size``."""
+    w, h = (size, size) if isinstance(size, int) else size
+    H, W = (x.shape[1], x.shape[2]) if x.ndim == 4 else x.shape[:2]
+    rng = _rng()
+    x0 = int(rng.integers(0, W - w + 1)) if hasattr(rng, "integers") \
+        else int(rng.randint(0, W - w + 1))
+    y0 = int(rng.integers(0, H - h + 1)) if hasattr(rng, "integers") \
+        else int(rng.randint(0, H - h + 1))
+    return crop(x, x0, y0, w, h)
+
+
+def random_resized_crop(x, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                        interp=1):
+    """Random area/aspect crop then resize (gluon RandomResizedCrop
+    contract)."""
+    H, W = (x.shape[1], x.shape[2]) if x.ndim == 4 else x.shape[:2]
+    rng = _rng()
+    uni = rng.uniform
+    for _ in range(10):
+        area = H * W * uni(*scale)
+        ar = uni(*ratio)
+        w = int(round(onp.sqrt(area * ar)))
+        h = int(round(onp.sqrt(area / ar)))
+        if w <= W and h <= H:
+            y0 = int(uni(0, H - h + 1))
+            x0 = int(uni(0, W - w + 1))
+            return resize(crop(x, x0, y0, w, h), size, interp=interp)
+    return resize(x, size, interp=interp)

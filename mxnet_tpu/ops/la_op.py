@@ -1,0 +1,173 @@
+"""Advanced linear-algebra operator family (BLAS3/LAPACK semantics).
+
+Reference: `src/operator/tensor/la_op.cc:29-1050` — the `_linalg_*` ops
+(gemm, gemm2, potrf, potri, trmm, trsm, syrk, gelqf, syevd, sumlogdiag,
+extractdiag, makediag, extracttrian, maketrian, inverse, det, slogdet).
+The reference dispatches to cuBLAS/LAPACK per batch element; here each op
+is a pure jnp/lax function over the trailing two dimensions (leading dims
+are batch), so XLA maps the matmuls onto the MXU and batches for free.
+
+All functions take/return raw jax arrays; the NDArray-facing namespace is
+`mxnet_tpu/ndarray/linalg.py` (mx.nd.linalg) via ``invoke``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax.scipy.linalg import solve_triangular as _solve_tri
+
+__all__ = [
+    "gemm", "gemm2", "potrf", "potri", "trmm", "trsm", "syrk",
+    "gelqf", "syevd", "sumlogdiag", "extractdiag", "makediag",
+    "extracttrian", "maketrian", "inverse", "det", "slogdet",
+]
+
+
+def _T(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+def _op(x, transpose):
+    return _T(x) if transpose else x
+
+
+def _swap_axis(x, axis):
+    """Move `axis` to the matrix-row position (-2), reference gemm `axis`
+    parameter (`la_op.cc:58-66` swapaxes equivalence)."""
+    if axis == -2 or axis == x.ndim - 2:
+        return x
+    return jnp.swapaxes(x, axis, -2)
+
+
+def gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+         beta=1.0, axis=-2):
+    A, B, C = (_swap_axis(x, axis) for x in (A, B, C))
+    out = alpha * jnp.matmul(_op(A, transpose_a), _op(B, transpose_b)) \
+        + beta * C
+    return _swap_axis(out, axis)
+
+
+def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    A, B = _swap_axis(A, axis), _swap_axis(B, axis)
+    out = alpha * jnp.matmul(_op(A, transpose_a), _op(B, transpose_b))
+    return _swap_axis(out, axis)
+
+
+def potrf(A, lower=True):
+    L = jnp.linalg.cholesky(A)
+    return L if lower else _T(L)
+
+
+def potri(A, lower=True):
+    """B^-1 from B's Cholesky factor A (`la_op.cc:240`): A^-T A^-1 when
+    lower, A^-1 A^-T when upper."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    Ainv = _solve_tri(A, eye, lower=lower)
+    if lower:
+        return jnp.matmul(_T(Ainv), Ainv)
+    return jnp.matmul(Ainv, _T(Ainv))
+
+
+def _tri_mask(A, lower):
+    return jnp.tril(A) if lower else jnp.triu(A)
+
+
+def trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    T = _op(_tri_mask(A, lower), transpose)
+    out = jnp.matmul(B, T) if rightside else jnp.matmul(T, B)
+    return alpha * out
+
+
+def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Solve op(A) X = alpha B (left) or X op(A) = alpha B (right),
+    A triangular (`la_op.cc:360`)."""
+    if rightside:
+        # X op(A) = aB  <=>  op(A)^T X^T = a B^T
+        sol = _solve_tri(A, alpha * _T(B), lower=lower,
+                         trans=0 if transpose else 1)
+        # trans flips the effective triangle: solve with op(A)^T
+        return _T(sol)
+    return _solve_tri(A, alpha * B, lower=lower, trans=1 if transpose else 0)
+
+
+def syrk(A, transpose=False, alpha=1.0):
+    At = _T(A)
+    return alpha * (jnp.matmul(At, A) if transpose else jnp.matmul(A, At))
+
+
+def gelqf(A):
+    """LQ factorization A = L Q for m x n with m <= n (`la_op.cc:752`):
+    computed as the QR of A^T (Q_lq = Q_qr^T, L = R^T)."""
+    Q1, R1 = jnp.linalg.qr(_T(A), mode="reduced")
+    return _T(R1), _T(Q1)
+
+
+def syevd(A):
+    """Symmetric eigendecomposition (`la_op.cc:824`): returns (U, L) with
+    A = U^T diag(L) U — rows of U are the eigenvectors."""
+    w, v = jnp.linalg.eigh(A)
+    return _T(v), w
+
+
+def sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+def extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+def makediag(A, offset=0):
+    n = A.shape[-1] + abs(offset)
+    d = A.shape[-1]
+    rows = onp.arange(d) + max(-offset, 0)
+    cols = onp.arange(d) + max(offset, 0)
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+def _trian_indices(n, offset, lower):
+    """Row-major (i, j) index arrays of the triangle selected by
+    offset/lower (`la_op.cc:569-640`): offset>0 upper wrt k-th
+    superdiagonal, offset<0 lower wrt k-th subdiagonal, offset=0 by
+    `lower`."""
+    i, j = onp.meshgrid(onp.arange(n), onp.arange(n), indexing="ij")
+    if offset > 0:
+        mask = (j - i) >= offset
+    elif offset < 0:
+        mask = (j - i) <= offset
+    else:
+        mask = (j <= i) if lower else (j >= i)
+    rows, cols = onp.nonzero(mask)  # row-major packing order
+    return rows, cols
+
+
+def extracttrian(A, offset=0, lower=True):
+    rows, cols = _trian_indices(A.shape[-1], offset, lower)
+    return A[..., rows, cols]
+
+
+def maketrian(A, offset=0, lower=True):
+    d = A.shape[-1]
+    # packed length d = m(m+1)/2 with m = n - |offset|
+    m = int((onp.sqrt(8 * d + 1) - 1) / 2 + 0.5)
+    assert m * (m + 1) // 2 == d, \
+        f"packed triangle length {d} is not triangular"
+    n = m + abs(offset)
+    rows, cols = _trian_indices(n, offset, lower)
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+def inverse(A):
+    return jnp.linalg.inv(A)
+
+
+def det(A):
+    return jnp.linalg.det(A)
+
+
+def slogdet(A):
+    sign, logabs = jnp.linalg.slogdet(A)
+    return sign, logabs

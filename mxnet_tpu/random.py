@@ -17,7 +17,8 @@ import threading
 import jax
 import jax.numpy as jnp
 
-__all__ = ["seed", "new_key", "key_stream_scope", "uniform", "normal", "randint"]
+__all__ = ["seed", "new_key", "key_stream_scope", "uniform", "normal",
+           "randint", "host_rng"]
 
 
 class _KeyState(threading.local):
@@ -25,9 +26,41 @@ class _KeyState(threading.local):
         self.root = jax.random.key(0)
         self.counter = 0
         self.stack = []  # traced KeyStream scopes
+        self.host = None  # lazy host-side Generator (image aug scalars)
+        self.host_seeded_with = None
 
 
 _state = _KeyState()
+
+# process-wide host seed so worker threads created AFTER mx.random.seed()
+# still derive deterministic streams (each thread gets its own Generator,
+# keyed by the global seed + a spawn index — numpy Generators are not
+# thread-safe to share).  _host_seed = (generation, seed) so re-seeding
+# with the same value still resets every thread's stream.
+_host_seed = [(0, None)]
+_host_spawn = [0]
+_host_lock = threading.Lock()
+
+
+def host_rng():
+    """Host-side numpy Generator for data-independent dispatch-time draws
+    (image augmentation factors, crop offsets) — deterministic per thread
+    once ``seed()`` has set the process-wide host seed (reference:
+    per-call mshadow host RNG, `src/resource.cc:93`).  Threads receive
+    independent streams spawned from the seed in thread-creation order."""
+    import numpy as onp
+    if _state.host is None or _state.host_seeded_with != _host_seed[0]:
+        gen, seed_val = _host_seed[0]
+        with _host_lock:
+            idx = _host_spawn[0]
+            _host_spawn[0] += 1
+        if seed_val is None:
+            _state.host = onp.random.default_rng()
+        else:
+            _state.host = onp.random.default_rng(
+                onp.random.SeedSequence(seed_val).spawn(idx + 1)[idx])
+        _state.host_seeded_with = _host_seed[0]
+    return _state.host
 
 
 class KeyStream:
@@ -45,8 +78,13 @@ class KeyStream:
 def seed(seed_state, ctx="all"):
     """Reference: `python/mxnet/random.py` `seed()`; ctx kept for API compat
     (XLA PRNG is device-independent so per-context seeding is a no-op)."""
+    import numpy as onp
     _state.root = jax.random.key(int(seed_state))
     _state.counter = 0
+    _host_seed[0] = (_host_seed[0][0] + 1, int(seed_state))
+    _host_spawn[0] = 0
+    _state.host = onp.random.default_rng(int(seed_state))
+    _state.host_seeded_with = _host_seed[0]
 
 
 def new_key():

@@ -458,5 +458,26 @@ def _populate():
     g["SliceChannel"] = _slice_channel_builder
     g["split"] = _slice_channel_builder
 
+    # sub-namespaces (reference `mx.sym.linalg/image/contrib`): builders
+    # lifted from the NDArray-facing modules
+    import types as _types
+
+    def _subns(prefix, mod, names):
+        ns = _types.SimpleNamespace()
+        for n in names:
+            fn = getattr(mod, n, None)
+            if callable(fn) and not isinstance(fn, type):
+                setattr(ns, n, _register(f"_{prefix}_{n}", fn))
+        return ns
+
+    from ..ndarray import image as _ndimage
+    from ..ndarray import linalg as _ndlinalg
+    from .. import contrib as _ndcontrib
+    g["linalg"] = _subns("linalg", _ndlinalg, _ndlinalg.__all__)
+    g["image"] = _subns("image", _ndimage, _ndimage.__all__)
+    g["contrib"] = _subns("contrib", _ndcontrib,
+                          [n for n in _ndcontrib.__all__
+                           if n not in ("foreach", "while_loop", "cond")])
+
 
 _populate()

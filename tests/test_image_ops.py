@@ -1,0 +1,225 @@
+"""mx.nd.image operator tests (reference
+`src/operator/image/image_random.cc` + doc examples) and npx extras
+(`_npx_reshape` codes, `_npx_index_add/update`, `_npx_nonzero`,
+`_npx_constraint_check`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _img(h=6, w=8):
+    return mx.np.array(
+        onp.random.randint(0, 255, (h, w, 3)).astype(onp.uint8))
+
+
+def test_to_tensor_normalize():
+    x = _img()
+    t = mx.nd.image.to_tensor(x)
+    assert t.shape == (3, 6, 8) and str(t.dtype) == "float32"
+    onp.testing.assert_allclose(
+        t.asnumpy(), onp.transpose(x.asnumpy(), (2, 0, 1)) / 255.0,
+        rtol=1e-6)
+    n = mx.nd.image.normalize(t, mean=(0.5, 0.4, 0.3), std=(0.2, 0.2, 0.2))
+    exp = (t.asnumpy() - onp.array([0.5, 0.4, 0.3]).reshape(3, 1, 1)) / 0.2
+    onp.testing.assert_allclose(n.asnumpy(), exp, rtol=1e-5, atol=1e-6)
+    # batched NHWC
+    xb = mx.np.array(onp.random.randint(
+        0, 255, (2, 4, 5, 3)).astype(onp.uint8))
+    tb = mx.nd.image.to_tensor(xb)
+    assert tb.shape == (2, 3, 4, 5)
+
+
+def test_flips():
+    x = _img()
+    onp.testing.assert_array_equal(
+        mx.nd.image.flip_left_right(x).asnumpy(), x.asnumpy()[:, ::-1])
+    onp.testing.assert_array_equal(
+        mx.nd.image.flip_top_bottom(x).asnumpy(), x.asnumpy()[::-1])
+    y = mx.nd.image.random_flip_left_right(x, p=0.0)
+    onp.testing.assert_array_equal(y.asnumpy(), x.asnumpy())
+    y = mx.nd.image.random_flip_left_right(x, p=1.0)
+    onp.testing.assert_array_equal(y.asnumpy(), x.asnumpy()[:, ::-1])
+
+
+def test_brightness_contrast_saturation_bounds():
+    mx.random.seed(7)
+    x = _img()
+    for op in (lambda: mx.nd.image.random_brightness(x, 0.5, 1.5),
+               lambda: mx.nd.image.random_contrast(x, 0.5, 1.5),
+               lambda: mx.nd.image.random_saturation(x, 0.5, 1.5),
+               lambda: mx.nd.image.random_hue(x, -0.1, 0.1),
+               lambda: mx.nd.image.random_color_jitter(x, 0.4, 0.4,
+                                                       0.4, 0.1)):
+        y = op()
+        assert y.shape == x.shape and y.dtype == x.dtype
+        arr = y.asnumpy()
+        assert arr.min() >= 0 and arr.max() <= 255
+    # identity factors = no-op for brightness
+    y = mx.nd.image.random_brightness(x, 1.0, 1.0)
+    onp.testing.assert_array_equal(y.asnumpy(), x.asnumpy())
+
+
+def test_hue_identity_and_lighting():
+    x = _img()
+    y = mx.nd.image.random_hue(x, 0.0, 0.0)  # alpha=0: hue unchanged
+    onp.testing.assert_allclose(y.asnumpy().astype(int),
+                                x.asnumpy().astype(int), atol=2)
+    z = mx.nd.image.adjust_lighting(x, (0.0, 0.0, 0.0))
+    onp.testing.assert_array_equal(z.asnumpy(), x.asnumpy())
+    z = mx.nd.image.random_lighting(x, alpha_std=0.05)
+    assert z.shape == x.shape
+
+
+def test_resize_crop():
+    x = _img(8, 10)
+    r = mx.nd.image.resize(x, (5, 4))  # (w, h)
+    assert r.shape == (4, 5, 3)
+    r2 = mx.nd.image.resize(x, 4, keep_ratio=True)
+    assert r2.shape[2] == 3 and min(r2.shape[:2]) == 4
+    c = mx.nd.image.crop(x, 2, 1, 4, 3)
+    onp.testing.assert_array_equal(c.asnumpy(), x.asnumpy()[1:4, 2:6])
+    rc = mx.nd.image.random_crop(x, (4, 3))
+    assert rc.shape == (3, 4, 3)
+    rrc = mx.nd.image.random_resized_crop(x, (6, 6))
+    assert rrc.shape == (6, 6, 3)
+
+
+def test_image_aug_differentiable_chain():
+    """to_tensor/normalize flow gradients (reference
+    `_backward_image_normalize`)."""
+    from mxnet_tpu import autograd
+
+    x = mx.np.array(onp.random.uniform(0, 255, (4, 5, 3)), dtype="float32")
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.image.normalize(mx.nd.image.to_tensor(x),
+                                  mean=(0.1, 0.2, 0.3), std=(0.5, 0.5, 0.5))
+        s = y.sum()
+    s.backward()
+    onp.testing.assert_allclose(
+        x.grad.asnumpy(), onp.full((4, 5, 3), 1 / 255.0 / 0.5), rtol=1e-5)
+
+
+def test_npx_reshape_codes():
+    x = mx.np.ones((2, 3, 8))
+    assert mx.npx.reshape(x, (-2, -2, 2, -1)).shape == (2, 3, 2, 4)
+    x = mx.np.ones((8, 3, 3, 3, 4, 4))
+    assert mx.npx.reshape(x, (-6, 2, -1, -4)).shape == (2, 4, 3, 3, 3, 4, 4)
+    assert mx.npx.reshape(x, (-5, -4)).shape == (24, 3, 3, 4, 4)
+    x = mx.np.ones((8, 1, 1, 1, 3))
+    assert mx.npx.reshape(x, (-2, -3, -3, -3, -2)).shape == (8, 3)
+    x = mx.np.ones((8, 3, 3, 3, 3, 8))
+    assert mx.npx.reshape(x, (-4, -5), reverse=True).shape == (8, 3, 3, 3, 24)
+    x = mx.np.ones((8, 3, 2, 4, 8))
+    assert mx.npx.reshape(x, (-4, -1, 2, -6),
+                          reverse=True).shape == (8, 3, 2, 4, 4, 2)
+    with pytest.raises(ValueError):
+        mx.npx.reshape(mx.np.ones((2, 3)), (-3, -2))
+    with pytest.raises(ValueError):
+        mx.npx.reshape(mx.np.ones((2, 3)), (-1, -1))
+
+
+def test_npx_index_add_update_nonzero_constraint():
+    a = mx.np.zeros((2, 3, 4))
+    ind = mx.np.array(onp.array([[0, 0], [0, 0], [0, 1]]), dtype="int32")
+    val = mx.np.array(onp.arange(2) + 1.0)
+    b = mx.npx.index_add(a, ind, val)
+    exp = onp.zeros((2, 3, 4))
+    exp[0, 0, 0], exp[0, 0, 1] = 1, 2
+    onp.testing.assert_allclose(b.asnumpy(), exp)
+    # duplicate positions accumulate
+    ind_dup = mx.np.array(onp.array([[0, 0], [0, 0], [0, 0]]), dtype="int32")
+    b = mx.npx.index_add(a, ind_dup, val)
+    assert b.asnumpy()[0, 0, 0] == 3
+    # update: set semantics
+    b = mx.npx.index_update(a, ind, val)
+    onp.testing.assert_allclose(b.asnumpy(), exp)
+    # broadcast val over trailing dims
+    ind2 = mx.np.array(onp.array([[0, 0], [0, 1]]), dtype="int32")
+    val2 = mx.np.array(onp.arange(4, dtype=onp.float32))
+    b = mx.npx.index_add(a, ind2, val2)
+    assert b.asnumpy()[0, 1].tolist() == [0, 1, 2, 3]
+
+    nz = mx.npx.nonzero(mx.np.array(onp.array([[1, 0], [0, 2]])))
+    assert nz.asnumpy().tolist() == [[0, 0], [1, 1]]
+
+    assert bool(mx.npx.constraint_check(
+        mx.np.array(onp.array([True, True])), "ok").asnumpy())
+    with pytest.raises(ValueError, match="positive"):
+        mx.npx.constraint_check(
+            mx.np.array(onp.array([True, False])), "must be positive")
+
+
+def test_interleaved_matmul_family():
+    """Oracle = the reference describe-block compositions
+    (`src/operator/contrib/transformer.cc:650-830`)."""
+    seq, b, H, D = 5, 2, 3, 4
+    qkv = onp.random.randn(seq, b, H * D * 3).astype(onp.float32)
+    tmp = qkv.reshape(seq, b, H, 3, D)
+    q = onp.transpose(tmp[:, :, :, 0, :], (1, 2, 0, 3)).reshape(
+        b * H, seq, D) / onp.sqrt(D)
+    k = onp.transpose(tmp[:, :, :, 1, :], (1, 2, 0, 3)).reshape(b * H, seq, D)
+    v = onp.transpose(tmp[:, :, :, 2, :], (1, 2, 0, 3)).reshape(b * H, seq, D)
+
+    scores = mx.nd.contrib.interleaved_matmul_selfatt_qk(
+        mx.np.array(qkv), heads=H)
+    onp.testing.assert_allclose(scores.asnumpy(),
+                                q @ onp.swapaxes(k, -1, -2),
+                                rtol=1e-5, atol=1e-5)
+    att = onp.random.rand(b * H, seq, seq).astype(onp.float32)
+    out = mx.nd.contrib.interleaved_matmul_selfatt_valatt(
+        mx.np.array(qkv), mx.np.array(att), heads=H)
+    o = onp.transpose((att @ v).reshape(b, H, seq, D),
+                      (2, 0, 1, 3)).reshape(seq, b, H * D)
+    onp.testing.assert_allclose(out.asnumpy(), o, rtol=1e-5, atol=1e-5)
+
+    # enc-dec: separate queries and keys_values
+    qs, ks = 4, 6
+    qin = onp.random.randn(qs, b, H * D).astype(onp.float32)
+    kv = onp.random.randn(ks, b, H * D * 2).astype(onp.float32)
+    kvt = kv.reshape(ks, b, H, 2, D)
+    q2 = onp.transpose(qin.reshape(qs, b, H, D), (1, 2, 0, 3)).reshape(
+        b * H, qs, D) / onp.sqrt(D)
+    k2 = onp.transpose(kvt[:, :, :, 0, :], (1, 2, 0, 3)).reshape(b * H, ks, D)
+    v2 = onp.transpose(kvt[:, :, :, 1, :], (1, 2, 0, 3)).reshape(b * H, ks, D)
+    s2 = mx.nd.contrib.interleaved_matmul_encdec_qk(
+        mx.np.array(qin), mx.np.array(kv), heads=H)
+    onp.testing.assert_allclose(s2.asnumpy(), q2 @ onp.swapaxes(k2, -1, -2),
+                                rtol=1e-5, atol=1e-5)
+    att2 = onp.random.rand(b * H, qs, ks).astype(onp.float32)
+    o2 = mx.nd.contrib.interleaved_matmul_encdec_valatt(
+        mx.np.array(kv), mx.np.array(att2), heads=H)
+    exp2 = onp.transpose((att2 @ v2).reshape(b, H, qs, D),
+                         (2, 0, 1, 3)).reshape(qs, b, H * D)
+    onp.testing.assert_allclose(o2.asnumpy(), exp2, rtol=1e-5, atol=1e-5)
+
+
+def test_host_rng_thread_determinism():
+    """mx.random.seed makes host-side augmentation draws deterministic in
+    worker threads created after seeding (code-review finding: thread-
+    local generators ignored the seed)."""
+    import threading
+
+    from mxnet_tpu import random as mxrand
+
+    def run_once():
+        mx.random.seed(123)
+        out = {}
+
+        def worker(slot):
+            out[slot] = mxrand.host_rng().uniform(size=3).tolist()
+
+        t1 = threading.Thread(target=worker, args=("a",))
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=worker, args=("b",))
+        t2.start()
+        t2.join()
+        out["main"] = mxrand.host_rng().uniform(size=3).tolist()
+        return out
+
+    r1 = run_once()
+    r2 = run_once()
+    assert r1 == r2
+    assert r1["a"] != r1["b"]  # independent per-thread streams

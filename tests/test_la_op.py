@@ -1,0 +1,189 @@
+"""la_op linalg family oracle tests.
+
+Reference: `src/operator/tensor/la_op.cc:29-1050` (`_linalg_*` ops) and its
+doc examples.  Oracle = numpy compositions, tolerances per
+`python/mxnet/test_utils.py:655` float32 defaults.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+la = None
+
+
+def setup_module():
+    global la
+    la = mx.nd.linalg
+
+
+def _rand(*shape):
+    return onp.random.uniform(-1, 1, shape).astype(onp.float32)
+
+
+def _spd(n, batch=()):
+    A = onp.random.uniform(-1, 1, batch + (n, n)).astype(onp.float32)
+    return (A @ onp.swapaxes(A, -1, -2) +
+            4 * onp.eye(n, dtype=onp.float32))
+
+
+def test_gemm_gemm2():
+    A, B, C = _rand(2, 3), _rand(4, 3), _rand(2, 4)
+    out = la.gemm(mx.np.array(A), mx.np.array(B), mx.np.array(C),
+                  transpose_b=True, alpha=2.0, beta=10.0)
+    onp.testing.assert_allclose(out.asnumpy(), 2 * A @ B.T + 10 * C,
+                                rtol=1e-5, atol=1e-5)
+    out2 = la.gemm2(mx.np.array(A), mx.np.array(B), transpose_b=True,
+                    alpha=2.0)
+    onp.testing.assert_allclose(out2.asnumpy(), 2 * A @ B.T,
+                                rtol=1e-5, atol=1e-5)
+    # reference doc example (`la_op.cc:76-85`)
+    A = onp.ones((1, 2), onp.float32)
+    B = onp.ones((3, 2), onp.float32)
+    out3 = la.gemm2(mx.np.array(A), mx.np.array(B), transpose_b=True,
+                    alpha=2.0)
+    onp.testing.assert_allclose(out3.asnumpy(), [[4.0, 4.0, 4.0]][:1])
+
+
+def test_gemm_batch_and_axis():
+    A, B = _rand(2, 5, 3, 4), _rand(2, 5, 4, 6)
+    out = la.gemm2(mx.np.array(A), mx.np.array(B))
+    onp.testing.assert_allclose(out.asnumpy(), A @ B, rtol=1e-5, atol=1e-5)
+    # axis=1: rows live on axis 1 (reference swapaxes equivalence)
+    A2 = onp.swapaxes(A, 1, 2).copy()
+    B2 = onp.swapaxes(B, 1, 2).copy()
+    out2 = la.gemm2(mx.np.array(A2), mx.np.array(B2), axis=1)
+    onp.testing.assert_allclose(out2.asnumpy(), onp.swapaxes(A @ B, 1, 2),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_potrf_potri():
+    S = _spd(4, (3,))
+    L = la.potrf(mx.np.array(S))
+    onp.testing.assert_allclose(L.asnumpy() @ onp.swapaxes(L.asnumpy(), -1, -2),
+                                S, rtol=1e-4, atol=1e-4)
+    # upper variant
+    U = la.potrf(mx.np.array(S), lower=False)
+    onp.testing.assert_allclose(
+        onp.swapaxes(U.asnumpy(), -1, -2) @ U.asnumpy(), S,
+        rtol=1e-4, atol=1e-4)
+    inv = la.potri(L)
+    onp.testing.assert_allclose(inv.asnumpy(), onp.linalg.inv(S),
+                                rtol=1e-3, atol=1e-3)
+    # doc example `la_op.cc:266-270`
+    A = onp.array([[2.0, 0], [0.5, 2.0]], onp.float32)
+    out = la.potri(mx.np.array(A))
+    onp.testing.assert_allclose(
+        out.asnumpy(), [[0.26563, -0.0625], [-0.0625, 0.25]], atol=1e-4)
+
+
+def test_trmm_trsm():
+    L = onp.tril(_rand(4, 4)) + 2 * onp.eye(4, dtype=onp.float32)
+    B = _rand(4, 3)
+    out = la.trmm(mx.np.array(L), mx.np.array(B), alpha=2.0)
+    onp.testing.assert_allclose(out.asnumpy(), 2 * L @ B, rtol=1e-5,
+                                atol=1e-5)
+    out = la.trmm(mx.np.array(L), mx.np.array(B.T), rightside=True,
+                  transpose=True)
+    onp.testing.assert_allclose(out.asnumpy(), B.T @ L.T, rtol=1e-5,
+                                atol=1e-5)
+    X = la.trsm(mx.np.array(L), mx.np.array(B), alpha=2.0)
+    onp.testing.assert_allclose(L @ X.asnumpy(), 2 * B, rtol=1e-4, atol=1e-4)
+    X = la.trsm(mx.np.array(L), mx.np.array(B.T), rightside=True)
+    onp.testing.assert_allclose(X.asnumpy() @ L, B.T, rtol=1e-4, atol=1e-4)
+    X = la.trsm(mx.np.array(L), mx.np.array(B), transpose=True)
+    onp.testing.assert_allclose(L.T @ X.asnumpy(), B, rtol=1e-4, atol=1e-4)
+
+
+def test_syrk():
+    A = _rand(2, 3, 5)
+    out = la.syrk(mx.np.array(A), alpha=1.5)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                1.5 * A @ onp.swapaxes(A, -1, -2),
+                                rtol=1e-5, atol=1e-5)
+    out = la.syrk(mx.np.array(A), transpose=True)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                onp.swapaxes(A, -1, -2) @ A,
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_gelqf_syevd():
+    A = _rand(3, 5)
+    L, Q = la.gelqf(mx.np.array(A))
+    onp.testing.assert_allclose(L.asnumpy() @ Q.asnumpy(), A, rtol=1e-4,
+                                atol=1e-4)
+    onp.testing.assert_allclose(Q.asnumpy() @ Q.asnumpy().T,
+                                onp.eye(3), rtol=1e-4, atol=1e-4)
+    # L lower triangular
+    onp.testing.assert_allclose(L.asnumpy(), onp.tril(L.asnumpy()),
+                                atol=1e-5)
+    S = _spd(4)
+    U, lam = la.syevd(mx.np.array(S))
+    onp.testing.assert_allclose(
+        U.asnumpy().T @ onp.diag(lam.asnumpy()) @ U.asnumpy(), S,
+        rtol=1e-3, atol=1e-3)
+
+
+def test_diag_trian_family():
+    A = onp.array([[1.0, 2.0], [3.0, 4.0]], onp.float32)
+    assert la.extractdiag(mx.np.array(A)).asnumpy().tolist() == [1.0, 4.0]
+    assert la.extractdiag(mx.np.array(A), 1).asnumpy().tolist() == [2.0]
+    d = mx.np.array(onp.array([1.0, 2.0], onp.float32))
+    onp.testing.assert_array_equal(
+        la.makediag(d).asnumpy(), [[1, 0], [0, 2]])
+    onp.testing.assert_array_equal(
+        la.makediag(d, 1).asnumpy(),
+        [[0, 1, 0], [0, 0, 2], [0, 0, 0]])
+    # `la_op.cc:575-586` examples
+    assert la.extracttrian(mx.np.array(A)).asnumpy().tolist() == [1, 3, 4]
+    assert la.extracttrian(mx.np.array(A), lower=False).asnumpy().tolist() \
+        == [1, 2, 4]
+    assert la.extracttrian(mx.np.array(A), 1).asnumpy().tolist() == [2]
+    assert la.extracttrian(mx.np.array(A), -1).asnumpy().tolist() == [3]
+    p = mx.np.array(onp.array([1.0, 2.0, 3.0], onp.float32))
+    onp.testing.assert_array_equal(
+        la.maketrian(p).asnumpy(), [[1, 0], [2, 3]])
+    onp.testing.assert_array_equal(
+        la.maketrian(p, lower=False).asnumpy(), [[1, 2], [0, 3]])
+    onp.testing.assert_array_equal(
+        la.maketrian(p, offset=-1).asnumpy(),
+        [[0, 0, 0], [1, 0, 0], [2, 3, 0]])
+    # batch + roundtrip
+    Ab = _rand(4, 5, 5)
+    packed = la.extracttrian(mx.np.array(Ab))
+    back = la.maketrian(packed)
+    onp.testing.assert_allclose(back.asnumpy(), onp.tril(Ab), atol=1e-6)
+
+
+def test_sumlogdiag_det_inverse():
+    S = _spd(3, (2,))
+    out = la.sumlogdiag(mx.np.array(S))
+    onp.testing.assert_allclose(
+        out.asnumpy(),
+        onp.log(onp.diagonal(S, axis1=-2, axis2=-1)).sum(-1),
+        rtol=1e-5)
+    onp.testing.assert_allclose(la.det(mx.np.array(S)).asnumpy(),
+                                onp.linalg.det(S), rtol=1e-3)
+    onp.testing.assert_allclose(la.inverse(mx.np.array(S)).asnumpy(),
+                                onp.linalg.inv(S), rtol=1e-3, atol=1e-4)
+    sign, logab = la.slogdet(mx.np.array(S))
+    s2, l2 = onp.linalg.slogdet(S)
+    onp.testing.assert_allclose(sign.asnumpy(), s2)
+    onp.testing.assert_allclose(logab.asnumpy(), l2, rtol=1e-4)
+
+
+def test_la_op_gradients():
+    """la ops flow through the tape (FGradient parity,
+    `la_op.cc:101,186`)."""
+    from mxnet_tpu import autograd
+
+    A = mx.np.array(_rand(3, 3))
+    B = mx.np.array(_rand(3, 3))
+    A.attach_grad()
+    with autograd.record():
+        out = la.gemm2(A, B)
+        s = out.sum()
+    s.backward()
+    onp.testing.assert_allclose(A.grad.asnumpy(),
+                                onp.ones((3, 3), onp.float32) @ B.asnumpy().T,
+                                rtol=1e-5, atol=1e-5)

@@ -1,0 +1,139 @@
+"""Reference op-name -> resolution-path parity walk.
+
+Round-2 verdict missing #2: "Commit a checked-in list of reference op
+names -> expected resolution path and a test that walks it."  Each row
+below is (reference op name as registered by `NNVM_REGISTER_OP` /
+generated python surface, dotted path under `mxnet_tpu` where a caller of
+the reference would find it).  The test resolves every path and asserts a
+callable (or namespace) exists.  Growing this table IS the regression
+fence: a namespace reshuffle that breaks user scripts fails here first.
+"""
+import importlib
+
+import pytest
+
+import mxnet_tpu as mx
+
+# (reference name, resolution path) — paths relative to `mx.`
+PARITY = [
+    # --- la_op family (`src/operator/tensor/la_op.cc:29-1050`) ---
+    ("_linalg_gemm", "nd.linalg.gemm"),
+    ("_linalg_gemm2", "nd.linalg.gemm2"),
+    ("_linalg_potrf", "nd.linalg.potrf"),
+    ("_linalg_potri", "nd.linalg.potri"),
+    ("_linalg_trmm", "nd.linalg.trmm"),
+    ("_linalg_trsm", "nd.linalg.trsm"),
+    ("_linalg_sumlogdiag", "nd.linalg.sumlogdiag"),
+    ("_linalg_extractdiag", "nd.linalg.extractdiag"),
+    ("_linalg_makediag", "nd.linalg.makediag"),
+    ("_linalg_extracttrian", "nd.linalg.extracttrian"),
+    ("_linalg_maketrian", "nd.linalg.maketrian"),
+    ("_linalg_syrk", "nd.linalg.syrk"),
+    ("_linalg_gelqf", "nd.linalg.gelqf"),
+    ("_linalg_syevd", "nd.linalg.syevd"),
+    ("_linalg_inverse", "nd.linalg.inverse"),
+    ("_linalg_det", "nd.linalg.det"),
+    ("_linalg_slogdet", "nd.linalg.slogdet"),
+    ("_linalg_gemm2 (sym)", "sym.linalg.gemm2"),
+    ("_linalg_potrf (sym)", "sym.linalg.potrf"),
+    # --- image ops (`src/operator/image/image_random.cc`, resize.cc) ---
+    ("_image_to_tensor", "nd.image.to_tensor"),
+    ("_image_normalize", "nd.image.normalize"),
+    ("_image_flip_left_right", "nd.image.flip_left_right"),
+    ("_image_random_flip_left_right", "nd.image.random_flip_left_right"),
+    ("_image_flip_top_bottom", "nd.image.flip_top_bottom"),
+    ("_image_random_flip_top_bottom", "nd.image.random_flip_top_bottom"),
+    ("_image_random_brightness", "nd.image.random_brightness"),
+    ("_image_random_contrast", "nd.image.random_contrast"),
+    ("_image_random_saturation", "nd.image.random_saturation"),
+    ("_image_random_hue", "nd.image.random_hue"),
+    ("_image_random_color_jitter", "nd.image.random_color_jitter"),
+    ("_image_adjust_lighting", "nd.image.adjust_lighting"),
+    ("_image_random_lighting", "nd.image.random_lighting"),
+    ("_image_resize", "nd.image.resize"),
+    ("_image_crop", "nd.image.crop"),
+    ("_image_random_crop", "nd.image.random_crop"),
+    ("_image_random_resized_crop", "nd.image.random_resized_crop"),
+    ("_image_to_tensor (sym)", "sym.image.to_tensor"),
+    # --- contrib ops under mx.nd.contrib (`python/mxnet/ndarray/contrib.py`) ---
+    ("_contrib_box_nms", "nd.contrib.box_nms"),
+    ("_contrib_box_iou", "nd.contrib.box_iou"),
+    ("_contrib_bipartite_matching", "nd.contrib.bipartite_matching"),
+    ("_contrib_ROIAlign", "nd.contrib.ROIAlign"),
+    ("_contrib_MultiBoxPrior", "nd.contrib.MultiBoxPrior"),
+    ("_contrib_MultiBoxTarget", "nd.contrib.MultiBoxTarget"),
+    ("_contrib_MultiBoxDetection", "nd.contrib.MultiBoxDetection"),
+    ("_contrib_boolean_mask", "nd.contrib.boolean_mask"),
+    ("_contrib_allclose", "nd.contrib.allclose"),
+    ("_contrib_index_copy", "nd.contrib.index_copy"),
+    ("_contrib_index_array", "nd.contrib.index_array"),
+    ("_contrib_hawkesll", "nd.contrib.hawkes_ll"),
+    ("_contrib_div_sqrt_dim", "nd.contrib.div_sqrt_dim"),
+    ("_contrib_interleaved_matmul_selfatt_qk",
+     "nd.contrib.interleaved_matmul_selfatt_qk"),
+    ("_contrib_interleaved_matmul_selfatt_valatt",
+     "nd.contrib.interleaved_matmul_selfatt_valatt"),
+    ("_contrib_interleaved_matmul_encdec_qk",
+     "nd.contrib.interleaved_matmul_encdec_qk"),
+    ("_contrib_interleaved_matmul_encdec_valatt",
+     "nd.contrib.interleaved_matmul_encdec_valatt"),
+    ("_foreach", "nd.contrib.foreach"),
+    ("_while_loop", "nd.contrib.while_loop"),
+    ("_cond", "nd.contrib.cond"),
+    ("circ_conv (fork)", "nd.contrib.circ_conv"),
+    ("k_smallest_flags (fork)", "nd.contrib.k_smallest_flags"),
+    # --- npx surface (`src/operator/numpy/`) ---
+    ("_npx_reshape", "npx.reshape"),
+    ("_npx_nonzero", "npx.nonzero"),
+    ("_npx_index_add", "npx.index_add"),
+    ("_npx_index_update", "npx.index_update"),
+    ("_npx_constraint_check", "npx.constraint_check"),
+    ("_npx_topk", "npx.topk"),
+    ("_npx_softmax", "npx.softmax"),
+    ("_npx_batch_norm", "npx.batch_norm"),
+    ("_npx_convolution", "npx.convolution"),
+    ("_npx_fully_connected", "npx.fully_connected"),
+    ("_npx_pick", "npx.pick"),
+    ("_npx_gamma", "npx.gamma"),
+    # --- legacy root ops (spot sample; full sweep in
+    #     tests/test_legacy_nd_ops.py) ---
+    ("FullyConnected", "nd.FullyConnected"),
+    ("Convolution", "nd.Convolution"),
+    ("BatchNorm", "nd.BatchNorm"),
+    ("SoftmaxOutput", "nd.SoftmaxOutput"),
+    ("Reshape", "nd.Reshape"),
+    ("SwapAxis", "nd.SwapAxis"),
+    ("sgd_update", "nd.sgd_update"),
+    ("adam_update", "nd.adam_update"),
+    ("lamb_update_phase1", "nd.lamb_update_phase1"),
+    ("RNN", "nd.RNN"),
+    ("Correlation", "nd.Correlation"),
+    ("SequenceMask", "nd.SequenceMask"),
+    # --- sparse / image modules, sanity of namespace objects ---
+    ("cast_storage (namespace)", "nd.sparse"),
+    ("image (namespace)", "nd.image"),
+    ("contrib (namespace)", "nd.contrib"),
+    ("linalg (namespace)", "nd.linalg"),
+]
+
+
+def _resolve(path):
+    obj = mx
+    for part in path.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+@pytest.mark.parametrize("ref_name,path", PARITY,
+                         ids=[p[0] for p in PARITY])
+def test_reference_name_resolves(ref_name, path):
+    obj = _resolve(path)
+    assert obj is not None, f"{ref_name}: {path} resolved to None"
+    if not path.endswith(("sparse", "image", "contrib", "linalg")):
+        assert callable(obj), f"{ref_name}: {path} is not callable"
+
+
+def test_nd_linalg_falls_back_to_np_linalg():
+    # scripts using the aliased numpy-style surface keep working
+    assert callable(mx.nd.linalg.svd)
+    assert callable(mx.nd.linalg.cholesky)
