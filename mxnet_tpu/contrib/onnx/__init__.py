@@ -5,7 +5,7 @@
 protobuf wire format is encoded directly (`proto.py`) because the
 ``onnx`` package is not available in this environment.
 """
-from .mx2onnx import export_model
+from .mx2onnx import export_model, export_block
 from .onnx2mx import import_model
 
-__all__ = ["export_model", "import_model"]
+__all__ = ["export_model", "export_block", "import_model"]

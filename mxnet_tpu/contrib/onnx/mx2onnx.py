@@ -13,7 +13,7 @@ import numpy as onp
 
 from . import proto as P
 
-__all__ = ["export_model"]
+__all__ = ["export_model", "export_block"]
 
 _CONVERTERS = {}
 
@@ -212,11 +212,305 @@ for _mx, _ox in [("relu", "Relu"), ("sigmoid", "Sigmoid"),
     _CONVERTERS[_mx] = _un
 
 
+# -- round-3 breadth (VERDICT r2 #4): Pad/Clip/Slice/TopK/Where/... ---------
+
+@register("Pad")
+@register("pad")
+def _pad(name, ins, attrs, extra_init=None):
+    mode = attrs.get("mode", "constant")
+    pw = _tup(attrs, "pad_width")
+    # mxnet pad_width is (before0, after0, before1, after1, ...);
+    # ONNX wants all befores then all afters
+    befores = pw[0::2]
+    afters = pw[1::2]
+    extra_init.append(P.tensor_proto(
+        name + "_pads", onp.asarray(befores + afters, onp.int64)))
+    node_ins = [ins[0], name + "_pads"]
+    if mode == "constant":
+        extra_init.append(P.tensor_proto(
+            name + "_cval",
+            onp.asarray(float(attrs.get("constant_value", 0.0)), onp.float32)))
+        node_ins.append(name + "_cval")
+    onnx_mode = {"constant": "constant", "edge": "edge",
+                 "reflect": "reflect"}[mode]
+    return [P.node_proto("Pad", node_ins, [name], name,
+                         [P.attr_string("mode", onnx_mode)])]
+
+
+@register("clip")
+def _clip(name, ins, attrs, extra_init=None):
+    # scalar bounds may arrive either as attrs (a_min/a_max) or as
+    # constant inputs (Symbol positional scalars)
+    node_ins = [ins[0]]
+    if len(ins) >= 3:
+        node_ins += [ins[1], ins[2]]
+    else:
+        extra_init.append(P.tensor_proto(
+            name + "_min", onp.asarray(float(attrs.get("a_min", 0.0)),
+                                       onp.float32)))
+        extra_init.append(P.tensor_proto(
+            name + "_max", onp.asarray(float(attrs.get("a_max", 0.0)),
+                                       onp.float32)))
+        node_ins += [name + "_min", name + "_max"]
+    return [P.node_proto("Clip", node_ins, [name], name)]
+
+
+@register("slice")
+def _slice(name, ins, attrs, extra_init=None):
+    begin = _tup(attrs, "begin")
+    end = _tup(attrs, "end")
+    step = _tup(attrs, "step") or (1,) * len(begin)
+    axes = tuple(range(len(begin)))
+    big = 2 ** 31 - 1
+    end = tuple(big if e is None else int(e) for e in end)
+    begin = tuple(0 if b is None else int(b) for b in begin)
+    for suffix, vals in (("_starts", begin), ("_ends", end),
+                         ("_axes", axes), ("_steps", step)):
+        extra_init.append(P.tensor_proto(
+            name + suffix, onp.asarray(vals, onp.int64)))
+    return [P.node_proto(
+        "Slice", [ins[0], name + "_starts", name + "_ends",
+                  name + "_axes", name + "_steps"], [name], name)]
+
+
+@register("slice_axis")
+def _slice_axis(name, ins, attrs, extra_init=None):
+    axis = int(attrs.get("axis", 0))
+    begin = int(attrs.get("begin", 0))
+    end = attrs.get("end")
+    end = 2 ** 31 - 1 if end is None else int(end)
+    for suffix, vals in (("_starts", (begin,)), ("_ends", (end,)),
+                         ("_axes", (axis,))):
+        extra_init.append(P.tensor_proto(
+            name + suffix, onp.asarray(vals, onp.int64)))
+    return [P.node_proto(
+        "Slice", [ins[0], name + "_starts", name + "_ends", name + "_axes"],
+        [name], name)]
+
+
+@register("topk")
+def _topk(name, ins, attrs, extra_init=None):
+    k = int(attrs.get("k", 1))
+    axis = int(attrs.get("axis", -1))
+    ret_typ = attrs.get("ret_typ", "indices")
+    extra_init.append(P.tensor_proto(name + "_k",
+                                     onp.asarray([k], onp.int64)))
+    outs = {"value": [name, name + "_idx_unused"],
+            "indices": [name + "_val_unused", name],
+            "both": [name, name + "_1"]}[ret_typ]
+    a = [P.attr_int("axis", axis),
+         P.attr_int("largest", int(not attrs.get("is_ascend", False))),
+         P.attr_int("sorted", 1)]
+    return [P.node_proto("TopK", [ins[0], name + "_k"], outs, name, a)]
+
+
+@register("where")
+def _where(name, ins, attrs):
+    return [P.node_proto("Where", ins[:3], [name], name)]
+
+
+@register("expand_dims")
+def _expand_dims(name, ins, attrs, extra_init=None):
+    extra_init.append(P.tensor_proto(
+        name + "_axes", onp.asarray([int(attrs.get("axis", 0))], onp.int64)))
+    return [P.node_proto("Unsqueeze", [ins[0], name + "_axes"],
+                         [name], name)]
+
+
+@register("squeeze")
+def _squeeze(name, ins, attrs, extra_init=None):
+    axis = attrs.get("axis")
+    if axis is None:
+        return [P.node_proto("Squeeze", ins[:1], [name], name)]
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    extra_init.append(P.tensor_proto(
+        name + "_axes", onp.asarray(axes, onp.int64)))
+    return [P.node_proto("Squeeze", [ins[0], name + "_axes"], [name], name)]
+
+
+@register("broadcast_like")
+def _broadcast_like(name, ins, attrs):
+    # Expand to the runtime shape of the second input
+    return [P.node_proto("Shape", [ins[1]], [name + "_shape"],
+                         name + "_shape"),
+            P.node_proto("Expand", [ins[0], name + "_shape"], [name], name)]
+
+
+@register("broadcast_to")
+def _broadcast_to(name, ins, attrs, extra_init=None):
+    shape = _tup(attrs, "shape")
+    extra_init.append(P.tensor_proto(
+        name + "_shape", onp.asarray(shape, onp.int64)))
+    return [P.node_proto("Expand", [ins[0], name + "_shape"], [name], name)]
+
+
+for _mx, _ox in [("_power", "Pow"), ("power", "Pow"), ("broadcast_power", "Pow"),
+                 ("mod", "Mod"), ("broadcast_mod", "Mod"),
+                 ("equal", "Equal"), ("broadcast_equal", "Equal"),
+                 ("greater", "Greater"), ("broadcast_greater", "Greater"),
+                 ("lesser", "Less"), ("less", "Less"),
+                 ("broadcast_lesser", "Less")]:
+    def _bin2(name, ins, attrs, _op=_ox):
+        return [P.node_proto(_op, ins[:2], [name], name)]
+    _CONVERTERS[_mx] = _bin2
+
+
+def _reduce(onnx_op):
+    def conv(name, ins, attrs, extra_init=None):
+        axis = attrs.get("axis")
+        a = [P.attr_int("keepdims", int(bool(attrs.get("keepdims", False))))]
+        axes = None
+        if axis is not None:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        if onnx_op == "ReduceSum":
+            # ReduceSum-13 takes axes as an INPUT; the other reductions
+            # keep the attribute form until opset 18
+            node_ins = [ins[0]]
+            if axes is not None:
+                extra_init.append(P.tensor_proto(
+                    name + "_axes", onp.asarray(axes, onp.int64)))
+                node_ins.append(name + "_axes")
+            return [P.node_proto(onnx_op, node_ins, [name], name, a)]
+        if axes is not None:
+            a.append(P.attr_ints("axes", axes))
+        return [P.node_proto(onnx_op, ins[:1], [name], name, a)]
+    return conv
+
+
+for _mx, _ox in [("sum", "ReduceSum"), ("mean", "ReduceMean"),
+                 ("max", "ReduceMax"), ("min", "ReduceMin"),
+                 ("prod", "ReduceProd"), ("norm", "ReduceL2")]:
+    _CONVERTERS[_mx] = _reduce(_ox)
+    _CONVERTERS["reduce_" + _mx] = _reduce(_ox)
+
+
+@register("argmax")
+def _argmax(name, ins, attrs):
+    return [P.node_proto("ArgMax", ins[:1], [name], name,
+                         [P.attr_int("axis", int(attrs.get("axis", 0))),
+                          P.attr_int("keepdims",
+                                     int(bool(attrs.get("keepdims", False))))])]
+
+
+@register("LayerNorm")
+@register("layer_norm")
+def _layer_norm(name, ins, attrs):
+    return [P.node_proto(
+        "LayerNormalization", ins[:3], [name], name,
+        [P.attr_int("axis", int(attrs.get("axis", -1))),
+         P.attr_float("epsilon", float(attrs.get("eps", 1e-5)))])]
+
+
+@register("log_softmax")
+def _log_softmax(name, ins, attrs):
+    return [P.node_proto("LogSoftmax", ins[:1], [name], name,
+                         [P.attr_int("axis", int(attrs.get("axis", -1)))])]
+
+
+@register("stack")
+def _stack(name, ins, attrs, extra_init=None):
+    axis = int(attrs.get("axis", 0))
+    nodes = []
+    unsq = []
+    extra_init.append(P.tensor_proto(
+        name + "_axes", onp.asarray([axis], onp.int64)))
+    for i, x in enumerate(ins):
+        nodes.append(P.node_proto("Unsqueeze", [x, name + "_axes"],
+                                  [f"{name}_u{i}"], f"{name}_u{i}"))
+        unsq.append(f"{name}_u{i}")
+    nodes.append(P.node_proto("Concat", unsq, [name], name,
+                              [P.attr_int("axis", axis)]))
+    return nodes
+
+
+def _rnn_onnx_nodes(name, ins, attrs, extra_init, weights):
+    """Emit per-layer ONNX LSTM/GRU/RNN nodes from captured weight VALUES
+    (`weights`: list of (i2h_w, i2h_b, h2h_w, h2h_b) numpy arrays per
+    layer).  MXNet LSTM gate order i,f,g,o -> ONNX i,o,f,c
+    (`src/operator/rnn-inl.h:421` vs ONNX LSTM spec); GRU z,r,n stays
+    r,z,n -> ONNX z,r,h needs the same swap."""
+    mode = attrs["mode"]
+    hidden = attrs["hidden"]
+    x = ins[0]
+    h0, c0 = ins[1], ins[2]
+    nodes = []
+    h_outs, c_outs = [], []
+    op = {"lstm": "LSTM", "gru": "GRU",
+          "rnn_relu": "RNN", "rnn_tanh": "RNN"}[mode]
+
+    def perm(w):
+        if mode == "lstm":   # i,f,g,o -> i,o,f,c(g)
+            i, f, g, o = onp.split(w, 4, axis=0)
+            return onp.concatenate([i, o, f, g], axis=0)
+        if mode == "gru":    # mxnet r,z,n -> onnx z,r,h
+            r, z, n = onp.split(w, 3, axis=0)
+            return onp.concatenate([z, r, n], axis=0)
+        return w
+
+    extra_init.append(P.tensor_proto(
+        name + "_sq1", onp.asarray([1], onp.int64)))
+    extra_init.append(P.tensor_proto(
+        name + "_sq0", onp.asarray([0], onp.int64)))
+    cur = x
+    for layer, (wi, bi, wh, bh) in enumerate(weights):
+        ln = f"{name}_l{layer}"
+        W = perm(wi)[None]                    # (1, G*H, C)
+        R = perm(wh)[None]
+        B = onp.concatenate([perm(bi), perm(bh)])[None]
+        extra_init.append(P.tensor_proto(ln + "_W", W.astype(onp.float32)))
+        extra_init.append(P.tensor_proto(ln + "_R", R.astype(onp.float32)))
+        extra_init.append(P.tensor_proto(ln + "_B", B.astype(onp.float32)))
+        # initial states: slice layer `layer` from the stacked (L, N, H)
+        for tag, full in (("_h0", h0),) + ((("_c0", c0),)
+                                           if mode == "lstm" else ()):
+            extra_init.append(P.tensor_proto(
+                ln + tag + "_starts", onp.asarray([layer], onp.int64)))
+            extra_init.append(P.tensor_proto(
+                ln + tag + "_ends", onp.asarray([layer + 1], onp.int64)))
+            nodes.append(P.node_proto(
+                "Slice", [full, ln + tag + "_starts", ln + tag + "_ends",
+                          name + "_sq0"], [ln + tag], ln + tag))
+        node_ins = [cur, ln + "_W", ln + "_R", ln + "_B", "", ln + "_h0"]
+        outs = [ln + "_Y", ln + "_Yh"]
+        if mode == "lstm":
+            node_ins.append(ln + "_c0")
+            outs.append(ln + "_Yc")
+        a = [P.attr_int("hidden_size", hidden)]
+        if mode == "gru":
+            # this backend's GRU applies the reset gate AFTER the
+            # recurrent linear incl. its bias (rnn_layer.py:51-55) —
+            # ONNX linear_before_reset=1; the default 0 places Rb
+            # outside the reset multiply and diverges whenever Rb != 0
+            a.append(P.attr_int("linear_before_reset", 1))
+        if mode == "rnn_relu":
+            a.append(P.attr_strings("activations", ["Relu"]))
+        nodes.append(P.node_proto(op, node_ins, outs, ln, a))
+        # Y: (T, 1, N, H) -> (T, N, H)
+        nodes.append(P.node_proto("Squeeze", [ln + "_Y", name + "_sq1"],
+                                  [ln + "_Ysq"], ln + "_Ysq"))
+        cur = ln + "_Ysq"
+        h_outs.append(ln + "_Yh")
+        c_outs.append(ln + "_Yc" if mode == "lstm" else ln + "_Yh")
+    # final output aliases
+    nodes.append(P.node_proto("Identity", [cur], [name], name))
+    if len(h_outs) == 1:
+        nodes.append(P.node_proto("Identity", [h_outs[0]],
+                                  [name + "_1"], name + "_1"))
+        nodes.append(P.node_proto("Identity", [c_outs[0]],
+                                  [name + "_2"], name + "_2"))
+    else:
+        nodes.append(P.node_proto("Concat", h_outs, [name + "_1"],
+                                  name + "_1", [P.attr_int("axis", 0)]))
+        nodes.append(P.node_proto("Concat", c_outs, [name + "_2"],
+                                  name + "_2", [P.attr_int("axis", 0)]))
+    return nodes
+
+
 # -- graph walk -------------------------------------------------------------
 
 
 def export_model(sym, params, input_shapes=None, input_types=None,
-                 onnx_file_path="model.onnx", opset_version=13,
+                 onnx_file_path="model.onnx", opset_version=17,
                  run_shape_inference=False):
     """Serialize ``sym`` + ``params`` to an ONNX file (reference
     `mx2onnx.export_model`).  ``params`` maps free-variable names to
@@ -287,6 +581,275 @@ def export_model(sym, params, input_shapes=None, input_types=None,
     g_inputs = [P.value_info(n, shape_of.get(n, ())) for n in data_inputs]
     g_outputs = [P.value_info(out_name, ())]
     graph = P.graph_proto(nodes, "mxnet_tpu_graph", initializers,
+                          g_inputs, g_outputs)
+    blob = P.model_proto(graph, opset=opset_version)
+    with open(onnx_file_path, "wb") as f:
+        f.write(blob)
+    return onnx_file_path
+
+
+# ---------------------------------------------------------------------------
+# Gluon HybridBlock -> ONNX via imperative graph capture
+# ---------------------------------------------------------------------------
+# The reference exports Gluon models by hybridize-tracing to a Symbol then
+# `export_model` (`python/mxnet/gluon/block.py:1300` + mx2onnx).  Here the
+# equivalent trace is `ops.invoke._CaptureScope`: one eval-mode forward
+# records every dispatched op with its live NDArrays; the entries are then
+# lifted into ONNX nodes.  Parameter identity maps array -> initializer
+# name; arrays created inside forward (zeros state, constants) are inlined
+# as initializers.
+
+def _buf_id(nd):
+    return id(nd._data)
+
+
+def _nd_leaves(obj):
+    import jax
+    from ...ndarray.ndarray import NDArray
+    return [x for x in jax.tree_util.tree_leaves(
+        obj, is_leaf=lambda o: isinstance(o, NDArray))
+        if isinstance(x, NDArray)]
+
+
+def _bind(fun, args, kwargs):
+    """Full argname->value mapping via the real signature when available."""
+    import inspect
+    try:
+        bound = inspect.signature(fun).bind(*args, **kwargs)
+        bound.apply_defaults()
+        return dict(bound.arguments)
+    except (TypeError, ValueError):
+        return None
+
+
+class _BlockExporter:
+    # op name -> (tensor arg names in ONNX input order, attr arg names)
+    SPECS = {
+        "convolution": (("data", "weight", "bias"),
+                        ("kernel", "stride", "dilate", "pad", "num_group")),
+        "fully_connected": (("data", "weight", "bias"), ("flatten",)),
+        "batch_norm": (("data", "gamma", "beta", "moving_mean",
+                        "moving_var"), ("eps",)),
+        "activation": (("data",), ("act_type",)),
+        "leaky_relu": (("data", "gamma"), ("act_type", "slope")),
+        "pooling": (("data",), ("kernel", "stride", "pad", "pool_type",
+                                "global_pool", "count_include_pad")),
+        "embedding": (("data", "weight"), ()),
+        "layer_norm": (("data", "gamma", "beta"), ("axis", "eps")),
+        "softmax": (("data",), ("axis",)),
+        "log_softmax": (("data",), ("axis",)),
+    }
+    # capture name -> converter key
+    ALIAS = {"activation": "Activation", "convolution": "Convolution",
+             "batch_norm": "BatchNorm", "fully_connected": "FullyConnected",
+             "pooling": "Pooling", "embedding": "Embedding",
+             "leaky_relu": "LeakyReLU", "layer_norm": "LayerNorm",
+             "add": "broadcast_add", "subtract": "broadcast_sub",
+             "multiply": "broadcast_mul", "true_divide": "broadcast_div",
+             "divide": "broadcast_div"}
+
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self.extra_init = []
+        self.names = {}          # buffer id -> onnx name
+        self.counter = 0
+        self.inlined = set()
+
+    def fresh(self, base):
+        self.counter += 1
+        return f"{base}_{self.counter}"
+
+    def resolve(self, nd):
+        """Name for an input NDArray; unseen arrays become constant
+        initializers (values baked at export, like reference params)."""
+        key = _buf_id(nd)
+        if key in self.names:
+            return self.names[key]
+        nm = self.fresh("const")
+        self.initializers.append(P.tensor_proto(
+            nm, onp.asarray(nd._data)))
+        self.names[key] = nm
+        return nm
+
+    def handle(self, name, fun, args, kwargs, res):
+        in_leaves = _nd_leaves((args, kwargs))
+        out_leaves = _nd_leaves(res)
+        if not in_leaves:
+            # creation op (zeros/arange/...): bake the result
+            for o in out_leaves:
+                self.names.setdefault(_buf_id(o), None)
+            for o in out_leaves:
+                key = _buf_id(o)
+                if self.names[key] is None:
+                    nm = self.fresh(name or "const")
+                    self.initializers.append(
+                        P.tensor_proto(nm, onp.asarray(o._data)))
+                    self.names[key] = nm
+            return
+        nm = self.fresh(name)
+        if name.startswith("rnn_"):
+            self._handle_rnn(nm, name, args, res)
+            return
+        bound = _bind(fun, args, kwargs)
+        spec = self.SPECS.get(name)
+        if spec is not None and bound is not None:
+            tensor_names, attr_names = spec
+            ins = []
+            for t in tensor_names:
+                v = bound.get(t)
+                ins.append(self.resolve(v) if v is not None and
+                           hasattr(v, "_data") else None)
+            while ins and ins[-1] is None:
+                ins.pop()
+            attrs = {k: bound[k] for k in attr_names
+                     if bound.get(k) is not None}
+        else:
+            # no spec: recover scalar parameters through the real
+            # signature so positionally-passed attrs (np.clip(x, 0, 6),
+            # np.mean(x, 1)) survive export instead of silently dropping
+            ins, attrs = self._generic_ins_attrs(name, fun, args, kwargs,
+                                                 in_leaves)
+        conv = _CONVERTERS.get(self.ALIAS.get(name, name)) or \
+            _CONVERTERS.get(name)
+        if conv is None:
+            raise NotImplementedError(
+                f"no ONNX converter for captured op {name!r}")
+        try:
+            new_nodes = conv(nm, ins, attrs, extra_init=self.extra_init)
+        except TypeError:
+            new_nodes = conv(nm, ins, attrs)
+        self.nodes.extend(new_nodes)
+        outs = [nm] + [f"{nm}_{i}" for i in range(1, len(out_leaves))]
+        for o, onm in zip(out_leaves, outs):
+            self.names[_buf_id(o)] = onm
+
+    _ATTR_ALIAS = {"min": "a_min", "max": "a_max", "a": None, "x": None,
+                   "arr": None, "data": None}
+    _SIMPLE = (int, float, bool, str, tuple, list)
+    # elementwise binaries: a scalar operand is a CONSTANT INPUT (ONNX
+    # tensor), never an attribute
+    _BINARY = {"add", "subtract", "multiply", "true_divide", "divide",
+               "power", "maximum", "minimum", "mod", "equal", "greater",
+               "less", "matmul", "dot", "_plus", "_minus", "_mul", "_div",
+               "_power", "broadcast_add", "broadcast_sub", "broadcast_mul",
+               "broadcast_div", "where"}
+
+    def _scalar_const(self, v):
+        nm = self.fresh("const")
+        self.initializers.append(P.tensor_proto(
+            nm, onp.asarray(v, onp.float32)))
+        return nm
+
+    def _generic_ins_attrs(self, name, fun, args, kwargs, in_leaves):
+        if name in self._BINARY:
+            ins = [self.resolve(a) if hasattr(a, "_data")
+                   else self._scalar_const(a) for a in args]
+            return ins, {}
+        bound = _bind(fun, args, kwargs)
+        if bound is None:
+            return ([self.resolve(x) for x in in_leaves],
+                    {k: v for k, v in kwargs.items()
+                     if isinstance(v, self._SIMPLE)})
+        ins, attrs = [], {}
+        for k, v in bound.items():
+            if hasattr(v, "_data"):
+                ins.append(self.resolve(v))
+            elif isinstance(v, self._SIMPLE) and k not in ("out", "order",
+                                                           "where"):
+                key = self._ATTR_ALIAS.get(k, k)
+                if key is not None:
+                    attrs[key] = v
+        return ins, attrs
+
+    def _handle_rnn(self, nm, name, args, res):
+        mode = name[len("rnn_"):]
+        if mode.endswith("_bi"):
+            raise NotImplementedError(
+                "bidirectional RNN ONNX export not supported")
+        x, h0, c0 = args[0], args[1], args[2]
+        flat_w = args[3:]
+        assert len(flat_w) % 4 == 0
+        weights = []
+        for i in range(0, len(flat_w), 4):
+            wi, bi, wh, bh = (onp.asarray(w._data) for w in flat_w[i:i + 4])
+            weights.append((wi, bi, wh, bh))
+        hidden = weights[0][2].shape[1]
+        ins = [self.resolve(x), self.resolve(h0), self.resolve(c0)]
+        self.nodes.extend(_rnn_onnx_nodes(
+            nm, ins, {"mode": mode, "hidden": hidden},
+            self.extra_init, weights))
+        out_leaves = _nd_leaves(res)
+        outs = [nm] + [f"{nm}_{i}" for i in range(1, len(out_leaves))]
+        for o, onm in zip(out_leaves, outs):
+            self.names[_buf_id(o)] = onm
+
+
+def export_block(block, example_args, onnx_file_path="model.onnx",
+                 input_names=None, opset_version=17):
+    """Export a Gluon (Hybrid)Block to ONNX by capturing one eval-mode
+    forward (reference flow: hybridize trace -> symbol -> mx2onnx
+    `export_model`).  ``example_args``: tuple of NDArrays fixing input
+    shapes.  Parameters become initializers named by `collect_params`
+    keys."""
+    from ...ndarray.ndarray import NDArray
+    from ...ops.invoke import _CaptureScope
+
+    if not isinstance(example_args, (list, tuple)):
+        example_args = (example_args,)
+    example_args = [a if isinstance(a, NDArray) else NDArray(a)
+                    for a in example_args]
+    block(*example_args)  # ensure shapes/params initialized
+    ex = _BlockExporter()
+
+    input_names = input_names or [f"data{i}" if i else "data"
+                                  for i in range(len(example_args))]
+    for a, nm in zip(example_args, input_names):
+        ex.names[_buf_id(a)] = nm
+
+    # parameters by identity of their per-device buffers
+    params = block.collect_params()
+    param_names = {}
+    for pname, p in params.items():
+        try:
+            datas = p.list_data()
+        except Exception:
+            datas = [p.data()] if p._data is not None else []
+        for d in datas:
+            ex.names[_buf_id(d)] = pname
+            param_names[_buf_id(d)] = pname
+
+    with _CaptureScope() as cap:
+        out = block(*example_args)
+    for entry in cap.entries:
+        ex.handle(*entry)
+
+    out_leaves = _nd_leaves(out)
+    g_outputs = []
+    for o in out_leaves:
+        onm = ex.names.get(_buf_id(o))
+        if onm is None:
+            raise RuntimeError("block output was not produced by a "
+                               "captured op (non-invoke path?)")
+        g_outputs.append(P.value_info(onm, tuple(o.shape)))
+
+    # parameter initializers
+    emitted = set()
+    for pname, p in params.items():
+        try:
+            datas = p.list_data()
+        except Exception:
+            datas = [p.data()] if p._data is not None else []
+        for d in datas:
+            if ex.names.get(_buf_id(d)) == pname and pname not in emitted:
+                ex.initializers.append(
+                    P.tensor_proto(pname, onp.asarray(d._data)))
+                emitted.add(pname)
+
+    g_inputs = [P.value_info(nm, tuple(a.shape))
+                for a, nm in zip(example_args, input_names)]
+    graph = P.graph_proto(ex.nodes, "mxnet_tpu_block",
+                          ex.initializers + ex.extra_init,
                           g_inputs, g_outputs)
     blob = P.model_proto(graph, opset=opset_version)
     with open(onnx_file_path, "wb") as f:

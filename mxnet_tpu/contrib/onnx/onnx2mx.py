@@ -39,7 +39,9 @@ def _parse_attr(data):
             out["t"] = _parse_tensor(v)
         elif f == 7:
             out.setdefault("ints", []).append(P.signed64(v))
-    val = out.get("ints")
+        elif f == 8:
+            out.setdefault("strings", []).append(v.decode())
+    val = out.get("ints", out.get("strings"))
     if val is None:
         val = out.get("i", out.get("f", out.get("s", out.get("t"))))
     return name, val
@@ -132,9 +134,10 @@ def _parse_model(data):
 # -- ONNX op -> Symbol builders ---------------------------------------------
 
 
-def _build(node, ins, consts, sym_mod):
+def _build(node, ins, consts, sym_mod, shape_of=None):
     op = node["op"]
     a = node["attrs"]
+    shape_of = shape_of or {}
 
     def tup(key, default=None):
         v = a.get(key, default)
@@ -204,16 +207,182 @@ def _build(node, ins, consts, sym_mod):
         return sym_mod.LeakyReLU(ins[0], ins[1], act_type="prelu")
     if op == "Softplus":
         return sym_mod.Activation(ins[0], act_type="softrelu")
+    if op == "Pad":
+        pads = consts.get(node["inputs"][1])
+        if pads is None:
+            raise NotImplementedError("dynamic Pad input")
+        n = len(pads) // 2
+        # legacy flat layout: (before0, after0, before1, after1, ...)
+        pw = []
+        for i in range(n):
+            pw.extend([int(pads[i]), int(pads[i + n])])
+        mode = a.get("mode", "constant")
+        cval = 0.0
+        if len(node["inputs"]) > 2 and node["inputs"][2] in consts:
+            cval = float(consts[node["inputs"][2]])
+        return sym_mod.Pad(ins[0], mode=mode, pad_width=tuple(pw),
+                           constant_value=cval)
+    if op == "Clip":
+        amin = float(consts[node["inputs"][1]]) \
+            if len(node["inputs"]) > 1 and node["inputs"][1] else None
+        amax = float(consts[node["inputs"][2]]) \
+            if len(node["inputs"]) > 2 and node["inputs"][2] else None
+        return sym_mod.clip(ins[0], amin, amax)
+    if op == "Slice":
+        starts = consts[node["inputs"][1]]
+        ends = consts[node["inputs"][2]]
+        axes = consts[node["inputs"][3]] if len(node["inputs"]) > 3 \
+            else onp.arange(len(starts))
+        steps = consts[node["inputs"][4]] if len(node["inputs"]) > 4 \
+            else onp.ones(len(starts), onp.int64)
+        out = ins[0]
+        big = 2 ** 31 - 1
+        for st, en, ax, sp in zip(starts, ends, axes, steps):
+            if int(sp) != 1:
+                raise NotImplementedError("strided ONNX Slice")
+            out = sym_mod.slice_axis(
+                out, axis=int(ax), begin=int(st),
+                end=None if int(en) >= big else int(en))
+        return out
+    if op == "Where":
+        return sym_mod.where(*ins)
+    if op == "Unsqueeze":
+        axes = consts.get(node["inputs"][1]) if len(node["inputs"]) > 1 \
+            else a.get("axes")
+        out = ins[0]
+        for ax in sorted(int(x) for x in axes):
+            out = sym_mod.expand_dims(out, axis=ax)
+        return out
+    if op == "Squeeze":
+        axes = consts.get(node["inputs"][1]) if len(node["inputs"]) > 1 \
+            else a.get("axes")
+        if axes is None:
+            return sym_mod.squeeze(ins[0])
+        axes = tuple(int(x) for x in axes)
+        return sym_mod.squeeze(ins[0],
+                               axis=axes[0] if len(axes) == 1 else axes)
+    if op == "Expand":
+        shape_src = shape_of.get(node["inputs"][1])
+        if shape_src is not None:
+            return sym_mod.broadcast_like(ins[0], shape_src)
+        shape = consts.get(node["inputs"][1])
+        if shape is None:
+            raise NotImplementedError("dynamic Expand shape")
+        return sym_mod.broadcast_to(ins[0],
+                                    shape=tuple(int(s) for s in shape))
+    if op == "TopK":
+        k = int(consts[node["inputs"][1]][0])
+        axis = int(a.get("axis", -1))
+        is_ascend = not bool(a.get("largest", 1))
+        vals = sym_mod.topk(ins[0], k=k, axis=axis, ret_typ="value",
+                            is_ascend=is_ascend)
+        idx = sym_mod.topk(ins[0], k=k, axis=axis, ret_typ="indices",
+                           is_ascend=is_ascend)
+        return [vals, idx]
+    if op in ("ReduceSum", "ReduceMean", "ReduceMax", "ReduceMin",
+              "ReduceProd", "ReduceL2"):
+        axes = a.get("axes")
+        if axes is None and len(node["inputs"]) > 1:
+            axes = consts.get(node["inputs"][1])
+        axis = tuple(int(x) for x in axes) if axes is not None else None
+        keep = bool(a.get("keepdims", 1))
+        if op == "ReduceL2":
+            return sym_mod.norm(ins[0], ord=2, axis=axis, keepdims=keep)
+        fn = {"ReduceSum": "sum", "ReduceMean": "mean", "ReduceMax": "max",
+              "ReduceMin": "min", "ReduceProd": "prod"}[op]
+        return getattr(sym_mod, fn)(ins[0], axis=axis, keepdims=keep)
+    if op == "ArgMax":
+        out = sym_mod.argmax(ins[0], axis=int(a.get("axis", 0)))
+        if a.get("keepdims", 1):
+            out = sym_mod.expand_dims(out, axis=int(a.get("axis", 0)))
+        return out
+    if op == "LayerNormalization":
+        return sym_mod.layer_norm(ins[0], ins[1], ins[2],
+                                  axis=int(a.get("axis", -1)),
+                                  eps=float(a.get("epsilon", 1e-5)))
+    if op == "LogSoftmax":
+        return sym_mod.log_softmax(ins[0], axis=int(a.get("axis", -1)))
+    if op in ("LSTM", "GRU", "RNN"):
+        return _import_rnn(op, node, ins, consts, sym_mod, a)
     simple = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
               "Exp": "exp", "Log": "log", "Sqrt": "sqrt", "Abs": "abs",
               "Neg": "negative", "Identity": "identity",
               "Add": "broadcast_add", "Sub": "broadcast_sub",
               "Mul": "broadcast_mul", "Div": "broadcast_div",
-              "Max": "maximum", "Min": "minimum",
-              "Softsign": "softsign"}
+              "Max": "maximum", "Min": "minimum", "Pow": "power",
+              "Mod": "mod", "Equal": "equal", "Greater": "greater",
+              "Less": "less", "Softsign": "softsign"}
     if op in simple:
         return getattr(sym_mod, simple[op])(*ins)
     raise NotImplementedError(f"no importer for ONNX op {op!r}")
+
+
+def _import_rnn(op, node, ins, consts, sym_mod, a):
+    """ONNX LSTM/GRU/RNN -> legacy fused `RNN` symbol
+    (`src/operator/rnn.cc:295` packed-parameter layout).  W/R/B must be
+    initializers; gate order is permuted back from ONNX (i,o,f,c / z,r,h)
+    to MXNet (i,f,g,o / r,z,n)."""
+    W = consts.get(node["inputs"][1])
+    R = consts.get(node["inputs"][2])
+    B = consts.get(node["inputs"][3])
+    if W is None or R is None or B is None:
+        raise NotImplementedError("ONNX RNN with non-initializer weights")
+    if W.shape[0] != 1:
+        raise NotImplementedError("bidirectional ONNX RNN import")
+    hidden = int(a["hidden_size"])
+    mode = {"LSTM": "lstm", "GRU": "gru", "RNN": "rnn_tanh"}[op]
+    if op == "RNN":
+        acts = a.get("activations")
+        if acts and "relu" in str(acts).lower():
+            mode = "rnn_relu"
+    if op == "GRU" and not int(a.get("linear_before_reset", 0) or 0):
+        # backend GRU math is linear_before_reset=1; a lbr=0 model only
+        # matches when the recurrent bias of the candidate gate is zero
+        gh3 = B.shape[1] // 2
+        rbn = B[0][gh3:][2 * (gh3 // 3):]
+        if onp.abs(rbn).max() > 0:
+            raise NotImplementedError(
+                "ONNX GRU with linear_before_reset=0 and nonzero Rb_h "
+                "has no equivalent in this backend's fused GRU")
+
+    def unperm(w):
+        if op == "LSTM":   # onnx i,o,f,c -> mxnet i,f,g,o
+            i, o, f, c = onp.split(w, 4, axis=0)
+            return onp.concatenate([i, f, c, o], axis=0)
+        if op == "GRU":    # onnx z,r,h -> mxnet r,z,n
+            z, r, h = onp.split(w, 3, axis=0)
+            return onp.concatenate([r, z, h], axis=0)
+        return w
+
+    Wm = unperm(W[0])
+    Rm = unperm(R[0])
+    gh = Wm.shape[0]
+    Wb = unperm(B[0][:gh])
+    Rb = unperm(B[0][gh:])
+    packed = onp.concatenate([Wm.ravel(), Rm.ravel(), Wb.ravel(),
+                              Rb.ravel()]).astype(onp.float32)
+    pname = node["outputs"][0] + "_parameters"
+    consts[pname] = packed  # materialized into arg_params by import_model
+    params_var = sym_mod.var(pname)
+    nout = 3 if op == "LSTM" else 2
+    sym_ins = [ins[0], params_var]
+    # initial_h is input 5, initial_c input 6 (input 4 = sequence_lens)
+    h0 = ins[5] if len(ins) > 5 and node["inputs"][5] else None
+    if h0 is None:
+        raise NotImplementedError("ONNX RNN without initial_h")
+    sym_ins.append(h0)
+    if op == "LSTM":
+        sym_ins.append(ins[6])
+    rnn_sym = sym_mod.Symbol(
+        "RNN", sym_ins,
+        {"mode": mode, "state_size": hidden, "num_layers": 1,
+         "state_outputs": True}, name=node["outputs"][0], nout=nout)
+    # ONNX Y is (T, num_dir, N, H): re-add the dir axis
+    y = sym_mod.expand_dims(rnn_sym[0], axis=1)
+    outs = [y, rnn_sym[1]]
+    if op == "LSTM":
+        outs.append(rnn_sym[2])
+    return outs
 
 
 def import_model(model_file):
@@ -232,27 +401,45 @@ def import_model(model_file):
         env.setdefault(name, sym_mod.var(name))
 
     aux_names = set()
+    shape_of = {}  # ONNX Shape outputs: name -> source Symbol
     for node in nodes:
         ins = []
         for i in node["inputs"]:
+            if i == "":
+                ins.append(None)
+                continue
             if i not in env:
                 env[i] = sym_mod.var(i)
             ins.append(env[i])
+        if node["op"] == "Shape":
+            shape_of[node["outputs"][0]] = ins[0]
+            continue
         if node["op"] == "BatchNormalization":
             # running mean/var (inputs 3,4) are aux state, as in the
             # reference importer
             aux_names.update(node["inputs"][3:5])
-        out = _build(node, ins, inits, sym_mod)
-        out._name = node["outputs"][0]
-        env[node["outputs"][0]] = out
+        out = _build(node, ins, inits, sym_mod, shape_of)
+        if isinstance(out, (list, tuple)):
+            for o, out_name in zip(out, node["outputs"]):
+                if out_name:
+                    o._name = out_name
+                    env[out_name] = o
+        else:
+            out._name = node["outputs"][0]
+            env[node["outputs"][0]] = out
 
     outputs = [env[o] for o in g_out]
     out_sym = outputs[0] if len(outputs) == 1 else sym_mod.Group(outputs)
 
+    # exactly the initializers the BUILT graph still references as free
+    # variables become params; ones consumed at build time (Reshape
+    # shapes, Slice starts, Clip bounds turned into attrs, RNN raw W/R/B
+    # repacked into `*_parameters`) are dropped
+    free = set(out_sym.list_arguments())
     arg_params, aux_params = {}, {}
     for name, arr in inits.items():
-        if name.startswith("const_") or name.endswith("_shape"):
-            continue  # inlined constants consumed at build time
+        if name not in free:
+            continue
         target = aux_params if name in aux_names else arg_params
         target[name] = NDArray(onp.ascontiguousarray(arr))
     return out_sym, arg_params, aux_params

@@ -166,6 +166,12 @@ def attr_string(name, s):
     return f_string(1, name) + f_bytes(4, s.encode()) + f_varint(20, 3)
 
 
+def attr_strings(name, vals):
+    """AttributeProto STRINGS (type=8): strings=8 repeated bytes."""
+    return f_string(1, name) + \
+        b"".join(f_bytes(8, v.encode()) for v in vals) + f_varint(20, 8)
+
+
 def node_proto(op_type, inputs, outputs, name="", attrs=()):
     """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
     return b"".join(
@@ -194,7 +200,7 @@ def graph_proto(nodes, name, initializers, inputs, outputs):
         [f_msg(12, o) for o in outputs])
 
 
-def model_proto(graph, producer="mxnet_tpu", opset=13):
+def model_proto(graph, producer="mxnet_tpu", opset=17):
     """ModelProto: ir_version=1, producer_name=2, graph=7,
     opset_import=8 {domain=1, version=2}."""
     opset_id = f_string(1, "") + f_varint(2, opset)

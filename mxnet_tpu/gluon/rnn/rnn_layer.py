@@ -215,7 +215,8 @@ class _RNNLayer(HybridBlock):
         h0 = states[0]
         c0 = states[1] if mode == "lstm" else states[0]
         out, hn, cn = invoke(fused, (inputs, h0, c0) + tuple(weights),
-                             name=f"rnn_{mode}")
+                             name=f"rnn_{mode}" + ("_bi" if ndir == 2
+                                                   else ""))
         if layout == "NTC":
             out = out.swapaxes(0, 1)
         if not explicit_states:

@@ -149,6 +149,34 @@ def _profiler_hook():
     return (lambda: _t.perf_counter() * 1e6, _p.record_op)
 
 
+class _CaptureScope:
+    """Graph-capture hook: while active, every ``invoke`` appends
+    ``(op_name, fun, args, kwargs, result)`` — with live NDArrays — to
+    ``self.entries``.  Used by the ONNX exporter to lift an imperative
+    Gluon forward into a symbolic graph (the deferred-compute analogue of
+    `python/mxnet/gluon/block.py:994` `_build_cache`, but for export)."""
+
+    def __init__(self):
+        self.entries = []
+
+    def __enter__(self):
+        _capture_stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _capture_stack.pop()
+        return False
+
+
+_capture_stack = []
+
+
+def _capture_record(name, fun, args, kwargs, res):
+    if _capture_stack:
+        _capture_stack[-1].entries.append(
+            (name or getattr(fun, "__name__", "op"), fun, args, kwargs, res))
+
+
 def invoke(fun, args, kwargs=None, name=None, differentiable=True, wrap=True):
     """Dispatch ``fun`` (a pure function over jax arrays) imperatively.
 
@@ -185,7 +213,10 @@ def invoke(fun, args, kwargs=None, name=None, differentiable=True, wrap=True):
         else:
             out = fun(*a, **kw)
         _naive_sync(out)
-        return _wrap_out(out, ctx, None, name) if wrap else out
+        res = _wrap_out(out, ctx, None, name) if wrap else out
+        if wrap:
+            _capture_record(name, fun, args, kwargs, res)
+        return res
 
     diff_idx = [i for i in nd_idx if _attached(leaves[i]) and _is_float(datas[i])]
     flat_const = list(datas)
@@ -237,7 +268,10 @@ def invoke(fun, args, kwargs=None, name=None, differentiable=True, wrap=True):
         treedef=treedef,
         diff_idx=diff_idx,
     )
-    return _wrap_out(out, ctx, node, name) if wrap else out
+    res = _wrap_out(out, ctx, node, name) if wrap else out
+    if wrap:
+        _capture_record(name, fun, args, kwargs, res)
+    return res
 
 
 def _naive_sync(out):
