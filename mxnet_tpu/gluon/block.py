@@ -164,6 +164,11 @@ class Block:
                         dtype_source="current"):
         from ..utils.serialization import load_ndarrays
         loaded = load_ndarrays(filename, ctx=ctx)
+        # Module-era checkpoints (reference `model.py save_checkpoint`)
+        # prefix names with "arg:"/"aux:"; reference load_parameters
+        # strips them (`python/mxnet/gluon/block.py:376`)
+        loaded = {k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                  else k: v for k, v in loaded.items()}
         params = self._collect_params_with_prefix()
         for name, param in params.items():
             if name not in loaded:

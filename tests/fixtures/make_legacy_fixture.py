@@ -1,0 +1,70 @@
+"""Generate tests/fixtures/lenet_legacy_0x112.params — a byte-exact
+reference-format NDArray list file, written with raw struct.pack only
+(independent of mxnet_tpu's own reader/writer) so the committed fixture
+certifies 0x112 interop, not self-consistency.
+
+Every write below is annotated with the reference code that defines it:
+- list container: `src/ndarray/ndarray.cc:1962-1970` (kMXAPINDArrayListMagic
+  0x112, u64 reserved, dmlc vector<NDArray>, vector<string>)
+- per-array V2 record: `src/ndarray/ndarray.cc:1729-1795` (NDARRAY_V2_MAGIC,
+  i32 stype, TShape::Save, Context::Save, i32 type_flag, raw data)
+- TShape::Save: u32 ndim + i64 per dim (`include/mxnet/tuple.h` Save with
+  int64 dims)
+- Context::Save: i32 dev_type (1 = kCPU), i32 dev_id
+  (`include/mxnet/base.h` Context::Save)
+- dmlc string vector: u64 count, then u64 length + bytes per string
+
+Names carry the Module-era "arg:"/"aux:" prefixes that
+`model.py:save_checkpoint` wrote, so the fixture also exercises prefix
+stripping in Block.load_parameters.
+"""
+import struct
+
+import numpy as onp
+
+V2_MAGIC = 0xF993FAC9          # ndarray.cc NDARRAY_V2_MAGIC
+KCPU = 1                        # base.h Context::kCPU
+TYPE_FLAG_F32 = 0               # mshadow kFloat32
+
+
+def nd_record(arr):
+    out = [struct.pack("<I", V2_MAGIC)]
+    out.append(struct.pack("<i", 0))                     # stype dense
+    out.append(struct.pack("<I", arr.ndim))              # TShape ndim
+    out.append(struct.pack("<" + "q" * arr.ndim, *arr.shape))
+    out.append(struct.pack("<ii", KCPU, 0))              # Context cpu(0)
+    out.append(struct.pack("<i", TYPE_FLAG_F32))         # type_flag
+    out.append(onp.ascontiguousarray(arr, onp.float32).tobytes())
+    return b"".join(out)
+
+
+def main():
+    rs = onp.random.RandomState(20260730)
+    arrays = {
+        # Gluon 2.0 structural names (HybridSequential children "0","1")
+        "arg:0.weight": rs.randn(8, 1, 3, 3).astype(onp.float32),
+        "arg:0.bias": rs.randn(8).astype(onp.float32),
+        "arg:1.weight": rs.randn(10, 8 * 13 * 13).astype(onp.float32),
+        "arg:1.bias": rs.randn(10).astype(onp.float32),
+        "aux:extra.running_mean": rs.randn(8).astype(onp.float32),
+        "aux:extra.running_var":
+            onp.abs(rs.randn(8)).astype(onp.float32) + 0.5,
+    }
+    blob = [struct.pack("<QQ", 0x112, 0)]                # magic + reserved
+    blob.append(struct.pack("<Q", len(arrays)))          # vector<NDArray>
+    for arr in arrays.values():
+        blob.append(nd_record(arr))
+    blob.append(struct.pack("<Q", len(arrays)))          # vector<string>
+    for name in arrays:
+        b = name.encode()
+        blob.append(struct.pack("<Q", len(b)) + b)
+    with open(__file__.replace("make_legacy_fixture.py",
+                               "lenet_legacy_0x112.params"), "wb") as f:
+        f.write(b"".join(blob))
+    # print checksums for the test to assert against
+    for name, arr in arrays.items():
+        print(name, float(arr.sum()))
+
+
+if __name__ == "__main__":
+    main()
