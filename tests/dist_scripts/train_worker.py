@@ -118,12 +118,64 @@ def check_compression(rank):
     print(f"rank {rank} COMP OK", flush=True)
 
 
+def check_hybrid_tp_dp(rank):
+    """tp x dp hybrid mesh across the 4 processes (8 devices -> dp=4,
+    tp=2): the tensor-parallel FusedTrainStep must produce the same
+    trained weights as the local numpy oracle."""
+    from jax.sharding import PartitionSpec as P2
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import mesh as pmesh
+
+    devs = jax.devices()
+    mesh = pmesh.make_mesh({"dp": len(devs) // 2, "tp": 2}, devices=devs)
+
+    mx.random.seed(11)
+    net = gluon.nn.Dense(8, use_bias=False)
+    net.initialize()
+
+    class WithLoss(gluon.block.HybridBlock):
+        def __init__(self, n):
+            super().__init__()
+            self.n = n
+
+        def forward(self, x, y):
+            d = self.n(x) - y
+            return (d * d).mean()
+
+    mod = WithLoss(net)
+    rs = onp.random.RandomState(17)
+    x = rs.rand(16, 6).astype("f")
+    y = rs.rand(16, 8).astype("f")
+    mod(mx.np.array(x), mx.np.array(y))
+    w0 = net.weight.data().asnumpy().copy()
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.2})
+    step = gluon.FusedTrainStep(
+        mod, trainer, mesh=mesh,
+        partition_rules=[(r".*weight", P2("tp", None))],
+        data_spec=P2("dp"))
+    loss = step(mx.np.array(x), mx.np.array(y), batch_size=1)
+
+    pred = x @ w0.T
+    d = pred - y
+    gw = (2 * d / d.size).T @ x
+    w_exp = w0 - 0.2 * gw
+    onp.testing.assert_allclose(net.weight.data().asnumpy(), w_exp,
+                                rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(float(loss.asnumpy()), (d * d).mean(),
+                                rtol=1e-4)
+    print(f"rank {rank} HYBRID OK", flush=True)
+
+
 def main():
     rank = jax.process_index()
     nproc = jax.process_count()
     assert nproc == 4, nproc
     assert len(jax.devices()) == 8, jax.devices()
     check_train_step_parity(rank)
+    check_hybrid_tp_dp(rank)
     check_big_array(rank, nproc)
     check_compression(rank)
     check_failure_detection(rank)
