@@ -216,7 +216,11 @@ class TPUICIStore(KVStoreBase):
         self._residuals = {}
 
     def pushpull(self, key, value, out=None, priority=0):
+        from ..ndarray.sparse import RowSparseNDArray
+
         vals = value if isinstance(value, (list, tuple)) else [value]
+        if isinstance(vals[0], RowSparseNDArray):
+            return self._pushpull_row_sparse(key, vals, out)
         if len(vals) == 1:
             # SPMD path: a single (possibly sharded) array — XLA already
             # reduced over the data axis inside the jitted step.
@@ -260,6 +264,43 @@ class TPUICIStore(KVStoreBase):
             total = total + jax.device_put(lvl, dev0).astype(jnp.int32)
         out = total.astype(vals[0]._data.dtype) * thr
         return NDArray(out, ctx=vals[0].ctx)
+
+    def _pushpull_row_sparse(self, key, vals, out=None):
+        """Row-sparse pushpull (reference Trainer sparse push+pull,
+        `python/mxnet/gluon/trainer.py:385-409` + `kvstore_local.h`
+        ReduceRowSparse): unique-union the touched rows across copies,
+        segment-sum the values, and scatter the reduced (indices, data)
+        back onto every copy's own device.  Eager path — row-sparse
+        gradients are eager by design (PARITY.md)."""
+        from ..ndarray.sparse import RowSparseNDArray
+
+        idx_host = [onp.asarray(v.indices) for v in vals]
+        union = onp.unique(onp.concatenate(idx_host)) if idx_host else \
+            onp.zeros((0,), onp.int32)
+        cols = vals[0].shape[1:]
+        dev0 = None
+        for v in vals:
+            if isinstance(v.data, jax.Array):
+                dev0 = list(v.data.devices())[0]
+                break
+        total = jnp.zeros((len(union),) + tuple(cols), vals[0].dtype)
+        for v, ih in zip(vals, idx_host):
+            seg = onp.searchsorted(union, ih).astype(onp.int32)
+            d = jax.device_put(v.data, dev0) if dev0 is not None else \
+                jnp.asarray(v.data)
+            total = total.at[jnp.asarray(seg)].add(d)
+        union = union.astype(onp.int32)
+        targets = vals if out is None else (
+            out if isinstance(out, (list, tuple)) else [out])
+        for t in targets:
+            if not isinstance(t, RowSparseNDArray):
+                raise MXNetError(
+                    "row_sparse pushpull requires row_sparse outputs")
+            tdev = list(t.data.devices())[0] \
+                if isinstance(t.data, jax.Array) and t.data.size else dev0
+            data = jax.device_put(total, tdev) if tdev is not None else total
+            t._set_rows(union, data)
+        return None
 
     def _reduce_copies(self, vals):
         """Sum per-device copies with one compiled allreduce (ICI ring).
