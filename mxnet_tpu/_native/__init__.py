@@ -320,3 +320,42 @@ def img_lib():
         L.imgpipe_destroy.argtypes = [ctypes.c_void_p]
         _img_lib = L
         return _img_lib
+
+
+def parse_csv(path):
+    """Parse a numeric CSV through the C++ core (`src/csv.cc`, reference
+    `src/io/iter_csv.cc` role): returns a float32 (rows, cols) numpy
+    array.  Falls back to numpy parsing when the native library is
+    unavailable."""
+    import numpy as onp
+
+    L = lib()
+    if L is not None:
+        if not getattr(L, "_csv_ready", False):
+            L.csv_last_error.restype = ctypes.c_char_p
+            L.csv_open.restype = ctypes.c_void_p
+            L.csv_open.argtypes = [ctypes.c_char_p]
+            L.csv_close.argtypes = [ctypes.c_void_p]
+            L.csv_rows.restype = ctypes.c_int64
+            L.csv_rows.argtypes = [ctypes.c_void_p]
+            L.csv_cols.restype = ctypes.c_int64
+            L.csv_cols.argtypes = [ctypes.c_void_p]
+            L.csv_copy.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_float)]
+            L._csv_ready = True
+        h = L.csv_open(os.fsencode(path))
+        if not h:
+            raise IOError(L.csv_last_error().decode())
+        try:
+            rows, cols = L.csv_rows(h), L.csv_cols(h)
+            out = onp.empty((rows, cols), onp.float32)
+            if out.size:
+                L.csv_copy(h, out.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float)))
+            return out
+        finally:
+            L.csv_close(h)
+    # fallback: numpy text parsing
+    out = onp.loadtxt(path, delimiter=",", dtype=onp.float32, ndmin=2,
+                      comments="#")
+    return out

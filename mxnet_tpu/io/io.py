@@ -283,20 +283,21 @@ class NDArrayIter(DataIter):
 
 
 class CSVIter(DataIter):
-    """Iterate rows of a CSV file (python equivalent of the C++
-    `CSVIter`, `src/io/iter_csv.cc`): fixed `data_shape` per row, optional
-    label CSV, round-robin padding of the last batch."""
+    """Iterate rows of a CSV file (native parse via `src/csv.cc`, the
+    C++ `CSVIter` role, `src/io/iter_csv.cc`): fixed `data_shape` per
+    row, optional label CSV, round-robin padding of the last batch."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
                  batch_size=1, round_batch=True, dtype="float32",
                  data_name="data", label_name="softmax_label"):
+        from .._native import parse_csv
+
         super().__init__(batch_size)
-        data = onp.loadtxt(data_csv, delimiter=",", dtype=dtype, ndmin=2)
+        data = parse_csv(data_csv).astype(dtype, copy=False)
         n = data.shape[0]
         data = data.reshape((n,) + tuple(data_shape))
         if label_csv is not None:
-            label = onp.loadtxt(label_csv, delimiter=",", dtype=dtype,
-                                ndmin=2)
+            label = parse_csv(label_csv).astype(dtype, copy=False)
             label = label.reshape((n,) + tuple(label_shape))
         else:
             label = onp.zeros((n,) + tuple(label_shape), dtype=dtype)

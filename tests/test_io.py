@@ -173,3 +173,38 @@ def test_batchify_stack_pad_group():
     tokens, labels = next(iter(dl))
     assert tokens.shape == (3, 3)  # padded to the longest in batch
     assert labels.shape == (3,)
+
+
+def test_native_csv_parser_matches_numpy(tmp_path):
+    """src/csv.cc parses the CSVIter input (reference `iter_csv.cc`
+    role); oracle = numpy.loadtxt, plus dialect/edge cases."""
+    from mxnet_tpu._native import lib, parse_csv
+
+    p = tmp_path / "d.csv"
+    rows = onp.random.RandomState(0).randn(17, 5).astype("f")
+    onp.savetxt(p, rows, delimiter=",")
+    got = parse_csv(str(p))
+    onp.testing.assert_allclose(got, rows, rtol=1e-5)
+
+    # comments, blank lines, tabs/spaces
+    p2 = tmp_path / "e.csv"
+    p2.write_text("# header\n1,2,3\n\n4\t5 6\n")
+    got2 = parse_csv(str(p2))
+    onp.testing.assert_array_equal(got2, [[1, 2, 3], [4, 5, 6]])
+
+    if lib() is not None:
+        # ragged rows error out (the reference CHECKs row width too)
+        p3 = tmp_path / "bad.csv"
+        p3.write_text("1,2,3\n4,5\n")
+        import pytest as _pytest
+        with _pytest.raises(IOError, match="ragged"):
+            parse_csv(str(p3))
+
+
+def test_csv_iter_uses_native_parser(tmp_path):
+    p = tmp_path / "x.csv"
+    data = onp.arange(12, dtype="f").reshape(6, 2)
+    onp.savetxt(p, data, delimiter=",")
+    it = mx.io.CSVIter(data_csv=str(p), data_shape=(2,), batch_size=3)
+    batch = it.next()
+    onp.testing.assert_allclose(batch.data[0].asnumpy(), data[:3])
