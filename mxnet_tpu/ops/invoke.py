@@ -104,6 +104,7 @@ class Node:
         "treedef",
         "diff_idx",
         "n_outs",
+        "parent_versions",
     )
 
     def __init__(self, name, vjp_fn, parents, out_structs, out_treedef=None,
@@ -119,6 +120,19 @@ class Node:
         self.flat_const = flat_const
         self.treedef = treedef
         self.diff_idx = diff_idx
+        # MXNET_ENGINE_DEBUG=1 stale-read diagnostics (reference §5.2:
+        # the engine's versioned vars make conflicting access visible;
+        # here buffers are immutable so the tape is always CORRECT, but a
+        # leaf mutated after being read means the gradient describes the
+        # OLD value — worth flagging in debug mode)
+        self.parent_versions = (
+            [getattr(a, "_version", None) for a, _n, _i in parents]
+            if _engine_debug() else None)
+
+
+def _engine_debug():
+    import os
+    return os.environ.get("MXNET_ENGINE_DEBUG", "0") not in ("0", "")
 
 
 def _is_nd(x):
@@ -429,6 +443,19 @@ def _accumulate_and_write(heads, head_grads, retain_graph, create_graph,
             for ct, s in zip(cts, node.out_structs)
         ]
         in_grads = _node_vjp(node, full_cts, create_graph)
+        if node.parent_versions is not None:
+            import warnings
+            for (arr, _pn, _pi), v0 in zip(node.parents,
+                                           node.parent_versions):
+                if v0 is not None and getattr(arr, "_version", v0) != v0:
+                    warnings.warn(
+                        f"[MXNET_ENGINE_DEBUG] stale read in backward of "
+                        f"'{node.name}': an input array was mutated "
+                        f"in-place (version {v0} -> {arr._version}) after "
+                        f"the op recorded it; the gradient flows to the "
+                        f"value read at record time (reference versioned-"
+                        f"var semantics), not the current contents",
+                        stacklevel=2)
         for (arr, pnode, pidx), g in zip(node.parents, in_grads):
             if pnode is not None:
                 from .sparse_grad import RowSparseCT

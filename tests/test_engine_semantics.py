@@ -84,3 +84,39 @@ def test_grad_req_add_accumulates():
             y = (x * x).sum()
         y.backward()
     assert onp.allclose(x.grad.asnumpy(), [6.0, 6.0])  # 3 * 2x
+
+
+def test_engine_debug_flags_stale_read(monkeypatch):
+    """MXNET_ENGINE_DEBUG=1 (reference §5.2 versioned-var visibility): a
+    leaf mutated in place AFTER being consumed by a recorded op gets a
+    stale-read warning at backward — the gradient describes the value at
+    record time."""
+    import warnings
+
+    from mxnet_tpu import autograd
+
+    monkeypatch.setenv("MXNET_ENGINE_DEBUG", "1")
+    x = mx.np.array(onp.array([1.0, 2.0], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    x += 5.0  # in-place mutation after the tape read x
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        y.backward()
+    msgs = [str(w.message) for w in caught]
+    assert any("stale read" in m for m in msgs), msgs
+    # gradient is w.r.t. the RECORDED value (2x at x=[1,2])
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0])
+
+    # without the flag: no warning (zero overhead on the hot path)
+    monkeypatch.setenv("MXNET_ENGINE_DEBUG", "0")
+    x2 = mx.np.array(onp.array([1.0], "f"))
+    x2.attach_grad()
+    with autograd.record():
+        y2 = (x2 * 2).sum()
+    x2 += 1.0
+    with warnings.catch_warnings(record=True) as caught2:
+        warnings.simplefilter("always")
+        y2.backward()
+    assert not [w for w in caught2 if "stale read" in str(w.message)]
