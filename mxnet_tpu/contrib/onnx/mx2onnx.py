@@ -691,6 +691,36 @@ class _BlockExporter:
         if name.startswith("rnn_"):
             self._handle_rnn(nm, name, args, res)
             return
+        if name == "reshape":
+            # the target shape often lives in a closure (Flatten-style
+            # lambdas); the capture is shape-specialized anyway, so the
+            # recorded RESULT's shape is the truth
+            ins = [self.resolve(in_leaves[0])]
+            attrs = {"shape": tuple(int(s) for s in out_leaves[0].shape)}
+            self.nodes.extend(_CONVERTERS["Reshape"](
+                nm, ins, attrs, extra_init=self.extra_init))
+            self.names[_buf_id(out_leaves[0])] = nm
+            return
+        if name in ("concatenate", "concat"):
+            ins = [self.resolve(x) for x in in_leaves]
+            axis = kwargs.get("axis")
+            if axis is None and len(args) > 1 \
+                    and isinstance(args[-1], int):
+                axis = args[-1]
+            if axis is None:
+                # infer: the one axis where input dims sum to the output
+                out_shape = out_leaves[0].shape
+                in_shapes = [x.shape for x in in_leaves]
+                axis = next(
+                    (ax for ax in range(len(out_shape))
+                     if sum(s[ax] for s in in_shapes) == out_shape[ax]
+                     and all(s[:ax] + s[ax + 1:] ==
+                             in_shapes[0][:ax] + in_shapes[0][ax + 1:]
+                             for s in in_shapes)), 0)
+            self.nodes.extend(_CONVERTERS["Concat"](
+                nm, ins, {"dim": int(axis)}))
+            self.names[_buf_id(out_leaves[0])] = nm
+            return
         bound = _bind(fun, args, kwargs)
         spec = self.SPECS.get(name)
         if spec is not None and bound is not None:

@@ -223,9 +223,9 @@ def _build(node, ins, consts, sym_mod, shape_of=None):
         return sym_mod.Pad(ins[0], mode=mode, pad_width=tuple(pw),
                            constant_value=cval)
     if op == "Clip":
-        amin = float(consts[node["inputs"][1]]) \
+        amin = float(onp.ravel(consts[node["inputs"][1]])[0]) \
             if len(node["inputs"]) > 1 and node["inputs"][1] else None
-        amax = float(consts[node["inputs"][2]]) \
+        amax = float(onp.ravel(consts[node["inputs"][2]])[0]) \
             if len(node["inputs"]) > 2 and node["inputs"][2] else None
         return sym_mod.clip(ins[0], amin, amax)
     if op == "Slice":

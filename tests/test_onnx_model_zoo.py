@@ -1,0 +1,45 @@
+"""ONNX export_block across every model-zoo family (one representative
+per family) — the capture exporter must cover the zoo's full op surface
+and the round trip must be numerically exact.
+
+Reference scope: `python/mxnet/contrib/onnx/mx2onnx/_op_translations.py`
+covers the reference zoo; this sweep is the equivalent fence here.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import onnx as mxonnx
+from mxnet_tpu.gluon.model_zoo import vision
+
+# one representative per family, smallest variant (keeps CPU runtime sane)
+FAMILIES = [
+    "resnet18_v1",
+    "resnet18_v2",
+    "alexnet",
+    "squeezenet1_0",
+    "mobilenet0_25",
+    "mobilenet_v2_0_25",
+    "densenet121",
+    "vgg11",
+]
+
+
+@pytest.mark.parametrize("name", FAMILIES)
+def test_model_zoo_onnx_round_trip(name, tmp_path):
+    onp.random.seed(0)
+    net = vision.get_model(name)
+    net.initialize()
+    x = mx.np.array(onp.random.rand(1, 3, 64, 64).astype("f"))
+    try:
+        ref = net(x).asnumpy()
+    except Exception:
+        # some nets need larger spatial extents
+        x = mx.np.array(onp.random.rand(1, 3, 224, 224).astype("f"))
+        ref = net(x).asnumpy()
+    path = str(tmp_path / f"{name}.onnx")
+    mxonnx.export_block(net, (x,), path)
+    sym2, args, aux = mxonnx.import_model(path)
+    got = sym2.eval(data=x, **args, **aux)[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4,
+                                err_msg=f"{name} diverged through ONNX")
