@@ -32,6 +32,7 @@ _models["mobilenet1.0"] = globals()["mobilenet1_0"]
 _models["mobilenet0.75"] = globals()["mobilenet0_75"]
 _models["mobilenet0.5"] = globals()["mobilenet0_5"]
 _models["mobilenet0.25"] = globals()["mobilenet0_25"]
+_models["inceptionv3"] = globals()["inception_v3"]
 
 
 def get_model(name, pretrained=False, ctx=None, root=None, **kwargs):

@@ -22,6 +22,7 @@ FAMILIES = [
     "mobilenet_v2_0_25",
     "densenet121",
     "vgg11",
+    "inception_v3",
 ]
 
 
@@ -30,7 +31,8 @@ def test_model_zoo_onnx_round_trip(name, tmp_path):
     onp.random.seed(0)
     net = vision.get_model(name)
     net.initialize()
-    x = mx.np.array(onp.random.rand(1, 3, 64, 64).astype("f"))
+    side = 299 if "inception" in name else 64
+    x = mx.np.array(onp.random.rand(1, 3, side, side).astype("f"))
     try:
         ref = net(x).asnumpy()
     except Exception:
