@@ -95,7 +95,7 @@ def _act(name, ins, attrs):
 
 @register("LeakyReLU")
 @register("leaky_relu")
-def _leaky(name, ins, attrs):
+def _leaky(name, ins, attrs, extra_init=None):
     act = attrs.get("act_type", "leaky")
     if act == "leaky":
         return [P.node_proto("LeakyRelu", ins[:1], [name], name,
@@ -107,6 +107,27 @@ def _leaky(name, ins, attrs):
                                            float(attrs.get("slope", 0.25)))])]
     if act == "prelu":
         return [P.node_proto("PRelu", ins[:2], [name], name)]
+    if act == "gelu":
+        # exact erf gelu as an opset-17 subgraph:
+        # 0.5 * x * (1 + erf(x / sqrt(2)))
+        extra_init.append(P.tensor_proto(
+            name + "_rsqrt2", onp.asarray(1.0 / onp.sqrt(2.0), onp.float32)))
+        extra_init.append(P.tensor_proto(
+            name + "_half", onp.asarray(0.5, onp.float32)))
+        extra_init.append(P.tensor_proto(
+            name + "_one", onp.asarray(1.0, onp.float32)))
+        x = ins[0]
+        return [
+            P.node_proto("Mul", [x, name + "_rsqrt2"], [name + "_s"],
+                         name + "_s"),
+            P.node_proto("Erf", [name + "_s"], [name + "_e"], name + "_e"),
+            P.node_proto("Add", [name + "_e", name + "_one"],
+                         [name + "_a"], name + "_a"),
+            P.node_proto("Mul", [x, name + "_a"], [name + "_m"],
+                         name + "_m"),
+            P.node_proto("Mul", [name + "_m", name + "_half"], [name],
+                         name),
+        ]
     raise ValueError(f"cannot export LeakyReLU act_type={act}")
 
 
@@ -701,6 +722,18 @@ class _BlockExporter:
                 nm, ins, attrs, extra_init=self.extra_init))
             self.names[_buf_id(out_leaves[0])] = nm
             return
+        if name == "einsum":
+            # ONNX has a first-class Einsum (opset 12+); the equation is
+            # the first positional arg
+            eq = next(a for a in args if isinstance(a, str))
+            ins = [self.resolve(x) for x in in_leaves]
+            self.nodes.append(P.node_proto(
+                "Einsum", ins, [nm], nm, [P.attr_string("equation", eq)]))
+            self.names[_buf_id(out_leaves[0])] = nm
+            return
+        if name == "getitem":
+            self._handle_getitem(nm, fun, in_leaves, out_leaves)
+            return
         if name in ("concatenate", "concat"):
             ins = [self.resolve(x) for x in in_leaves]
             axis = kwargs.get("axis")
@@ -791,6 +824,56 @@ class _BlockExporter:
                 if key is not None:
                     attrs[key] = v
         return ins, attrs
+
+    def _handle_getitem(self, nm, fun, in_leaves, out_leaves):
+        """NDArray.__getitem__ capture: the index is the lambda's closure
+        cell (`ndarray.py:315-317`).  Basic indexing (ints/slices) lowers
+        to ONNX Slice + Squeeze; anything fancier is rejected."""
+        cells = getattr(fun, "__closure__", None) or ()
+        if len(cells) != 1:
+            raise NotImplementedError("getitem index not recoverable")
+        key = cells[0].cell_contents
+        key = key if isinstance(key, tuple) else (key,)
+        src = in_leaves[0]
+        starts, ends, axes, squeeze = [], [], [], []
+        big = 2 ** 31 - 1
+        for ax, k in enumerate(key):
+            if isinstance(k, int):
+                kk = k if k >= 0 else k + src.shape[ax]
+                starts.append(kk)
+                ends.append(kk + 1)
+                axes.append(ax)
+                squeeze.append(ax)
+            elif isinstance(k, slice):
+                if k.step not in (None, 1):
+                    raise NotImplementedError("strided getitem export")
+                if k.start is None and k.stop is None:
+                    continue
+                starts.append(int(k.start or 0))
+                ends.append(big if k.stop is None else int(k.stop))
+                axes.append(ax)
+            else:
+                raise NotImplementedError(
+                    f"getitem export supports ints/slices, got {k!r}")
+        cur = self.resolve(src)
+        if axes:
+            for suffix, vals in (("_starts", starts), ("_ends", ends),
+                                 ("_axes", axes)):
+                self.extra_init.append(P.tensor_proto(
+                    nm + suffix, onp.asarray(vals, onp.int64)))
+            self.nodes.append(P.node_proto(
+                "Slice", [cur, nm + "_starts", nm + "_ends", nm + "_axes"],
+                [nm + "_sl" if squeeze else nm],
+                nm + "_sl" if squeeze else nm))
+            cur = nm + "_sl" if squeeze else nm
+        if squeeze:
+            self.extra_init.append(P.tensor_proto(
+                nm + "_sq", onp.asarray(squeeze, onp.int64)))
+            self.nodes.append(P.node_proto(
+                "Squeeze", [cur, nm + "_sq"], [nm], nm))
+        elif not axes:
+            self.nodes.append(P.node_proto("Identity", [cur], [nm], nm))
+        self.names[_buf_id(out_leaves[0])] = nm
 
     def _handle_rnn(self, nm, name, args, res):
         mode = name[len("rnn_"):]

@@ -302,6 +302,9 @@ def _build(node, ins, consts, sym_mod, shape_of=None):
                                   eps=float(a.get("epsilon", 1e-5)))
     if op == "LogSoftmax":
         return sym_mod.log_softmax(ins[0], axis=int(a.get("axis", -1)))
+    if op == "Einsum":
+        eq = a.get("equation")
+        return sym_mod.einsum(eq, *ins)
     if op in ("LSTM", "GRU", "RNN"):
         return _import_rnn(op, node, ins, consts, sym_mod, a)
     simple = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
@@ -311,7 +314,7 @@ def _build(node, ins, consts, sym_mod, shape_of=None):
               "Mul": "broadcast_mul", "Div": "broadcast_div",
               "Max": "maximum", "Min": "minimum", "Pow": "power",
               "Mod": "mod", "Equal": "equal", "Greater": "greater",
-              "Less": "less", "Softsign": "softsign"}
+              "Less": "less", "Softsign": "softsign", "Erf": "erf"}
     if op in simple:
         return getattr(sym_mod, simple[op])(*ins)
     raise NotImplementedError(f"no importer for ONNX op {op!r}")

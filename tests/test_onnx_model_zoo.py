@@ -45,3 +45,26 @@ def test_model_zoo_onnx_round_trip(name, tmp_path):
     got = sym2.eval(data=x, **args, **aux)[0].asnumpy()
     onp.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4,
                                 err_msg=f"{name} diverged through ONNX")
+
+
+def test_bert_onnx_round_trip(tmp_path):
+    """The flagship transformer exports too: einsum attention, GELU (erf
+    subgraph), CLS-token getitem (Slice+Squeeze), LayerNorm."""
+    from mxnet_tpu.models import BertForPretraining
+
+    onp.random.seed(0)
+    m = BertForPretraining(vocab_size=50, units=16, hidden_size=32,
+                           num_layers=2, num_heads=2, max_length=16,
+                           dropout=0.0)
+    m.initialize()
+    tok = mx.np.array(onp.random.randint(0, 50, (2, 8)), dtype="int32")
+    seg = mx.np.zeros((2, 8), dtype="int32")
+    ref = m(tok, seg)
+    path = str(tmp_path / "bert.onnx")
+    mxonnx.export_block(m, (tok, seg), path,
+                        input_names=["tokens", "segments"])
+    sym2, args, aux = mxonnx.import_model(path)
+    outs = sym2.eval(tokens=tok, segments=seg, **args, **aux)
+    for r, g in zip(ref, outs):
+        onp.testing.assert_allclose(g.asnumpy(), r.asnumpy(),
+                                    rtol=1e-4, atol=1e-5)
