@@ -512,8 +512,7 @@ def main():
             assert r["jit_us"] is not None and r["jit_us"] >= 0, r
         print("opperf smoke OK")
     if args.output:
-        with open(args.output, "w") as f:
-            json.dump(results, f, indent=2)
+        # run() already wrote the file incrementally after every row
         print(f"wrote {args.output}")
 
 

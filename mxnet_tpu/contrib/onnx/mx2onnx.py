@@ -734,22 +734,28 @@ class _BlockExporter:
         if name == "getitem":
             self._handle_getitem(nm, fun, in_leaves, out_leaves)
             return
-        if name in ("concatenate", "concat"):
+        if name in ("concatenate", "concat", "Concat"):
+            # the axis lives in the frontend lambda's closure, so recover
+            # it from shapes: the one axis where input dims sum to the
+            # output while all others match
             ins = [self.resolve(x) for x in in_leaves]
-            axis = kwargs.get("axis")
-            if axis is None and len(args) > 1 \
-                    and isinstance(args[-1], int):
-                axis = args[-1]
+            out_shape = out_leaves[0].shape
+            in_shapes = [x.shape for x in in_leaves]
+            if any(len(s) != len(out_shape) for s in in_shapes):
+                # np.concatenate(axis=None) flatten semantics — no ONNX
+                # Concat equivalent; fail loudly rather than export wrong
+                raise NotImplementedError(
+                    "concatenate with axis=None (rank-collapsing) has no "
+                    "ONNX Concat equivalent")
+            axis = next(
+                (ax for ax in range(len(out_shape))
+                 if sum(s[ax] for s in in_shapes) == out_shape[ax]
+                 and all(s[:ax] + s[ax + 1:] ==
+                         in_shapes[0][:ax] + in_shapes[0][ax + 1:]
+                         for s in in_shapes)), None)
             if axis is None:
-                # infer: the one axis where input dims sum to the output
-                out_shape = out_leaves[0].shape
-                in_shapes = [x.shape for x in in_leaves]
-                axis = next(
-                    (ax for ax in range(len(out_shape))
-                     if sum(s[ax] for s in in_shapes) == out_shape[ax]
-                     and all(s[:ax] + s[ax + 1:] ==
-                             in_shapes[0][:ax] + in_shapes[0][ax + 1:]
-                             for s in in_shapes)), 0)
+                raise NotImplementedError(
+                    f"cannot infer concat axis: {in_shapes} -> {out_shape}")
             self.nodes.extend(_CONVERTERS["Concat"](
                 nm, ins, {"dim": int(axis)}))
             self.names[_buf_id(out_leaves[0])] = nm

@@ -7,6 +7,7 @@ checking the emitted wire bytes field-by-field against the onnx.proto
 schema for a known small graph.
 """
 import numpy as onp
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.contrib import onnx as mxonnx
@@ -268,3 +269,37 @@ def test_export_block_positional_scalar_attrs(tmp_path):
     sym2, args, aux = mxonnx.import_model(path)
     got = sym2.eval(data=x, **args, **aux)[0].asnumpy()
     onp.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_export_block_legacy_concat_and_flatten_concat(tmp_path):
+    """Captured legacy Concat (capitalized name, axis in closure) exports
+    correctly; rank-collapsing concatenate(axis=None) fails loudly
+    (code-review findings)."""
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class Cat(HybridBlock):
+        def forward(self, a, b):
+            return mx.nd.Concat(a, b, dim=0) + 0.0
+
+    net = Cat()
+    net.initialize()
+    a = mx.np.array(onp.random.rand(2, 3).astype("f"))
+    b = mx.np.array(onp.random.rand(2, 3).astype("f"))
+    ref = net(a, b).asnumpy()
+    path = str(tmp_path / "cat.onnx")
+    mxonnx.export_model  # (namespace sanity)
+    from mxnet_tpu.contrib.onnx import export_block
+    export_block(net, (a, b), path, input_names=["a", "b"])
+    sym2, args, aux = mxonnx.import_model(path)
+    got = sym2.eval(a=a, b=b, **args, **aux)[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    class FlattenCat(HybridBlock):
+        def forward(self, a, b):
+            return mx.np.concatenate([a, b], axis=None) * 1.0
+
+    net2 = FlattenCat()
+    net2.initialize()
+    with pytest.raises(NotImplementedError, match="rank-collapsing"):
+        export_block(net2, (a, b), str(tmp_path / "bad.onnx"),
+                     input_names=["a", "b"])
