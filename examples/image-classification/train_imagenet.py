@@ -66,15 +66,19 @@ def batches(args, ctxs):
                 it.reset()
                 for b in it:
                     yield b.data[0].astype(args.dtype), b.label[0]
+        # prefetch-to-device double buffering (io/prefetch.py): the H2D
+        # transfer for batch N+1 rides the wire while step N computes —
+        # the step-time law becomes max(feed, compute), not the sum
+        pf = mx.io.DevicePrefetcher(it, depth=3, dtypes=(None, onp.int32))
         mean = mx.np.array(_MEAN)
         std = mx.np.array(_STD)
         while True:
-            it.reset()
-            for b in it:
-                x = ((b.data[0].astype("float32") - mean) / std) \
+            for data, labels in pf:
+                x = ((data.astype("float32") - mean) / std) \
                     .astype(args.dtype)
                 # NHWC -> NCHW for the reference-layout model zoo
-                yield mx.np.transpose(x, (0, 3, 1, 2)), b.label[0]
+                yield mx.np.transpose(x, (0, 3, 1, 2)), labels
+            pf.reset()
     else:
         x = mx.np.array(onp.random.uniform(-1, 1,
                                            (args.batch_size, 3, 224, 224)),
