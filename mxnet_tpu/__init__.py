@@ -78,6 +78,10 @@ from .libinfo import __version__
 # honor the documented MXNET_* environment variables (env.py table)
 env.apply()
 
+# register NumPy __array_function__/__array_ufunc__ interop (reference
+# `python/mxnet/numpy_dispatch_protocol.py:1`)
+from . import numpy_dispatch  # noqa: E402  (needs np + NDArray above)
+
 # legacy custom-op entry: mx.nd.Custom(data..., op_type="name")
 ndarray.Custom = operator.invoke_custom  # (mx.nd is the same module)
 

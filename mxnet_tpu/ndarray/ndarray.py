@@ -369,41 +369,93 @@ class NDArray:
     def abs(self):
         return invoke(jnp.abs, (self,), name="abs")
 
-    def sum(self, axis=None, dtype=None, keepdims=False):
-        return invoke(lambda x: jnp.sum(x, axis=axis, dtype=dtype, keepdims=keepdims),
-                      (self,), name="sum")
+    def _maybe_out(self, res, out):
+        # numpy-compatible ``out=``: the reference's generated method
+        # signatures accept it (`python/mxnet/numpy/multiarray.py` reduce
+        # methods); on XLA it is a rebind of the destination wrapper.
+        # Shape must match (numpy raises too); the value is cast to the
+        # destination's dtype so holders of `out` keep its contract.
+        if out is None:
+            return res
+        if tuple(out.shape) != tuple(res.shape):
+            raise ValueError(
+                f"out= has shape {tuple(out.shape)}, result is "
+                f"{tuple(res.shape)}")
+        if out.dtype != res.dtype:
+            res = res.astype(out.dtype)
+        return out._rebind(res)
 
-    def mean(self, axis=None, dtype=None, keepdims=False):
-        return invoke(lambda x: jnp.mean(x, axis=axis, dtype=dtype, keepdims=keepdims),
-                      (self,), name="mean")
+    def sum(self, axis=None, dtype=None, out=None, keepdims=False):
+        return self._maybe_out(
+            invoke(lambda x: jnp.sum(x, axis=axis, dtype=dtype, keepdims=keepdims),
+                   (self,), name="sum"), out)
 
-    def prod(self, axis=None, keepdims=False):
-        return invoke(lambda x: jnp.prod(x, axis=axis, keepdims=keepdims),
-                      (self,), name="prod")
+    def mean(self, axis=None, dtype=None, out=None, keepdims=False):
+        return self._maybe_out(
+            invoke(lambda x: jnp.mean(x, axis=axis, dtype=dtype, keepdims=keepdims),
+                   (self,), name="mean"), out)
 
-    def max(self, axis=None, keepdims=False):
-        return invoke(lambda x: jnp.max(x, axis=axis, keepdims=keepdims),
-                      (self,), name="max")
+    def std(self, axis=None, dtype=None, out=None, ddof=0, keepdims=False):
+        return self._maybe_out(
+            invoke(lambda x: jnp.std(x, axis=axis, dtype=dtype, ddof=ddof,
+                                     keepdims=keepdims),
+                   (self,), name="std"), out)
 
-    def min(self, axis=None, keepdims=False):
-        return invoke(lambda x: jnp.min(x, axis=axis, keepdims=keepdims),
-                      (self,), name="min")
+    def var(self, axis=None, dtype=None, out=None, ddof=0, keepdims=False):
+        return self._maybe_out(
+            invoke(lambda x: jnp.var(x, axis=axis, dtype=dtype, ddof=ddof,
+                                     keepdims=keepdims),
+                   (self,), name="var"), out)
 
-    def all(self, axis=None, keepdims=False):
-        return invoke(lambda x: jnp.all(x, axis=axis, keepdims=keepdims),
-                      (self,), name="all", differentiable=False)
+    def cumsum(self, axis=None, dtype=None, out=None):
+        return self._maybe_out(
+            invoke(lambda x: jnp.cumsum(x, axis=axis, dtype=dtype),
+                   (self,), name="cumsum"), out)
 
-    def any(self, axis=None, keepdims=False):
-        return invoke(lambda x: jnp.any(x, axis=axis, keepdims=keepdims),
-                      (self,), name="any", differentiable=False)
+    def round(self, decimals=0, out=None):
+        return self._maybe_out(
+            invoke(lambda x: jnp.round(x, decimals), (self,), name="round",
+                   differentiable=False), out)
 
-    def argmax(self, axis=None):
-        return invoke(lambda x: jnp.argmax(x, axis=axis), (self,),
-                      name="argmax", differentiable=False)
+    def take(self, indices, axis=None, mode="clip", out=None):
+        return self._maybe_out(
+            invoke(lambda x, i: jnp.take(x, i, axis=axis, mode=mode),
+                   (self, indices), name="take"), out)
 
-    def argmin(self, axis=None):
-        return invoke(lambda x: jnp.argmin(x, axis=axis), (self,),
-                      name="argmin", differentiable=False)
+    def prod(self, axis=None, dtype=None, out=None, keepdims=False):
+        return self._maybe_out(
+            invoke(lambda x: jnp.prod(x, axis=axis, dtype=dtype, keepdims=keepdims),
+                   (self,), name="prod"), out)
+
+    def max(self, axis=None, out=None, keepdims=False):
+        return self._maybe_out(
+            invoke(lambda x: jnp.max(x, axis=axis, keepdims=keepdims),
+                   (self,), name="max"), out)
+
+    def min(self, axis=None, out=None, keepdims=False):
+        return self._maybe_out(
+            invoke(lambda x: jnp.min(x, axis=axis, keepdims=keepdims),
+                   (self,), name="min"), out)
+
+    def all(self, axis=None, out=None, keepdims=False):
+        return self._maybe_out(
+            invoke(lambda x: jnp.all(x, axis=axis, keepdims=keepdims),
+                   (self,), name="all", differentiable=False), out)
+
+    def any(self, axis=None, out=None, keepdims=False):
+        return self._maybe_out(
+            invoke(lambda x: jnp.any(x, axis=axis, keepdims=keepdims),
+                   (self,), name="any", differentiable=False), out)
+
+    def argmax(self, axis=None, out=None):
+        return self._maybe_out(
+            invoke(lambda x: jnp.argmax(x, axis=axis), (self,),
+                   name="argmax", differentiable=False), out)
+
+    def argmin(self, axis=None, out=None):
+        return self._maybe_out(
+            invoke(lambda x: jnp.argmin(x, axis=axis), (self,),
+                   name="argmin", differentiable=False), out)
 
     def dot(self, other):
         return invoke(jnp.dot, (self, other), name="dot")

@@ -1,0 +1,188 @@
+"""NumPy dispatch-protocol interop for :class:`~mxnet_tpu.ndarray.NDArray`.
+
+Reference role: `python/mxnet/numpy_dispatch_protocol.py:1` — the reference
+registers its ``mx.np`` implementations against NumPy's
+``__array_function__`` (NEP 18) and ``__array_ufunc__`` (NEP 13) protocols so
+that *plain numpy* calls such as ``numpy.mean(mx.np.array(...))`` execute the
+framework's operator (async, device-resident, autograd-recorded) and return a
+framework array instead of silently pulling data to the host.
+
+TPU-native design: the table maps official ``numpy`` function objects
+directly to the `mxnet_tpu.numpy` lowerings (which dispatch through
+`ops/invoke.py`, so the call is traced onto the tape and stays on the TPU
+buffer).  Functions NumPy dispatches that have no registered lowering fall
+back to the official NumPy implementation on host copies — mirroring the
+reference's warn-once fallback (`numpy_dispatch_protocol.py` fallback path) —
+except under ``autograd.record()``, where a silent host round-trip would cut
+the tape, so it raises instead (same contract as the reference).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from . import numpy as mx_np
+from .ndarray.ndarray import NDArray
+
+__all__ = [
+    "ARRAY_FUNCTION_NAMES",
+    "ARRAY_UFUNC_NAMES",
+    "array_function_impls",
+    "array_ufunc_impls",
+]
+
+# Names NumPy dispatches through __array_function__ that this framework
+# lowers natively.  This is the reference's interop op list
+# (`numpy_dispatch_protocol.py` _NUMPY_ARRAY_FUNCTION_LIST) filtered to what
+# exists in both namespaces at import time (asserted by
+# tests/test_numpy_interop.py so silent shrinkage fails CI).
+ARRAY_FUNCTION_NAMES = [
+    "all", "any", "argmin", "argmax", "around", "round", "argsort", "sort",
+    "append", "broadcast_arrays", "broadcast_to", "clip", "concatenate",
+    "copy", "cumsum", "diag", "diagonal", "diagflat", "dot", "expand_dims",
+    "fix", "flip", "flipud", "fliplr", "inner", "insert", "interp", "max",
+    "amax", "mean", "min", "amin", "nonzero", "ones_like", "atleast_1d",
+    "atleast_2d", "atleast_3d", "prod", "ravel", "repeat", "reshape", "roll",
+    "split", "array_split", "hsplit", "vsplit", "dsplit", "squeeze", "stack",
+    "std", "sum", "swapaxes", "take", "tensordot", "tile", "transpose",
+    "unique", "unravel_index", "flatnonzero", "delete", "var", "vdot",
+    "vstack", "column_stack", "hstack", "dstack", "zeros_like", "shape",
+    "trace", "tril", "triu", "meshgrid", "outer", "kron", "einsum",
+    "polyval", "quantile", "median", "percentile", "diff", "ediff1d",
+    "resize", "where", "full_like", "bincount", "empty_like",
+    "linalg.norm", "linalg.cholesky", "linalg.inv", "linalg.solve",
+    "linalg.tensorinv", "linalg.tensorsolve", "linalg.lstsq", "linalg.pinv",
+    "linalg.eigvals", "linalg.eig", "linalg.eigvalsh", "linalg.eigh",
+    "linalg.qr", "linalg.matrix_rank",
+]
+
+# ufuncs routed through __array_ufunc__ (reference _NUMPY_ARRAY_UFUNC_LIST).
+ARRAY_UFUNC_NAMES = [
+    "abs", "fabs", "add", "arctan2", "copysign", "degrees", "hypot", "lcm",
+    "subtract", "multiply", "true_divide", "negative", "power", "mod",
+    "fmod", "matmul", "absolute", "rint", "sign", "exp", "log", "log2",
+    "log10", "expm1", "sqrt", "square", "cbrt", "reciprocal", "invert",
+    "bitwise_not", "remainder", "sin", "cos", "tan", "sinh", "cosh", "tanh",
+    "arcsin", "arccos", "arctan", "arcsinh", "arccosh", "arctanh",
+    "maximum", "fmax", "minimum", "fmin", "ceil", "trunc", "floor",
+    "bitwise_and", "bitwise_xor", "bitwise_or", "logical_and", "logical_or",
+    "logical_xor", "logical_not", "equal", "not_equal", "less", "less_equal",
+    "greater", "greater_equal", "floor_divide",
+]
+
+
+def _resolve(namespace, dotted):
+    obj = namespace
+    for part in dotted.split("."):
+        obj = getattr(obj, part, None)
+        if obj is None:
+            return None
+    return obj
+
+
+def _build_tables():
+    fn_table = {}
+    for name in ARRAY_FUNCTION_NAMES:
+        np_fn = _resolve(onp, name)
+        mx_fn = _resolve(mx_np, name)
+        if np_fn is not None and mx_fn is not None:
+            fn_table[np_fn] = mx_fn
+    uf_table = {}
+    for name in ARRAY_UFUNC_NAMES:
+        mx_fn = getattr(mx_np, name, None)
+        if mx_fn is not None and getattr(onp, name, None) is not None:
+            uf_table[name] = mx_fn
+    return fn_table, uf_table
+
+
+_ARRAY_FUNCTION_IMPLS, _ARRAY_UFUNC_IMPLS = _build_tables()
+_FALLBACK_WARNED = set()
+
+
+def array_function_impls():
+    """The live ``numpy function -> mxnet_tpu.numpy lowering`` table."""
+    return dict(_ARRAY_FUNCTION_IMPLS)
+
+
+def array_ufunc_impls():
+    """The live ``ufunc name -> mxnet_tpu.numpy lowering`` table."""
+    return dict(_ARRAY_UFUNC_IMPLS)
+
+
+def _to_host(value):
+    if isinstance(value, NDArray):
+        return value.asnumpy()
+    if isinstance(value, (tuple, list)):
+        return type(value)(_to_host(v) for v in value)
+    return value
+
+
+def _wrap_host(value):
+    if isinstance(value, onp.ndarray):
+        return NDArray(value)
+    if isinstance(value, (tuple, list)):
+        return type(value)(_wrap_host(v) for v in value)
+    return value
+
+
+def _is_recording():
+    from . import autograd
+    return autograd.is_recording()
+
+
+def _host_fallback(func, args, kwargs):
+    if _is_recording():
+        raise ValueError(
+            f"numpy.{func.__name__} has no device lowering and falling back "
+            "to host NumPy under autograd.record() would cut the gradient "
+            "tape; move the call outside the recording scope."
+        )
+    if func not in _FALLBACK_WARNED:
+        _FALLBACK_WARNED.add(func)
+        logging.warning(
+            "np.%s is a fallback operator: executing official NumPy on a "
+            "host copy of the TPU buffer.", func.__name__,
+        )
+    res = func(*_to_host(args), **{k: _to_host(v) for k, v in kwargs.items()})
+    return _wrap_host(res)
+
+
+def _array_function(self, func, types, args, kwargs):
+    impl = _ARRAY_FUNCTION_IMPLS.get(func)
+    if impl is None:
+        return _host_fallback(func, args, kwargs)
+    return impl(*args, **kwargs)
+
+
+def _array_ufunc(self, ufunc, method, *inputs, **kwargs):
+    if method != "__call__":
+        # reduce/accumulate/outer: host fallback (reference raises here; a
+        # host copy is the friendlier superset outside autograd)
+        bound = getattr(ufunc, method)
+        return _host_fallback(bound, inputs, kwargs)
+    out = kwargs.pop("out", None)
+    for drop, default in (("where", True), ("casting", "same_kind"),
+                          ("order", "K"), ("subok", True)):
+        if kwargs.get(drop, default) == default:
+            kwargs.pop(drop, None)
+    impl = _ARRAY_UFUNC_IMPLS.get(ufunc.__name__)
+    if impl is None:
+        res = _host_fallback(ufunc, inputs, kwargs)
+    else:
+        res = impl(*inputs, **kwargs)
+    if out is not None:
+        if len(out) != 1:
+            raise ValueError("the `out` argument must hold exactly one array")
+        target = out[0]
+        if isinstance(target, NDArray):
+            return target._rebind(res if isinstance(res, NDArray)
+                                  else NDArray(res))
+        # numpy-array destination (e.g. `host += device`): land on host
+        target[...] = res.asnumpy() if isinstance(res, NDArray) else res
+        return target
+    return res
+
+
+NDArray.__array_function__ = _array_function
+NDArray.__array_ufunc__ = _array_ufunc

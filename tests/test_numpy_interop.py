@@ -1,0 +1,170 @@
+"""NumPy dispatch-protocol interop (NEP 13 / NEP 18).
+
+Reference: `python/mxnet/numpy_dispatch_protocol.py:1` and the interop
+coverage of `tests/python/unittest/test_numpy_interoperability.py` — plain
+``numpy`` functions called on framework arrays must execute the framework's
+lowering and return framework arrays.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import numpy_dispatch
+
+
+def _nd(x):
+    return mx.np.array(onp.asarray(x, dtype=onp.float32))
+
+
+# (numpy dotted name, args-builder) — a representative slice of the
+# reference's _NUMPY_ARRAY_FUNCTION_LIST exercised end to end.
+_FUNCTION_CASES = [
+    ("mean", lambda: ((_nd([[1, 2], [3, 4]]),), {})),
+    ("std", lambda: ((_nd([[1, 2], [3, 4]]),), {"axis": 0})),
+    ("var", lambda: ((_nd([[1, 2], [3, 4]]),), {"axis": 1})),
+    ("sum", lambda: ((_nd([[1, 2], [3, 4]]),), {"axis": 0})),
+    ("concatenate", lambda: (([_nd([[1.0]]), _nd([[2.0]])],), {"axis": 0})),
+    ("stack", lambda: (([_nd([1.0, 2.0]), _nd([3.0, 4.0])],), {})),
+    ("vstack", lambda: (([_nd([1.0, 2.0]), _nd([3.0, 4.0])],), {})),
+    ("hstack", lambda: (([_nd([1.0]), _nd([2.0])],), {})),
+    ("dot", lambda: ((_nd([[1, 2], [3, 4]]), _nd([[1, 0], [0, 1]])), {})),
+    ("tensordot", lambda: ((_nd([[1, 2], [3, 4]]), _nd([[1, 0], [0, 1]])), {})),
+    ("transpose", lambda: ((_nd([[1, 2], [3, 4]]),), {})),
+    ("reshape", lambda: ((_nd([[1, 2], [3, 4]]), (4,)), {})),
+    ("ravel", lambda: ((_nd([[1, 2], [3, 4]]),), {})),
+    ("squeeze", lambda: ((_nd([[[1.0], [2.0]]]),), {})),
+    ("expand_dims", lambda: ((_nd([1, 2]), 0), {})),
+    ("clip", lambda: ((_nd([1, 5, 9]), 2, 8), {})),
+    ("cumsum", lambda: ((_nd([1, 2, 3]),), {})),
+    ("argsort", lambda: ((_nd([3, 1, 2]),), {})),
+    ("sort", lambda: ((_nd([3, 1, 2]),), {})),
+    ("max", lambda: ((_nd([[1, 2], [3, 4]]),), {"axis": 0})),
+    ("min", lambda: ((_nd([[1, 2], [3, 4]]),), {"axis": 1})),
+    ("prod", lambda: ((_nd([1, 2, 3]),), {})),
+    ("tile", lambda: ((_nd([1, 2]), 2), {})),
+    ("roll", lambda: ((_nd([1, 2, 3]), 1), {})),
+    ("flip", lambda: ((_nd([1, 2, 3]),), {})),
+    ("split", lambda: ((_nd([1, 2, 3, 4]), 2), {})),
+    ("where", lambda: ((_nd([1, 0, 1]).astype(onp.bool_), _nd([1, 2, 3]),
+                        _nd([4, 5, 6])), {})),
+    ("take", lambda: ((_nd([10, 20, 30]), _nd([0, 2]).astype(onp.int32)), {})),
+    ("trace", lambda: ((_nd([[1, 2], [3, 4]]),), {})),
+    ("tril", lambda: ((_nd([[1, 2], [3, 4]]),), {})),
+    ("einsum", lambda: (("ij,jk->ik", _nd([[1, 2], [3, 4]]),
+                         _nd([[1, 0], [0, 1]])), {})),
+    ("outer", lambda: ((_nd([1, 2]), _nd([3, 4])), {})),
+    ("broadcast_to", lambda: ((_nd([1, 2]), (3, 2)), {})),
+    ("zeros_like", lambda: ((_nd([[1, 2]]),), {})),
+    ("ones_like", lambda: ((_nd([[1, 2]]),), {})),
+    ("median", lambda: ((_nd([1, 2, 3, 4]),), {})),
+    ("diff", lambda: ((_nd([1, 4, 9]),), {})),
+    ("unique", lambda: ((_nd([1, 2, 2, 3]),), {})),
+    ("linalg.norm", lambda: ((_nd([[3, 4]]),), {})),
+    ("linalg.inv", lambda: ((_nd([[2, 0], [0, 2]]),), {})),
+    ("linalg.solve", lambda: ((_nd([[2, 0], [0, 2]]), _nd([2, 4])), {})),
+    ("linalg.qr", lambda: ((_nd([[1, 2], [3, 4]]),), {})),
+    ("linalg.cholesky", lambda: ((_nd([[4, 0], [0, 9]]),), {})),
+]
+
+
+def _leaf_arrays(res):
+    if isinstance(res, (tuple, list)):
+        for r in res:
+            yield from _leaf_arrays(r)
+    elif hasattr(res, "asnumpy"):
+        yield res
+
+
+def _host(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else (
+        [_host(v) for v in x] if isinstance(x, (tuple, list)) else x)
+
+
+@pytest.mark.parametrize("name,build", _FUNCTION_CASES,
+                         ids=[c[0] for c in _FUNCTION_CASES])
+def test_array_function_dispatch(name, build):
+    np_fn = numpy_dispatch._resolve(onp, name)
+    args, kwargs = build()
+    res = np_fn(*args, **kwargs)
+    leaves = list(_leaf_arrays(res))
+    assert leaves, f"numpy.{name} on NDArray returned no framework arrays"
+    # oracle: same call on host copies through official numpy
+    expected = np_fn(*_host(list(args)), **{k: _host(v) for k, v in kwargs.items()})
+    onp.testing.assert_allclose(
+        onp.asarray(leaves[0].asnumpy(), dtype=onp.float64),
+        onp.asarray(onp.asarray(expected[0] if isinstance(expected, (tuple, list))
+                                else expected), dtype=onp.float64),
+        rtol=1e-4, atol=1e-5)
+
+
+_UFUNC_CASES = ["add", "subtract", "multiply", "true_divide", "maximum",
+                "minimum", "power", "exp", "log", "sqrt", "tanh", "sin",
+                "arctan2", "hypot", "equal", "greater", "matmul"]
+
+
+@pytest.mark.parametrize("name", _UFUNC_CASES)
+def test_array_ufunc_dispatch(name):
+    uf = getattr(onp, name)
+    a = _nd([[1.0, 2.0], [3.0, 4.0]])
+    b = _nd([[1.5, 0.5], [2.0, 1.0]])
+    args = (a,) if uf.nin == 1 else (a, b)
+    res = uf(*args)
+    assert hasattr(res, "asnumpy"), f"ufunc {name} did not return NDArray"
+    expected = uf(*[x.asnumpy() for x in args])
+    onp.testing.assert_allclose(onp.asarray(res.asnumpy(), onp.float64),
+                                onp.asarray(expected, onp.float64),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_mixed_operand_casting_table():
+    # reference multiarray.py __array_ufunc__ docstring table
+    host = onp.ones((2, 2), onp.float32)
+    dev = _nd(onp.full((2, 2), 2.0))
+    out = host + dev
+    assert hasattr(out, "asnumpy")          # c = onp + mx -> mx
+    out = dev + host
+    assert hasattr(out, "asnumpy")          # c = mx + onp -> mx
+    h = host.copy()
+    h += dev                                 # onp += mx stays onp
+    assert isinstance(h, onp.ndarray) and not hasattr(h, "asnumpy")
+    onp.testing.assert_allclose(h, 3.0)
+    d = _nd(onp.ones((2, 2)))
+    d += host                                # mx += onp stays mx
+    assert hasattr(d, "asnumpy")
+    onp.testing.assert_allclose(d.asnumpy(), 2.0)
+
+
+def test_method_out_kwarg():
+    a = _nd([[1.0, 2.0], [3.0, 4.0]])
+    out = mx.np.zeros((2,))
+    r = a.mean(axis=0, out=out)
+    assert r is out
+    onp.testing.assert_allclose(out.asnumpy(), [2.0, 3.0])
+    out2 = mx.np.zeros(())
+    a.std(out=out2)
+    assert out2.asnumpy().shape == ()
+
+
+def test_host_fallback_outside_record():
+    a = _nd([[1.0, 9.0], [3.0, 4.0]])
+    r = onp.ptp(a)          # no device lowering registered
+    onp.testing.assert_allclose(onp.asarray(r), 8.0)
+
+
+def test_fallback_raises_under_record():
+    a = _nd([1.0, 2.0])
+    a.attach_grad()
+    with pytest.raises(ValueError, match="tape"):
+        with mx.autograd.record():
+            onp.ptp(a)
+
+
+def test_registration_coverage():
+    # the table must not silently shrink: every listed name resolves
+    impls = numpy_dispatch.array_function_impls()
+    assert len(impls) == len(numpy_dispatch.ARRAY_FUNCTION_NAMES), (
+        sorted(set(numpy_dispatch.ARRAY_FUNCTION_NAMES)
+               - {f.__name__ for f in impls}))
+    uf = numpy_dispatch.array_ufunc_impls()
+    missing = set(numpy_dispatch.ARRAY_UFUNC_NAMES) - set(uf)
+    assert not missing, sorted(missing)
