@@ -1,0 +1,116 @@
+"""ssh launcher transport test (reference `tools/launch.py:72-74`).
+
+No sshd exists in CI, so the transport is exercised through a fake `ssh`
+binary that strips the options/hostname and runs the remote command in a
+local shell — validating exactly what the launcher is responsible for:
+rank/coordinator env wiring inlined into the ssh command line, round-robin
+host assignment, and exit-code aggregation.
+"""
+import json
+import os
+import stat
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import launch  # noqa: E402
+
+
+FAKE_SSH = """#!/usr/bin/env python3
+import subprocess, sys
+# drop ssh options ("-o value" pairs), then the hostname; run the rest
+args = sys.argv[1:]
+while args and args[0] == "-o":
+    args = args[2:]
+host, remote = args[0], " ".join(args[1:])
+with open(__OUT__ + "/hosts.log", "a") as f:
+    f.write(host + "\\n")
+sys.exit(subprocess.call(["/bin/sh", "-c", remote]))
+"""
+
+
+@pytest.fixture
+def fake_ssh(tmp_path):
+    path = tmp_path / "fake-ssh"
+    path.write_text(FAKE_SSH.replace("__OUT__", repr(str(tmp_path))))
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return path
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("# pod hosts\nhost-a slots=4\nhost-b\n\nhost-c  # tail\n")
+    assert launch.parse_hostfile(str(hf)) == ["host-a", "host-b", "host-c"]
+    empty = tmp_path / "empty"
+    empty.write_text("# nothing\n")
+    with pytest.raises(ValueError):
+        launch.parse_hostfile(str(empty))
+
+
+def test_ssh_launch_env_wiring(tmp_path, fake_ssh):
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import json, os\n"
+        "rec = {k: os.environ.get(k) for k in ('JAX_COORDINATOR_ADDRESS',"
+        " 'JAX_NUM_PROCESSES', 'JAX_PROCESS_ID', 'DMLC_WORKER_ID',"
+        " 'EXTRA_FLAG')}\n"
+        "path = os.path.join(%r, 'rank%%s.json' %% rec['JAX_PROCESS_ID'])\n"
+        "json.dump(rec, open(path, 'w'))\n" % str(tmp_path))
+    codes = launch.launch_ssh(
+        4, [sys.executable, str(probe)], ["node0", "node1"],
+        coordinator_port=5123, env_extra={"EXTRA_FLAG": "on"},
+        ssh_binary=str(fake_ssh))
+    assert codes == [0, 0, 0, 0]
+    hosts = (tmp_path / "hosts.log").read_text().split()
+    assert sorted(hosts) == ["node0", "node0", "node1", "node1"]
+    for rank in range(4):
+        rec = json.load(open(tmp_path / f"rank{rank}.json"))
+        assert rec["JAX_COORDINATOR_ADDRESS"] == "node0:5123"
+        assert rec["JAX_NUM_PROCESSES"] == "4"
+        assert rec["JAX_PROCESS_ID"] == str(rank)
+        assert rec["DMLC_WORKER_ID"] == str(rank)
+        assert rec["EXTRA_FLAG"] == "on"
+
+
+def test_ssh_launch_remote_cwd_keeps_env(tmp_path, fake_ssh):
+    """`cd DIR && env VARS cmd` — the env must bind to the command, not
+    to `cd` (r4 review finding)."""
+    workdir = tmp_path / "wd"
+    workdir.mkdir()
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import os\n"
+        "open(os.path.join(%r, 'cwd_env.txt'), 'w').write(\n"
+        "    os.getcwd() + '|' + os.environ['JAX_PROCESS_ID'])\n"
+        % str(tmp_path))
+    codes = launch.launch_ssh(
+        1, [sys.executable, str(probe)], ["h0"],
+        ssh_binary=str(fake_ssh), remote_cwd=str(workdir))
+    assert codes == [0]
+    cwd, rank = (tmp_path / "cwd_env.txt").read_text().split("|")
+    assert os.path.realpath(cwd) == os.path.realpath(str(workdir))
+    assert rank == "0"
+
+
+def test_ssh_launch_propagates_failure(tmp_path, fake_ssh):
+    codes = launch.launch_ssh(
+        2, [sys.executable, "-c",
+            "import os,sys; sys.exit(int(os.environ['JAX_PROCESS_ID']))"],
+        ["h0"], ssh_binary=str(fake_ssh))
+    assert codes == [0, 1]
+
+
+def test_cli_ssh_mode(tmp_path, fake_ssh):
+    hf = tmp_path / "hosts"
+    hf.write_text("localhost\n")
+    marker = tmp_path / "ran.txt"
+    rc = subprocess.call(
+        [sys.executable, launch.__file__, "-n", "1", "--launcher", "ssh",
+         "-H", str(hf), "--ssh-binary", str(fake_ssh),
+         "--env", "M=1", "--",
+         sys.executable, "-c",
+         f"import os; open({str(marker)!r}, 'w').write(os.environ['M'])"])
+    assert rc == 0
+    assert marker.read_text() == "1"
