@@ -23,9 +23,14 @@ def _fields(data):
         yield r.field()
 
 
+_ATTR_STRINGS_ENUM = 8  # AttributeProto.AttributeType.STRINGS
+
+
 def _parse_attr(data):
     name = None
     out = {}
+    atype = None
+    f8_bytes = []  # field 8 wire 2: packed ints (official) OR legacy strings
     for f, _w, v in _fields(data):
         if f == 1:
             name = v.decode()
@@ -37,11 +42,33 @@ def _parse_attr(data):
             out["s"] = v.decode()
         elif f == 5:
             out["t"] = _parse_tensor(v)
-        elif f == 7:
+        elif f == 7 and _w == 0:
+            # legacy pre-r4 exports misfiled ints here (field 7 is
+            # `floats` in onnx.proto); wire type 0 disambiguates
             out.setdefault("ints", []).append(P.signed64(v))
-        elif f == 8:
+        elif f == 7 and _w == 5:
+            out.setdefault("floats", []).append(P.f32_from_bits(v))
+        elif f == 7 and _w == 2:
+            # proto3 packed repeated float
+            out.setdefault("floats", []).extend(
+                P.parse_packed_f32(v))
+        elif f == 8 and _w == 0:
+            out.setdefault("ints", []).append(P.signed64(v))
+        elif f == 8 and _w == 2:
+            f8_bytes.append(v)
+        elif f == 9 and _w == 2:
             out.setdefault("strings", []).append(v.decode())
-    val = out.get("ints", out.get("strings"))
+        elif f == 20 and _w == 0:
+            atype = v
+    for v in f8_bytes:
+        # the type enum (field 20) disambiguates: STRINGS here means a
+        # legacy pre-r4 export that misfiled strings at field 8;
+        # otherwise it is official proto3 packed int64
+        if atype == _ATTR_STRINGS_ENUM:
+            out.setdefault("strings", []).append(v.decode())
+        else:
+            out.setdefault("ints", []).extend(P.parse_packed_int64(v))
+    val = out.get("ints", out.get("strings", out.get("floats")))
     if val is None:
         val = out.get("i", out.get("f", out.get("s", out.get("t"))))
     return name, val
@@ -52,11 +79,18 @@ _NP_OF = {P.FLOAT: onp.float32, P.INT64: onp.int64, P.INT32: onp.int32,
 
 
 def _parse_tensor(data):
+    # repeated scalar fields (dims, float_data, int32/int64_data) arrive
+    # PACKED (wire 2) from official proto3 serializers and unpacked
+    # (wire 0/5) from this codec — both are valid wire format and both
+    # must parse (r4 review finding)
     dims, dtype, raw, name = [], P.FLOAT, b"", ""
     floats, int32s, int64s = [], [], []
     for f, _w, v in _fields(data):
         if f == 1:
-            dims.append(P.signed64(v))
+            if _w == 2:
+                dims.extend(P.parse_packed_int64(v))
+            else:
+                dims.append(P.signed64(v))
         elif f == 2:
             dtype = v
         elif f == 8:
@@ -64,11 +98,20 @@ def _parse_tensor(data):
         elif f == 9:
             raw = v
         elif f == 4:
-            floats.append(P.f32_from_bits(v))
+            if _w == 2:
+                floats.extend(P.parse_packed_f32(v))
+            else:
+                floats.append(P.f32_from_bits(v))
         elif f == 5:
-            int32s.append(P.signed64(v))
+            if _w == 2:
+                int32s.extend(P.parse_packed_int64(v))
+            else:
+                int32s.append(P.signed64(v))
         elif f == 7:
-            int64s.append(P.signed64(v))
+            if _w == 2:
+                int64s.extend(P.parse_packed_int64(v))
+            else:
+                int64s.append(P.signed64(v))
     np_dt = _NP_OF.get(dtype, onp.float32)
     if raw:
         arr = onp.frombuffer(raw, dtype=np_dt)
@@ -80,7 +123,11 @@ def _parse_tensor(data):
         arr = onp.asarray(int32s, onp.int32)
     else:
         arr = onp.zeros(0, np_dt)
-    return name, arr.reshape(dims) if dims else arr
+    # no dims + one element => scalar TensorProto (absent repeated field
+    # = rank 0); a dataless placeholder stays the empty array
+    if dims or arr.size == 1:
+        arr = arr.reshape(dims)
+    return name, arr
 
 
 def _parse_node(data):

@@ -118,6 +118,11 @@ def f32_from_bits(bits):
     return struct.unpack("<f", struct.pack("<I", bits))[0]
 
 
+def parse_packed_f32(data):
+    """Packed repeated float payload (proto3 default packing)."""
+    return list(struct.unpack(f"<{len(data) // 4}f", data))
+
+
 # -- ONNX message builders (field numbers from onnx.proto) -------------------
 
 # TensorProto.DataType
@@ -129,7 +134,9 @@ def tensor_proto(name, arr):
     name=8."""
     import numpy as onp
 
-    a = onp.ascontiguousarray(arr)
+    # NOT ascontiguousarray: it promotes 0-d scalars to shape (1,), which
+    # corrupts the dims field (r4 fuzz finding)
+    a = onp.asarray(arr, order="C")
     if a.dtype == onp.float32:
         dt = FLOAT
     elif a.dtype == onp.int64:
@@ -158,8 +165,11 @@ def attr_float(name, v):
 
 
 def attr_ints(name, vals):
+    """AttributeProto INTS (type enum 7): repeated int64 `ints` is FIELD 8
+    in onnx.proto (field 7 is `floats`) — r4 golden-bytes audit fix; the
+    pre-r4 codec wrote field 7 and was unreadable by external consumers."""
     return f_string(1, name) + \
-        b"".join(f_varint(7, v) for v in vals) + f_varint(20, 7)
+        b"".join(f_varint(8, v) for v in vals) + f_varint(20, 7)
 
 
 def attr_string(name, s):
@@ -167,9 +177,11 @@ def attr_string(name, s):
 
 
 def attr_strings(name, vals):
-    """AttributeProto STRINGS (type=8): strings=8 repeated bytes."""
+    """AttributeProto STRINGS (type enum 8): repeated bytes `strings` is
+    FIELD 9 in onnx.proto (field 8 is `ints`) — r4 golden-bytes audit
+    fix, same self-consistent-but-wrong pairing as `attr_ints`."""
     return f_string(1, name) + \
-        b"".join(f_bytes(8, v.encode()) for v in vals) + f_varint(20, 8)
+        b"".join(f_bytes(9, v.encode()) for v in vals) + f_varint(20, 8)
 
 
 def node_proto(op_type, inputs, outputs, name="", attrs=()):
