@@ -1,0 +1,201 @@
+"""Tiny-SSD object-detection training, end to end.
+
+Reference shape: the SSD pipeline of the reference's example zoo —
+`ImageDetIter` feeding `MultiBoxPrior`/`MultiBoxTarget`/`MultiBoxDetection`
+(`python/mxnet/image/detection.py:625`,
+`src/operator/contrib/multibox_*.cc`).  This example packs a synthetic
+shapes dataset into a .rec, streams it through the detection-aware
+augmentation pipeline, and trains a two-scale SSD head until the loss
+drops; inference decodes + NMS-filters boxes with `multibox_detection`.
+
+Run (CPU mesh or one TPU chip):
+    python examples/ssd/train_ssd.py --steps 60
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack_img
+
+NUM_CLASSES = 2  # squares (0) and wide rectangles (1)
+
+
+def make_dataset(path, n=64, size=64, seed=0):
+    """Synthetic detection .rec: bright class-coded rectangles on a dark
+    noisy background, labels in the packed det wire format
+    (header_width=2, obj_width=5, normalized corners)."""
+    rng = onp.random.RandomState(seed)
+    rec = MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    for i in range(n):
+        img = rng.randint(0, 40, (size, size, 3)).astype(onp.uint8)
+        objs = []
+        for _ in range(1 + int(rng.randint(0, 2))):
+            cls = int(rng.randint(0, NUM_CLASSES))
+            w = rng.uniform(0.25, 0.4) * (1.8 if cls == 1 else 1.0)
+            h = rng.uniform(0.25, 0.4) * (0.6 if cls == 1 else 1.0)
+            x1 = rng.uniform(0.02, 0.95 - w)
+            y1 = rng.uniform(0.02, 0.95 - h)
+            x2, y2 = x1 + w, y1 + h
+            color = (255, 80, 80) if cls == 0 else (80, 255, 80)
+            xs, ys = int(x1 * size), int(y1 * size)
+            xe, ye = int(x2 * size), int(y2 * size)
+            img[ys:ye, xs:xe] = color
+            objs.append([cls, x1, y1, x2, y2])
+        flat = [2.0, 5.0]
+        for o in objs:
+            flat.extend(o)
+        rec.write_idx(i, pack_img(
+            IRHeader(0, onp.asarray(flat, onp.float32), i, 0), img,
+            quality=95))
+    rec.close()
+    return path + ".rec"
+
+
+class TinySSD(gluon.HybridBlock):
+    """Two-scale SSD: conv backbone -> per-scale (cls, loc) heads.
+
+    Anchors come from `multibox_prior` on each feature map; forward
+    returns (anchors (1, N, 4), cls_preds (B, N, C+1), loc_preds
+    (B, N*4)) — the contract `multibox_target`/`multibox_detection`
+    consume."""
+
+    SIZES = [(0.25, 0.35), (0.45, 0.6)]
+    RATIOS = [(1.0, 2.0, 0.5)] * 2
+
+    def __init__(self, num_classes=NUM_CLASSES):
+        super().__init__()
+        self.num_classes = num_classes
+        self.backbone = nn.HybridSequential()
+        for filters in (16, 32):
+            self.backbone.add(nn.Conv2D(filters, 3, padding=1),
+                              nn.BatchNorm(), nn.Activation("relu"),
+                              nn.MaxPool2D(2))
+        self.stage2 = nn.HybridSequential()
+        self.stage2.add(nn.Conv2D(64, 3, padding=1), nn.BatchNorm(),
+                        nn.Activation("relu"), nn.MaxPool2D(2))
+        self.cls_heads, self.loc_heads = [], []
+        for k in range(2):
+            a = len(self.SIZES[k]) + len(self.RATIOS[k]) - 1
+            ch = nn.Conv2D(a * (num_classes + 1), 3, padding=1)
+            lh = nn.Conv2D(a * 4, 3, padding=1)
+            setattr(self, f"cls_head{k}", ch)
+            setattr(self, f"loc_head{k}", lh)
+            self.cls_heads.append(ch)
+            self.loc_heads.append(lh)
+
+    def forward(self, x):
+        feats = [self.backbone(x)]
+        feats.append(self.stage2(feats[0]))
+        anchors, cls_preds, loc_preds = [], [], []
+        for k, f in enumerate(feats):
+            anchors.append(mx.nd.contrib.multibox_prior(
+                f, sizes=self.SIZES[k], ratios=self.RATIOS[k]))
+            c = self.cls_heads[k](f)           # (B, A*(C+1), H, W)
+            l = self.loc_heads[k](f)           # (B, A*4, H, W)
+            B = c.shape[0]
+            cls_preds.append(
+                c.transpose(0, 2, 3, 1).reshape(B, -1, self.num_classes + 1))
+            loc_preds.append(l.transpose(0, 2, 3, 1).reshape(B, -1))
+        anchor = mx.np.concatenate(anchors, axis=1)
+        return (anchor, mx.np.concatenate(cls_preds, axis=1),
+                mx.np.concatenate(loc_preds, axis=1))
+
+
+def ssd_loss(cls_preds, cls_target, loc_preds, loc_target, loc_mask):
+    """Softmax CE on anchor classes + smooth-L1 on masked offsets — the
+    loss the reference pairs with MultiBoxTarget."""
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    l1 = gluon.loss.HuberLoss(rho=1.0)
+    cls_l = ce(cls_preds.reshape(-1, cls_preds.shape[-1]),
+               cls_target.reshape(-1))
+    loc_l = l1(loc_preds * loc_mask, loc_target * loc_mask)
+    return cls_l.mean() + loc_l.mean()
+
+
+class SSDWithLoss(gluon.HybridBlock):
+    """net + target assignment + loss in ONE hybridized program — a
+    training step is a single XLA dispatch (docs/MIGRATION.md 'fuse the
+    whole step'); multibox_target traces into the same program."""
+
+    def __init__(self, net):
+        super().__init__()
+        self.net = net
+
+    def forward(self, x, y):
+        anchor, cls_preds, loc_preds = self.net(x)
+        loc_t, loc_m, cls_t = mx.nd.contrib.multibox_target(anchor, y)
+        return ssd_loss(cls_preds, cls_t, loc_preds, loc_t, loc_m)
+
+
+def train(rec_path, steps=60, batch_size=8, lr=0.2, log=print):
+    it = mx.image.ImageDetIter(
+        batch_size=batch_size, data_shape=(3, 64, 64),
+        path_imgrec=rec_path, shuffle=True,
+        rand_mirror=True, mean=True, std=True)
+    net = TinySSD()
+    net.initialize(init=mx.init.Xavier())
+    netloss = SSDWithLoss(net)
+    netloss.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9},
+                            kvstore="tpu_ici")
+    losses = []
+    step = 0
+    while step < steps:
+        it.reset()
+        for batch in it:
+            if step >= steps:
+                break
+            with autograd.record():
+                loss = netloss(batch.data[0], batch.label[0])
+            loss.backward()
+            trainer.step(batch_size)
+            losses.append(float(loss.asnumpy()))
+            if step % 10 == 0:
+                log(f"step {step:4d}  loss {losses[-1]:.4f}")
+            step += 1
+    return net, it, losses
+
+
+def detect(net, it):
+    """Decode one batch: returns (B, N, 6) rows of
+    [cls, score, x1, y1, x2, y2], NMS-filtered."""
+    it.reset()
+    batch = next(iter(it))
+    anchor, cls_preds, loc_preds = net(batch.data[0])
+    cls_prob = mx.npx.softmax(cls_preds, axis=-1).transpose(0, 2, 1)
+    return mx.nd.contrib.multibox_detection(
+        cls_prob, loc_preds, anchor, nms_threshold=0.45, threshold=0.05)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.2)
+    p.add_argument("--data-dir", default=None)
+    args = p.parse_args()
+    root = args.data_dir or tempfile.mkdtemp(prefix="ssd_synth_")
+    rec = make_dataset(os.path.join(root, "synth"))
+    net, it, losses = train(rec, steps=args.steps,
+                            batch_size=args.batch_size, lr=args.lr)
+    first = sum(losses[:5]) / 5
+    last = sum(losses[-5:]) / 5
+    print(f"loss {first:.4f} -> {last:.4f}")
+    assert last < first, "SSD training did not reduce the loss"
+    out = detect(net, it)
+    kept = (out.asnumpy()[:, :, 0] >= 0).sum()
+    print(f"detections kept after NMS: {int(kept)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -523,3 +523,17 @@ class ImageIter:
 
     def __next__(self):
         return self.next()
+
+
+# detection-aware augmenters + ImageDetIter live in their own module but
+# surface here, matching the reference's `mx.image` namespace
+# (`python/mxnet/image/detection.py` re-exported via image/__init__.py)
+from .image_detection import (  # noqa: E402
+    DetAugmenter, DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug,
+    DetRandomCropAug, DetRandomPadAug, CreateMultiRandCropAugmenter,
+    CreateDetAugmenter, ImageDetIter)
+
+__all__ += ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+            "CreateMultiRandCropAugmenter", "CreateDetAugmenter",
+            "ImageDetIter"]

@@ -1,0 +1,453 @@
+"""Detection-aware image augmentation + iterator.
+
+Reference: `python/mxnet/image/detection.py:1` (DetAugmenter family +
+``ImageDetIter``) and the packed-label record format of
+`src/io/iter_image_det_recordio.cc:1`.  Every geometric transform updates
+the bounding boxes together with the pixels; labels are normalized
+``[cls, xmin, ymin, xmax, ymax, ...]`` rows (coords in [0, 1]) behind a
+``[header_width, obj_width, ...header..., objects...]`` flat wire format.
+
+TPU-native design: augmentation is host-side numpy feeding the device
+pipeline (decode/augment is the CPU stage of the input pipeline — the
+reference runs it in C++ iterator threads; here `io.DataLoader` workers or
+`DevicePrefetcher` overlap it with TPU compute).  The detection *ops*
+(multibox_prior/target/detection, box_nms) are XLA lowerings in
+`ops/contrib.py`; this module is what feeds them.
+"""
+from __future__ import annotations
+
+import random as pyrandom
+
+import numpy as onp
+
+from .image import (Augmenter, CastAug, ColorJitterAug, ColorNormalizeAug,
+                    ForceResizeAug, HueJitterAug, ImageIter, LightingAug,
+                    RandomGrayAug, ResizeAug, _as_np, fixed_crop)
+
+__all__ = [
+    "DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+    "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+    "CreateMultiRandCropAugmenter", "CreateDetAugmenter", "ImageDetIter",
+]
+
+
+def _box_areas(boxes):
+    """Areas of normalized [xmin, ymin, xmax, ymax] rows."""
+    return (onp.maximum(0.0, boxes[:, 2] - boxes[:, 0]) *
+            onp.maximum(0.0, boxes[:, 3] - boxes[:, 1]))
+
+
+class DetAugmenter:
+    """Base class: ``(image HWC, label (N, 5+)) -> (image, label)``
+    (reference `detection.py:40`)."""
+
+    def __call__(self, src, label):
+        return src, label
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only `image.Augmenter` into the detection pipeline —
+    valid only for transforms that don't move pixels spatially (color,
+    cast, lighting; reference `detection.py:66`)."""
+
+    def __init__(self, augmenter):
+        assert isinstance(augmenter, Augmenter)
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly run ONE augmenter from a list, or none with
+    ``skip_prob`` (reference `detection.py:91`)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if self.aug_list and pyrandom.random() >= self.skip_prob:
+            src, label = pyrandom.choice(self.aug_list)(src, label)
+        return src, label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image and boxes with probability ``p`` (reference
+    `detection.py:127`: xmin' = 1-xmax, xmax' = 1-xmin)."""
+
+    def __init__(self, p):
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = _as_np(src)[:, ::-1]
+            label = label.copy()
+            new_xmin = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - label[:, 1]
+            label[:, 1] = new_xmin
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Constrained random crop (reference `detection.py:153`): sample a
+    crop window whose aspect/area fall in range and that covers at least
+    ``min_object_covered`` of every (surviving) object; boxes are
+    re-normalized to the window and objects cropped below
+    ``min_eject_coverage`` of their area are dropped."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 1.0),
+                 min_eject_coverage=0.3, max_attempts=50):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.enabled = (0 < area_range[1] >= area_range[0] and
+                        0 < aspect_ratio_range[0] <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        src = _as_np(src)
+        h, w = src.shape[0], src.shape[1]
+        found = self._propose(label, h, w)
+        if found:
+            x0, y0, cw, ch, label = found
+            src = fixed_crop(src, x0, y0, cw, ch, None)
+        return src, label
+
+    def _coverage_ok(self, boxes, window):
+        """True when every object overlapping the window is covered at
+        least min_object_covered (normalized coords)."""
+        x0, y0, x1, y1 = window
+        areas = _box_areas(boxes)
+        live = areas > 0
+        if not live.any():
+            return False
+        inter = onp.stack([
+            onp.maximum(boxes[:, 0], x0), onp.maximum(boxes[:, 1], y0),
+            onp.minimum(boxes[:, 2], x1), onp.minimum(boxes[:, 3], y1),
+        ], axis=1)
+        cov = _box_areas(inter) / onp.maximum(areas, 1e-12)
+        cov = cov[live & (cov > 0)]
+        return cov.size > 0 and cov.min() > self.min_object_covered
+
+    def _clip_labels(self, label, x0, y0, cw, ch, height, width):
+        """Re-normalize boxes to the crop window; drop objects whose
+        surviving area fraction is below min_eject_coverage."""
+        out = label.copy()
+        fx, fy = x0 / width, y0 / height
+        fw, fh = cw / width, ch / height
+        before = _box_areas(out[:, 1:5])
+        out[:, (1, 3)] = (out[:, (1, 3)] - fx) / fw
+        out[:, (2, 4)] = (out[:, (2, 4)] - fy) / fh
+        out[:, 1:5] = onp.clip(out[:, 1:5], 0.0, 1.0)
+        kept = _box_areas(out[:, 1:5]) * fw * fh
+        valid = ((out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2]) &
+                 (kept > self.min_eject_coverage *
+                  onp.maximum(before, 1e-12)))
+        return out[valid] if valid.any() else None
+
+    def _propose(self, label, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return None
+        lo_area = self.area_range[0] * height * width
+        hi_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            ch_lo = int(round((lo_area / ratio) ** 0.5))
+            ch_hi = min(int(round((hi_area / ratio) ** 0.5)),
+                        height, int(width / ratio))
+            if ch_hi < 1 or ch_lo > ch_hi:
+                continue
+            ch = pyrandom.randint(min(ch_lo, ch_hi), ch_hi)
+            cw = min(int(round(ch * ratio)), width)
+            if not (lo_area * 0.99 <= cw * ch <= hi_area * 1.01) or \
+                    cw * ch < 2:
+                continue
+            y0 = pyrandom.randint(0, height - ch)
+            x0 = pyrandom.randint(0, width - cw)
+            window = (x0 / width, y0 / height,
+                      (x0 + cw) / width, (y0 + ch) / height)
+            if not self._coverage_ok(label[:, 1:5], window):
+                continue
+            new_label = self._clip_labels(label, x0, y0, cw, ch,
+                                          height, width)
+            if new_label is not None:
+                return x0, y0, cw, ch, new_label
+        return None
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion pad (reference `detection.py:324`): place the
+    image inside a larger canvas filled with ``pad_val``; boxes shrink
+    into the canvas coordinates."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(128, 128, 128)):
+        if not isinstance(pad_val, (tuple, list)):
+            pad_val = (pad_val,)
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        self.pad_val = pad_val
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.enabled = (area_range[1] > 1.0 and
+                        area_range[0] <= area_range[1] and
+                        0 < aspect_ratio_range[0] <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        src = _as_np(src)
+        h, w = src.shape[0], src.shape[1]
+        found = self._propose(label, h, w)
+        if found:
+            x0, y0, pw, ph, label = found
+            canvas = onp.empty((ph, pw, src.shape[2]), src.dtype)
+            canvas[...] = onp.asarray(
+                self.pad_val * (src.shape[2] if len(self.pad_val) == 1
+                                else 1))[:src.shape[2]]
+            canvas[y0:y0 + h, x0:x0 + w] = src
+            src = canvas
+        return src, label
+
+    def _propose(self, label, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return None
+        lo_area = self.area_range[0] * height * width
+        hi_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            ph_lo = max(int(round((lo_area / ratio) ** 0.5)), height,
+                        int(round(width / ratio)))
+            ph_hi = max(int(round((hi_area / ratio) ** 0.5)), ph_lo)
+            ph = pyrandom.randint(ph_lo, ph_hi)
+            pw = int(round(ph * ratio))
+            if ph - height < 2 or pw - width < 2:
+                continue
+            y0 = pyrandom.randint(0, ph - height)
+            x0 = pyrandom.randint(0, pw - width)
+            out = label.copy()
+            out[:, (1, 3)] = (out[:, (1, 3)] * width + x0) / pw
+            out[:, (2, 4)] = (out[:, (2, 4)] * height + y0) / ph
+            return x0, y0, pw, ph, out
+        return None
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0.0):
+    """One-of-N random crops, each with its own constraint set (reference
+    `detection.py:418`): scalar parameters broadcast, list parameters
+    must agree in length."""
+    def listify(x):
+        return x if isinstance(x, list) else [x]
+
+    params = [listify(min_object_covered), listify(aspect_ratio_range),
+              listify(area_range), listify(min_eject_coverage),
+              listify(max_attempts)]
+    n = max(len(p) for p in params)
+    params = [p * n if len(p) == 1 else p for p in params]
+    assert all(len(p) == n for p in params), \
+        "CreateMultiRandCropAugmenter: list parameters must align"
+    crops = [DetRandomCropAug(moc, arr, ar, mec, ma)
+             for moc, arr, ar, mec, ma in zip(*params)]
+    return DetRandomSelectAug(crops, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """The standard detection augmentation chain (reference
+    `detection.py:483`): resize → color jitter → expansion pad →
+    constrained crop → mirror → force-resize to ``data_shape`` →
+    cast/normalize.  ``rand_crop``/``rand_pad``/``rand_gray`` are
+    probabilities."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        from .image import PCA_EIGVAL, PCA_EIGVEC
+        auglist.append(DetBorrowAug(
+            LightingAug(pca_noise, PCA_EIGVAL, PCA_EIGVEC)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if rand_pad > 0:
+        auglist.append(DetRandomSelectAug(
+            [DetRandomPadAug(aspect_ratio_range,
+                             (1.0, area_range[1]), max_attempts, pad_val)],
+            skip_prob=1 - rand_pad))
+    if rand_crop > 0:
+        auglist.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range,
+            (area_range[0], min(area_range[1], 1.0)),
+            min_eject_coverage, max_attempts, skip_prob=1 - rand_crop))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator (reference `detection.py:625` over the packed
+    label format of `src/io/iter_image_det_recordio.cc:1`).
+
+    The record label is a flat float vector
+    ``[header_width, obj_width, <header...>, obj0..., obj1...]`` with one
+    ``[cls, xmin, ymin, xmax, ymax, ...]`` row per object (normalized
+    corner coords).  Batches pad the object dimension with ``-1`` rows to
+    ``label_shape`` so XLA sees one static shape per epoch."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, label_width=-1, data_name="data",
+                 label_name="label", last_batch_handle="pad", **aug_kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **aug_kwargs)
+        super().__init__(batch_size, data_shape, path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         shuffle=shuffle,
+                         aug_list=[],  # det augmenters applied by us
+                         label_width=max(label_width, 1),
+                         data_name=data_name, label_name=label_name,
+                         last_batch_handle=last_batch_handle)
+        self.det_aug_list = aug_list
+        self.max_objects, obj_width = self._estimate_label_shape()
+        self.label_shape = (self.max_objects, obj_width)
+        from .io import DataDesc
+        self.provide_label = [DataDesc(
+            label_name, (batch_size,) + self.label_shape)]
+
+    # -- label plumbing ----------------------------------------------------
+    @staticmethod
+    def _parse_label(raw):
+        """Flat packed vector -> (N, obj_width) rows (reference
+        `detection.py:717`); drops degenerate boxes."""
+        raw = onp.asarray(raw, onp.float32).ravel()
+        if raw.size < 7:
+            raise RuntimeError(f"invalid packed det label size {raw.size}")
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if obj_width < 5 or (raw.size - header_width) % obj_width != 0:
+            raise RuntimeError(
+                f"label size {raw.size} inconsistent with header "
+                f"{header_width}/object width {obj_width}")
+        objs = raw[header_width:].reshape(-1, obj_width)
+        valid = (objs[:, 3] > objs[:, 1]) & (objs[:, 4] > objs[:, 2])
+        if not valid.any():
+            raise RuntimeError("sample with no valid boxes")
+        return objs[valid]
+
+    def _estimate_label_shape(self):
+        """Scan the dataset once for (max_objects, obj_width) (reference
+        `detection.py:703`)."""
+        max_objs, width = 0, 5
+        for i in range(len(self._keys)):
+            label = self._raw_label(i)
+            try:
+                parsed = self._parse_label(label)
+            except RuntimeError:
+                continue
+            max_objs = max(max_objs, parsed.shape[0])
+            width = parsed.shape[1]
+        if max_objs == 0:
+            raise RuntimeError("no sample carries a valid detection label")
+        return max_objs, width
+
+    def _raw_label(self, i):
+        if self._rec is not None:
+            from .recordio import unpack
+            header, _ = unpack(self._rec.read_idx(self._keys[i]))
+            return onp.asarray(header.label, onp.float32)
+        path, label = self._items[i]
+        return onp.asarray(label, onp.float32)
+
+    def reshape(self, data_shape=None, label_shape=None):
+        """Rebind data/label shapes (reference `detection.py:743`)."""
+        from .io import DataDesc
+        if data_shape is not None:
+            assert len(data_shape) == 3
+            self.data_shape = tuple(data_shape)
+            self.provide_data = [DataDesc(
+                self.provide_data[0].name,
+                (self.batch_size,) + self.data_shape)]
+        if label_shape is not None:
+            self.check_label_shape(label_shape)
+            self.label_shape = tuple(label_shape)
+            self.max_objects = label_shape[0]
+            self.provide_label = [DataDesc(
+                self.provide_label[0].name,
+                (self.batch_size,) + self.label_shape)]
+
+    def check_label_shape(self, label_shape):
+        if len(label_shape) != 2 or label_shape[0] < self.max_objects:
+            raise ValueError(
+                f"label_shape {label_shape} cannot hold up to "
+                f"{self.max_objects} objects")
+
+    def sync_label_shape(self, it, verbose=False):
+        """Grow both iterators to the larger label shape (train/val
+        pairing; reference `detection.py:967`)."""
+        assert isinstance(it, ImageDetIter)
+        n = max(self.label_shape[0], it.label_shape[0])
+        w = max(self.label_shape[1], it.label_shape[1])
+        shape = (n, w)
+        self.max_objects = it.max_objects = 0  # allow shrink-to-sync
+        self.reshape(label_shape=shape)
+        it.reshape(label_shape=shape)
+        self.max_objects = it.max_objects = n
+        return it
+
+    # -- batch production --------------------------------------------------
+    def _read_one(self, i):
+        from .recordio import unpack_img
+        import os as _os
+        if self._rec is not None:
+            header, img = unpack_img(
+                self._rec.read_idx(self._keys[i]),
+                iscolor=1 if self.data_shape[0] == 3 else 0)
+            raw = onp.asarray(header.label, onp.float32)
+        else:
+            path, raw = self._items[i]
+            from .image import imread
+            img = imread(_os.path.join(self.path_root, path),
+                         flag=1 if self.data_shape[0] == 3 else 0)
+            raw = onp.asarray(raw, onp.float32)
+        label = self._parse_label(raw)
+        img = _as_np(img)
+        for aug in self.det_aug_list:
+            img, label = aug(img, label)
+            if label.shape[0] == 0:
+                raise RuntimeError("augmentation dropped every box")
+        padded = onp.full((self.max_objects, self.label_shape[1]), -1.0,
+                          onp.float32)
+        n = min(label.shape[0], self.max_objects)
+        padded[:n] = label[:n]
+        arr = _as_np(img).astype(onp.float32)
+        return arr.transpose(2, 0, 1), padded

