@@ -8,6 +8,7 @@ line per (T, variant).
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -23,6 +24,13 @@ ITERS = 10
 
 
 def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--output", default=None,
+                   help="write all result lines as a JSON array here")
+    p.add_argument("--seq-lens", default="512,1024,2048,4096,8192",
+                   help="comma-separated sequence lengths")
+    args = p.parse_args()
+
     import jax
     import jax.numpy as jnp
 
@@ -37,7 +45,8 @@ def main():
     def flash(q, k, v):
         return pk._flash(q, k, v, False, None, 128, 128, None)
 
-    for t in (2048, 4096, 8192):
+    rows = []
+    for t in (int(x) for x in args.seq_lens.split(",")):
         qkv = [jnp.asarray(onp.random.randn(B, H, t, D), jnp.bfloat16)
                for _ in range(3)]
 
@@ -63,14 +72,21 @@ def main():
                         step()
                     waitall()
                     ms = (time.perf_counter() - t0) / ITERS * 1e3
-                    print(json.dumps({
+                    row = {
                         "metric": f"attn_{name}_{kind}_ms",
                         "seq_len": t, "value": round(ms, 2), "unit": "ms",
                         "tokens_per_s": round(B * t / (ms / 1e3)),
-                    }))
+                    }
+                    print(json.dumps(row))
+                    rows.append(row)
             except Exception as e:
-                print(json.dumps({"metric": f"attn_{name}_error",
-                                  "seq_len": t, "error": str(e)[:120]}))
+                row = {"metric": f"attn_{name}_error",
+                       "seq_len": t, "error": str(e)[:120]}
+                print(json.dumps(row))
+                rows.append(row)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(rows, f, indent=1)
 
 
 if __name__ == "__main__":

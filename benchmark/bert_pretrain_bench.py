@@ -1,11 +1,21 @@
-"""BERT-base pretraining throughput on one chip (BASELINE config 4 path).
+"""BERT-base pretraining throughput + MFU on one chip (BASELINE config 4).
 
 MLM+NSP loss over the Gluon BERT, bf16, batch 32 x seq 128, driven by
-`gluon.FusedTrainStep` (one XLA program per step).  Prints one JSON line;
-best of three fully-drained windows (see bench.py for the sync rationale).
+`gluon.FusedTrainStep` (one XLA program per step).  Prints one JSON line
+(best of three fully-drained windows; see bench.py for the sync
+rationale) carrying tokens/s AND model-FLOPs-utilization against the
+chip's 197 TF/s bf16 peak, so the transformer perf story is judged the
+same way the ResNet one is (MFU_ANALYSIS.md / BERT_ANALYSIS.md).
+
+MFU accounting: training FLOPs/token = 6·N_dense (fwd+bwd weight
+matmuls; N_dense excludes embedding tables, whose forward is a gather)
++ 12·L·U·T attention-score/context FLOPs.  The MLM head's vocab
+projection (tied embedding, U×V matmul) IS dense compute and dominates
+at T=128 — it is counted in N_dense.
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
@@ -16,18 +26,33 @@ import numpy as onp
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 B, T = 32, 128
+L, U, V = 12, 768, 30522
 WARMUP = 6
 ITERS = 30
+PEAK_BF16 = 197e12  # one v5e chip
+
+
+def flops_per_token(n_dense):
+    # 6 FLOPs per dense weight per token (2 fwd + 4 bwd) + attention
+    # scores/context: 2 matmuls of 2·T·U each, fwd+bwd -> 12·T·U per
+    # layer per token
+    return 6.0 * n_dense + 12.0 * L * U * T
 
 
 def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--output", default=None)
+    p.add_argument("--dp", type=int, default=0,
+                   help="data-parallel mesh size (multi-host runs)")
+    args = p.parse_args()
+
     import mxnet_tpu as mx
     from mxnet_tpu.gluon import FusedTrainStep, Trainer
     from mxnet_tpu.gluon.block import HybridBlock
     from mxnet_tpu.models import BertForPretraining
 
-    model = BertForPretraining(vocab_size=30522, units=768, hidden_size=3072,
-                               num_layers=12, num_heads=12, max_length=512,
+    model = BertForPretraining(vocab_size=V, units=U, hidden_size=3072,
+                               num_layers=L, num_heads=12, max_length=512,
                                dropout=0.1)
     model.initialize()
     model.cast("bfloat16")
@@ -46,11 +71,24 @@ def main():
             return mlm + nsp
 
     mod = PretrainLoss(model)
-    tokens = mx.np.array(onp.random.randint(0, 30522, (B, T)), dtype="int32")
+    tokens = mx.np.array(onp.random.randint(0, V, (B, T)), dtype="int32")
     segments = mx.np.array(onp.zeros((B, T)), dtype="int32")
-    labels = mx.np.array(onp.random.randint(0, 30522, (B, T)), dtype="int32")
+    labels = mx.np.array(onp.random.randint(0, V, (B, T)), dtype="int32")
     trainer = Trainer(model.collect_params(), "adam", {"learning_rate": 1e-4})
-    step = FusedTrainStep(mod, trainer)
+    mesh = None
+    if args.dp:
+        from mxnet_tpu.parallel import mesh as pmesh
+        mesh = pmesh.make_mesh({"dp": args.dp})
+    step = FusedTrainStep(mod, trainer, mesh=mesh)
+
+    # dense-param count for MFU: everything except the embedding tables
+    # (their forward is a gather, not a matmul; the TIED mlm vocab
+    # projection is a real U x V matmul and is added back explicitly)
+    params = model.collect_params()
+    n_total = sum(int(onp.prod(p.shape)) for p in params.values())
+    n_embed = sum(int(onp.prod(p.shape)) for name, p in params.items()
+                  if "embed" in name.lower())
+    n_dense = n_total - n_embed + U * V  # + tied vocab projection matmul
 
     for _ in range(WARMUP):
         loss = step(tokens, segments, labels, batch_size=B)
@@ -65,13 +103,27 @@ def main():
         mx.waitall()
         windows.append(B * T * ITERS / (time.perf_counter() - t0))
 
-    print(json.dumps({
+    tok_s = max(windows)
+    fpt = flops_per_token(n_dense)
+    n_chips = max(args.dp, 1)  # tok_s is the global rate on a dp mesh
+    result = {
         "metric": "bert_base_pretrain_bf16_tokens_per_s",
-        "value": round(max(windows), 0),
+        "value": round(tok_s, 0),
         "unit": "tokens/s",
         "batch": B, "seq_len": T,
         "window_tokens_per_s": [round(w) for w in windows],
-    }))
+        "params_total": n_total,
+        "params_dense_for_mfu": int(n_dense),
+        "flops_per_token": round(fpt),
+        "n_chips": n_chips,
+        "model_tflops_per_s": round(tok_s * fpt / 1e12, 2),
+        "mfu_vs_197tf_bf16": round(tok_s * fpt / (PEAK_BF16 * n_chips), 4),
+    }
+    line = json.dumps(result)
+    print(line)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(line + "\n")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,357 @@
+"""ResNet-50 MFU residual levers, measured (round-3 verdict weak #2).
+
+MFU_ANALYSIS.md names the two levers left between the 2.65k img/s
+operating point (~17% MFU) and the 3.77k img/s identity-BN bound, plus a
+batch lever. This experiment measures all three with the same-window
+interleaving methodology (drift cancels; see bench.py _bench_ab):
+
+(a) **BN f32 intermediate**: a variant that keeps the normalize math in
+    bf16 (stats still accumulate in f32 via `jnp.sum(..., dtype=f32)`)
+    vs the baseline's f32 elementwise chain. Evidence at two levels:
+    end-to-end img/s, and per-layer `cost_analysis()` bytes-accessed +
+    HLO convert census on a ResNet-representative BN shape.
+(b) **BN backward residual policy**: recompute-xhat (baseline: bwd
+    re-reads `data` and recomputes xhat) vs store-xhat (fwd writes xhat,
+    bwd reads it — trades a fwd write for bwd compute).
+(c) **Batch**: 128 / 192 / 256 interleaved; 512 attempted last
+    (expected RESOURCE_EXHAUSTED on the shared 16 GB chip — recorded
+    either way).
+
+Usage:  python benchmark/mfu_residuals_experiment.py
+        [--skip-model] [--batches 128,192,256] [--output FILE]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+WARMUP = 6
+ITERS = 20
+ROUNDS = 3
+
+
+# ---------------------------------------------------------------------------
+# BN variants (same API as ops/nn.py batch_norm_train)
+# ---------------------------------------------------------------------------
+def _make_variants():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mxnet_tpu.ops import nn as _nn
+
+    def shape_of(data, axis):
+        s = [1] * data.ndim
+        s[axis] = data.shape[axis]
+        return tuple(s)
+
+    # -- variant A: bf16 normalize math, f32-accumulated stats ------------
+    def bn_bf16_fwd(data, gamma, beta, moving_mean, moving_var, momentum,
+                    eps, axis):
+        red = tuple(i for i in range(data.ndim) if i != axis)
+        n = 1
+        for i in red:
+            n *= data.shape[i]
+        # ONE bf16 read; f32 accumulation happens inside the reductions
+        s1 = jnp.sum(data, axis=red, dtype=jnp.float32)
+        s2 = jnp.sum(jnp.square(data.astype(jnp.float32)), axis=red)
+        mean = s1 / n
+        var = jnp.maximum(s2 / n - mean * mean, 0.0)
+        inv = lax.rsqrt(var + eps)
+        a = (gamma.astype(jnp.float32) * inv).astype(data.dtype)
+        b = (beta.astype(jnp.float32) - mean * gamma.astype(jnp.float32)
+             * inv).astype(data.dtype)
+        sh = shape_of(data, axis)
+        out = data * a.reshape(sh) + b.reshape(sh)   # bf16 multiply-add
+        new_mean = moving_mean * momentum + \
+            mean.astype(moving_mean.dtype) * (1 - momentum)
+        new_var = moving_var * momentum + \
+            var.astype(moving_var.dtype) * (1 - momentum)
+        return (out, new_mean, new_var), (data, gamma, mean, inv)
+
+    def bn_bf16_bwd(momentum, eps, axis, res, cts):
+        data, gamma, mean, inv = res
+        dy, d_mm, d_mv = cts
+        red = tuple(i for i in range(data.ndim) if i != axis)
+        n = 1
+        for i in red:
+            n *= data.shape[i]
+        sh = shape_of(data, axis)
+        m16 = mean.astype(data.dtype)
+        i16 = inv.astype(data.dtype)
+        xhat = (data - m16.reshape(sh)) * i16.reshape(sh)    # bf16
+        sum_dy = jnp.sum(dy, axis=red, dtype=jnp.float32)
+        sum_dy_xhat = jnp.sum((dy * xhat).astype(jnp.float32), axis=red)
+        a = (gamma.astype(jnp.float32) * inv).astype(data.dtype)
+        dx = a.reshape(sh) * (
+            dy - (sum_dy / n).astype(data.dtype).reshape(sh) -
+            xhat * (sum_dy_xhat / n).astype(data.dtype).reshape(sh))
+        return (dx, sum_dy_xhat.astype(gamma.dtype),
+                sum_dy.astype(gamma.dtype), d_mm * momentum,
+                d_mv * momentum)
+
+    # custom_vjp with nondiff momentum/eps/axis, mirroring ops/nn.py
+    bn_bf16_core = jax.custom_vjp(
+        lambda data, gamma, beta, mm, mv, momentum, eps, axis:
+        bn_bf16_fwd(data, gamma, beta, mm, mv, momentum, eps, axis)[0],
+        nondiff_argnums=(5, 6, 7))
+    bn_bf16_core.defvjp(
+        lambda data, gamma, beta, mm, mv, momentum, eps, axis:
+        bn_bf16_fwd(data, gamma, beta, mm, mv, momentum, eps, axis),
+        bn_bf16_bwd)
+
+    def batch_norm_train_bf16(data, gamma, beta, momentum, eps, axis,
+                              moving_mean, moving_var):
+        return bn_bf16_core(data, gamma, beta, moving_mean, moving_var,
+                            momentum, eps, axis)
+
+    # -- variant B: store-xhat residuals (bwd reads xhat, not data) -------
+    def bn_store_fwd(data, gamma, beta, moving_mean, moving_var, momentum,
+                     eps, axis):
+        (out, new_mean, new_var), (d, g, mean, inv) = _nn._bn_train_fwd(
+            data, gamma, beta, moving_mean, moving_var, momentum, eps, axis)
+        sh = shape_of(data, axis)
+        cdt = jnp.promote_types(data.dtype, jnp.float32)
+        xhat = ((data.astype(cdt) - mean.reshape(sh)) *
+                inv.reshape(sh)).astype(data.dtype)
+        return (out, new_mean, new_var), (xhat, g, inv)
+
+    def bn_store_bwd(momentum, eps, axis, res, cts):
+        xhat16, gamma, inv = res
+        dy, d_mm, d_mv = cts
+        red = tuple(i for i in range(xhat16.ndim) if i != axis)
+        n = 1
+        for i in red:
+            n *= xhat16.shape[i]
+        sh = shape_of(xhat16, axis)
+        cdt = jnp.promote_types(xhat16.dtype, jnp.float32)
+        dyf = dy.astype(cdt)
+        xhat = xhat16.astype(cdt)
+        sum_dy = jnp.sum(dyf, axis=red)
+        sum_dy_xhat = jnp.sum(dyf * xhat, axis=red)
+        a = (gamma.astype(cdt) * inv).reshape(sh)
+        dx = a * (dyf - (sum_dy / n).reshape(sh) -
+                  xhat * (sum_dy_xhat / n).reshape(sh))
+        return (dx.astype(xhat16.dtype), sum_dy_xhat.astype(gamma.dtype),
+                sum_dy.astype(gamma.dtype), d_mm * momentum, d_mv * momentum)
+
+    bn_store_core = jax.custom_vjp(
+        lambda data, gamma, beta, mm, mv, momentum, eps, axis:
+        bn_store_fwd(data, gamma, beta, mm, mv, momentum, eps, axis)[0],
+        nondiff_argnums=(5, 6, 7))
+    bn_store_core.defvjp(
+        lambda data, gamma, beta, mm, mv, momentum, eps, axis:
+        bn_store_fwd(data, gamma, beta, mm, mv, momentum, eps, axis),
+        bn_store_bwd)
+
+    def batch_norm_train_store(data, gamma, beta, momentum, eps, axis,
+                               moving_mean, moving_var):
+        return bn_store_core(data, gamma, beta, moving_mean, moving_var,
+                             momentum, eps, axis)
+
+    return {"baseline": _nn.batch_norm_train,
+            "bf16_norm": batch_norm_train_bf16,
+            "store_xhat": batch_norm_train_store}
+
+
+# ---------------------------------------------------------------------------
+# part 1: per-layer cost analysis at a ResNet-representative shape
+# ---------------------------------------------------------------------------
+def layer_analysis(variants):
+    import jax
+    import jax.numpy as jnp
+
+    B, C, H, W = 128, 256, 56, 56
+    x = jnp.asarray(onp.random.randn(B, C, H, W), jnp.bfloat16)
+    g = jnp.ones((C,), jnp.float32)
+    b = jnp.zeros((C,), jnp.float32)
+    mm = jnp.zeros((C,), jnp.float32)
+    mv = jnp.ones((C,), jnp.float32)
+    rows = []
+    for name, bn in variants.items():
+        def loss(x, g, b, bn=bn):
+            out, _nm, _nv = bn(x, g, b, 0.9, 1e-5, 1, mm, mv)
+            return jnp.sum(out.astype(jnp.float32))
+
+        comp = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
+            x, g, b).compile()
+        ca = comp.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        hlo = comp.as_text()
+        rows.append({
+            "experiment": "bn_layer_fwd_bwd", "variant": name,
+            "shape": [B, C, H, W],
+            "bytes_accessed": ca.get("bytes accessed"),
+            "flops": ca.get("flops"),
+            "hlo_f32_big_buffers": sum(
+                1 for l in hlo.splitlines()
+                if f"f32[{B},{C}" in l.replace(" ", "")),
+            "hlo_convert_count": hlo.count("convert("),
+        })
+        print(json.dumps(rows[-1]), flush=True)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# part 2: full-model interleaved windows
+# ---------------------------------------------------------------------------
+def model_ab(variants, batch, rounds=ROUNDS):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.ops import nn as _nn
+
+    from bench import _net_with_loss_classes
+
+    NetWithLoss, _ = _net_with_loss_classes()
+    net = vision.resnet50_v1()
+    net.initialize(init=mx.init.Xavier())
+    net.cast("bfloat16")
+    lf = gloss.SoftmaxCrossEntropyLoss()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1, "momentum": 0.9},
+                               kvstore="device")
+    rs = onp.random.RandomState(0)
+    x = mx.np.array(rs.uniform(-1, 1, (batch, 3, 224, 224)),
+                    dtype="bfloat16")
+    y = mx.np.array(rs.randint(0, 1000, (batch,)), dtype="int32")
+
+    steps = {}
+    orig = _nn.batch_norm_train
+    for name, bn in variants.items():
+        # each variant needs its own traced program; the patch is active
+        # only during this variant's compile (trace happens on first call)
+        _nn.batch_norm_train = bn
+        mod = NetWithLoss(net, lf)
+        step = mx.gluon.FusedTrainStep(mod, trainer)
+        for _ in range(WARMUP):
+            step(x, y, batch_size=batch)
+        mx.waitall()
+        _nn.batch_norm_train = orig
+        steps[name] = step
+
+    def window(step):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            step(x, y, batch_size=batch)
+        mx.waitall()
+        return batch * ITERS / (time.perf_counter() - t0)
+
+    per = {name: [] for name in steps}
+    for _round in range(rounds):
+        for name, step in steps.items():
+            per[name].append(window(step))
+    rows = []
+    base = max(per["baseline"])
+    for name, rates in per.items():
+        rows.append({
+            "experiment": "resnet50_train_interleaved", "batch": batch,
+            "variant": name, "img_per_s": round(max(rates), 1),
+            "rounds": [round(r, 1) for r in rates],
+            "vs_baseline": round(max(rates) / base, 4),
+        })
+        print(json.dumps(rows[-1]), flush=True)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# part 3: batch sweep (subprocess per batch: OOM poisons the client)
+# ---------------------------------------------------------------------------
+def batch_probe(batch):
+    """Child mode: one batch, baseline BN, prints img/s or exits 42."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    from bench import _net_with_loss_classes
+
+    NetWithLoss, _ = _net_with_loss_classes()
+    try:
+        net = vision.resnet50_v1()
+        net.initialize(init=mx.init.Xavier())
+        net.cast("bfloat16")
+        mod = NetWithLoss(net, gloss.SoftmaxCrossEntropyLoss())
+        trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                                   {"learning_rate": 0.1, "momentum": 0.9},
+                                   kvstore="device")
+        step = mx.gluon.FusedTrainStep(mod, trainer)
+        rs = onp.random.RandomState(0)
+        x = mx.np.array(rs.uniform(-1, 1, (batch, 3, 224, 224)),
+                        dtype="bfloat16")
+        y = mx.np.array(rs.randint(0, 1000, (batch,)), dtype="int32")
+        for _ in range(WARMUP):
+            step(x, y, batch_size=batch)
+        mx.waitall()
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(ITERS):
+                step(x, y, batch_size=batch)
+            mx.waitall()
+            best = max(best, batch * ITERS / (time.perf_counter() - t0))
+        print(json.dumps({"experiment": "batch_sweep", "batch": batch,
+                          "img_per_s": round(best, 1)}))
+    except Exception as e:
+        if "RESOURCE_EXHAUSTED" in str(e):
+            print(json.dumps({"experiment": "batch_sweep", "batch": batch,
+                              "error": "RESOURCE_EXHAUSTED"}))
+            sys.exit(42)
+        raise
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batches", default="128,192,256,512")
+    p.add_argument("--skip-model", action="store_true")
+    p.add_argument("--skip-batch-sweep", action="store_true")
+    p.add_argument("--output",
+                   default=os.path.join(os.path.dirname(__file__),
+                                        "results",
+                                        "mfu_residuals_tpu_v5e.json"))
+    args = p.parse_args()
+
+    if os.environ.get("MFU_BATCH_PROBE"):
+        batch_probe(int(os.environ["MFU_BATCH_PROBE"]))
+        return
+
+    rows = []
+    variants = _make_variants()
+    rows += layer_analysis(variants)
+    if not args.skip_model:
+        rows += model_ab(variants, 128)
+    if not args.skip_batch_sweep:
+        import subprocess
+        for b in (int(x) for x in args.batches.split(",")):
+            env = dict(os.environ, MFU_BATCH_PROBE=str(b))
+            proc = subprocess.run([sys.executable,
+                                   os.path.abspath(__file__)], env=env,
+                                  stdout=subprocess.PIPE, text=True,
+                                  timeout=1800)
+            got_row = False
+            for line in proc.stdout.splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    rows.append(json.loads(line))
+                    print(line, flush=True)
+                    got_row = True
+            if not got_row or proc.returncode not in (0, 42):
+                # a crashed probe must be a visible row, not a silent gap
+                row = {"experiment": "batch_sweep", "batch": b,
+                       "error": f"probe exited {proc.returncode}"}
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+    os.makedirs(os.path.dirname(args.output), exist_ok=True)
+    with open(args.output, "w") as f:
+        json.dump(rows, f, indent=1)
+    print(f"wrote {args.output}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -265,6 +265,12 @@ def _window(fn, n, sync, t_sync):
 _SMOKE = False  # harness smoke: tiny fixed windows, no adaptive growth
 
 
+class _NotDifferentiable(Exception):
+    """Sentinel: the op has no float input/output to differentiate —
+    distinct from real fwd+bwd failures (r4 review finding: a generic
+    ValueError catch would let vjp regressions masquerade as this)."""
+
+
 def _time(fn, iters, *, sync):
     """Best-of-3 windows, iteration count adapted so the op work dominates
     the drain: the drain is a host round trip (~100 ms with ±tens of ms of
@@ -322,7 +328,7 @@ def _scan_time(fn, datas, hint_us=None, grad=False):
                   if hasattr(d, "dtype") and d.dtype.kind == "f"), None)
     if chain is None:
         if grad:
-            raise ValueError("no float input to differentiate")
+            raise _NotDifferentiable("no float input")
         return _fallback_single_dispatch(fn, datas)
 
     def _float_leaves(out):
@@ -344,7 +350,7 @@ def _scan_time(fn, datas, hint_us=None, grad=False):
                 ins[i] = fl[j]
             fleaves = _float_leaves(fn(*[NDArray(d) for d in ins]))
             if not fleaves:
-                raise ValueError("no float output to differentiate")
+                raise _NotDifferentiable("no float output")
             total = fleaves[0].astype(jnp.float32).sum()
             for l in fleaves[1:]:
                 total = total + l.astype(jnp.float32).sum()
@@ -478,8 +484,8 @@ def _dump(results, output):
 def _error_row(name, cat, e):
     # keep the schema stable: error rows carry the timing keys too
     return {"op": name, "category": cat, "error": str(e)[:200],
-            "eager_us": None, "jit_us": None, "fwd_bwd_us": None,
-            "reliable": False}
+            "eager_us": None, "jit_us": None, "fwd_bwd_jit_us": None,
+            "fwd_bwd_us": None, "reliable": False}
 
 
 _DEAD_BACKEND = ("UNAVAILABLE", "crashed or restarted", "DataLoss",
@@ -548,8 +554,8 @@ def run(categories=None, iters=50, dtype="float32", warmup=None, ops=None,
             # the measured fwd kernel time is a tight hint: bwd ≈ 2-3x fwd
             fbj_us, fbj_ok = _scan_time(fn, datas, grad=True,
                                         hint_us=24 * max(jit_us, 0.5))
-        except ValueError:
-            pass  # no float input/output: genuinely not differentiable
+        except _NotDifferentiable:
+            pass
         except Exception as e:
             if _backend_dead(e):
                 _dump(results, output)

@@ -38,6 +38,19 @@ __all__ = [
     "bert_partition_rules", "bert_base", "bert_large",
 ]
 
+# measured flash-vs-dense crossover on one v5e chip
+# (benchmark/results/attention_tpu_v5e.json, fwd+bwd): dense wins through
+# moderate T, flash wins from here up.  use_flash="auto" switches at this
+# sequence length when masks/attention-dropout allow.
+FLASH_AUTO_MIN_T = 4096
+
+
+def _on_tpu():
+    """auto-flash only applies on TPU: off-TPU the Pallas kernel runs in
+    interpret mode (orders of magnitude slower than dense XLA)."""
+    import jax
+    return jax.default_backend() == "tpu"
+
 
 class MultiHeadAttention(HybridBlock):
     """Scaled dot-product multi-head attention.
@@ -48,16 +61,27 @@ class MultiHeadAttention(HybridBlock):
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
-                 dtype="float32", use_flash=False):
+                 dtype="float32", use_flash="auto"):
         super().__init__()
         assert units % num_heads == 0, "num_heads must divide units"
-        # opt-in Pallas flash kernel for sequences where the (T, T) score
-        # matrix is the memory wall; XLA's fused dense attention is faster
-        # at moderate T (see ops/pallas_kernels.py).  The kernel computes
+        # Pallas flash kernel for sequences where the (T, T) score matrix
+        # is the memory wall; XLA's fused dense attention is faster at
+        # moderate T (see ops/pallas_kernels.py).  The kernel computes
         # unmasked softmax over dense blocks, so it excludes attention
         # masks and attention-dropout, and T must be <=128 or a multiple
-        # of 128.
-        if use_flash and dropout > 0:
+        # of 128.  The default "auto" picks flash per call once T reaches
+        # the measured crossover (FLASH_AUTO_MIN_T, from
+        # benchmark/results/attention_tpu_v5e.json) and every constraint
+        # holds; True forces it (and raises on violations), False forces
+        # dense.
+        # identity checks: `1 in (True, ...)` is True by equality, and a
+        # truthy non-True value would skip the dropout guard below
+        if not (use_flash is True or use_flash is False or
+                use_flash == "auto"):
+            raise ValueError(
+                f"use_flash must be True, False, or 'auto'; got "
+                f"{use_flash!r}")
+        if use_flash is True and dropout > 0:
             raise ValueError(
                 "use_flash does not support attention dropout; set "
                 "dropout=0 (residual/FFN dropout is unaffected)")
@@ -65,6 +89,7 @@ class MultiHeadAttention(HybridBlock):
         self._num_heads = num_heads
         self._head_dim = units // num_heads
         self._use_flash = use_flash
+        self._attn_dropout_rate = dropout
         init_std = init.Normal(0.02)
         self.query = nn.Dense(units, flatten=False, use_bias=use_bias,
                               weight_initializer=init_std, dtype=dtype)
@@ -76,13 +101,23 @@ class MultiHeadAttention(HybridBlock):
                              weight_initializer=init_std, dtype=dtype)
         self.attn_dropout = nn.Dropout(dropout)
 
+    def _flash_now(self, t, mask):
+        """Resolve the use_flash policy for this call (T is trace-static,
+        so the choice bakes into the compiled program per shape)."""
+        if self._use_flash == "auto":
+            return (_on_tpu() and mask is None and
+                    self._attn_dropout_rate == 0 and
+                    t >= FLASH_AUTO_MIN_T and
+                    (t <= 128 or t % 128 == 0))
+        return bool(self._use_flash)
+
     def forward(self, x, mask=None):
         b, t, _ = x.shape
         h, d = self._num_heads, self._head_dim
         q = self.query(x).reshape(b, t, h, d)
         k = self.key(x).reshape(b, t, h, d)
         v = self.value(x).reshape(b, t, h, d)
-        if self._use_flash:
+        if self._flash_now(t, mask):
             if mask is not None:
                 raise ValueError(
                     "use_flash=True cannot apply attention masks (the "
@@ -129,7 +164,7 @@ class TransformerEncoderLayer(HybridBlock):
     """Post-norm (BERT-style) encoder layer."""
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 layer_norm_eps=1e-12, dtype="float32", use_flash=False):
+                 layer_norm_eps=1e-12, dtype="float32", use_flash="auto"):
         super().__init__()
         # dropout propagates unchanged: with use_flash MHA raises its
         # explicit attention-dropout error rather than silently diverging
@@ -151,7 +186,7 @@ class TransformerEncoderLayer(HybridBlock):
 class TransformerEncoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads,
                  dropout=0.0, layer_norm_eps=1e-12, dtype="float32",
-                 use_flash=False):
+                 use_flash="auto"):
         super().__init__()
         self._num_layers = num_layers
         for i in range(num_layers):
@@ -175,7 +210,7 @@ class BertModel(HybridBlock):
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
                  num_segments=2, dropout=0.1, layer_norm_eps=1e-12,
-                 dtype="float32", use_flash=False):
+                 dtype="float32", use_flash="auto"):
         super().__init__()
         self._units = units
         init_std = init.Normal(0.02)

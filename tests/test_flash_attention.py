@@ -97,3 +97,26 @@ def test_flash_small_sequence_blocks_clamp():
     q = mx.np.ones((1, 1, 8, 4))
     out = flash_attention(q, q, q)
     assert out.shape == (1, 1, 8, 4)
+
+
+def test_mha_auto_flash_policy(monkeypatch):
+    """use_flash='auto' (the default) picks flash only on TPU, above the
+    measured crossover, and when masks/attention-dropout permit."""
+    from mxnet_tpu.models import transformer as tr
+
+    mha = tr.MultiHeadAttention(64, 4, dropout=0.0)
+    assert mha._use_flash == "auto"
+    # off-TPU (this CI): auto never picks the interpret-mode kernel
+    assert not mha._flash_now(tr.FLASH_AUTO_MIN_T, None)
+    monkeypatch.setattr(tr, "_on_tpu", lambda: True)
+    assert not mha._flash_now(tr.FLASH_AUTO_MIN_T - 128, None)
+    assert mha._flash_now(tr.FLASH_AUTO_MIN_T, None)
+    assert not mha._flash_now(tr.FLASH_AUTO_MIN_T, object())  # mask
+    assert not mha._flash_now(tr.FLASH_AUTO_MIN_T + 1, None)  # not /128
+    dropped = tr.MultiHeadAttention(64, 4, dropout=0.1)
+    assert not dropped._flash_now(tr.FLASH_AUTO_MIN_T, None)
+    forced = tr.MultiHeadAttention(64, 4, use_flash=False)
+    assert not forced._flash_now(tr.FLASH_AUTO_MIN_T, None)
+    import pytest as _pt
+    with _pt.raises(ValueError, match="use_flash"):
+        tr.MultiHeadAttention(64, 4, use_flash=1)
