@@ -14,6 +14,7 @@ from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
 from .pipeline import pipeline_apply
 from .moe import moe_ffn, init_moe_params, moe_partition_specs, shard_moe_params
+from .layers import MoEFFN, GPipeMLP
 
 __all__ = [
     "make_mesh", "current_mesh", "mesh_scope", "data_sharding",
@@ -21,5 +22,5 @@ __all__ = [
     "global_put",
     "constrain", "ring_attention", "ulysses_attention", "init_distributed",
     "pipeline_apply", "moe_ffn", "init_moe_params", "moe_partition_specs",
-    "shard_moe_params",
+    "shard_moe_params", "MoEFFN", "GPipeMLP",
 ]
