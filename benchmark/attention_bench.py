@@ -1,10 +1,15 @@
 """Long-sequence attention: flash (Pallas) vs dense (XLA) on one chip.
 
-The long-context story's perf evidence: at sequence lengths where the
-(T, T) score matrix stresses HBM, the blockwise Pallas kernel keeps
-memory O(T * block) and overtakes XLA's dense fusion.  fwd and fwd+bwd
-timed with the true-drain methodology (see bench.py).  Prints one JSON
-line per (T, variant).
+The long-context story's perf evidence: where does the blockwise Pallas
+kernel (memory O(T * block)) overtake XLA's dense fusion (materialized
+(T, T) scores)?  Timed as device-side `lax.scan` loops — the opperf
+treatment — because through the tunnel a host drain costs ~100 ms and a
+10-iteration dispatch loop buries every sub-10 ms kernel under it
+(dense fwd+bwd "faster than fwd" was the tell).  Each scan iteration
+chains the output back into q with a 1e-24 perturbation so nothing is
+hoisted or dead-coded; the drain cost is measured separately and
+subtracted.  Prints one JSON line per (T, variant, direction) with a
+`reliable` flag (scan work >= 2x drain).
 """
 from __future__ import annotations
 
@@ -19,8 +24,6 @@ import numpy as onp
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 B, H, D = 4, 8, 64
-WARMUP = 3
-ITERS = 10
 
 
 def main():
@@ -29,13 +32,14 @@ def main():
                    help="write all result lines as a JSON array here")
     p.add_argument("--seq-lens", default="512,1024,2048,4096,8192",
                    help="comma-separated sequence lengths")
+    p.add_argument("--kinds", default="fwd,fwd_bwd",
+                   help="comma-separated subset of fwd,fwd_bwd")
     args = p.parse_args()
 
     import jax
     import jax.numpy as jnp
 
     from mxnet_tpu.ops import pallas_kernels as pk
-    from mxnet_tpu.ndarray.ndarray import waitall
 
     def dense(q, k, v):
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
@@ -43,46 +47,100 @@ def main():
         return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
     def flash(q, k, v):
-        return pk._flash(q, k, v, False, None, 128, 128, None)
+        return pk._flash(q, k, v, False, None, None, None, None)
+
+    def drain(x):
+        onp.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0])
+
+    def scan_ms(impl, qkv, grad):
+        """Per-iteration kernel ms via a chained lax.scan; (ms, k, ok)."""
+        q0, kk, vv = qkv
+        if grad:
+            gfn = jax.value_and_grad(
+                lambda q, k, v: impl(q, k, v).sum().astype(jnp.float32),
+                argnums=(0, 1, 2))
+
+            def body(c, _):
+                val, (gq, gk, gv) = gfn(c, kk, vv)
+                dep = (val + gq.astype(jnp.float32).sum()
+                       + gk.astype(jnp.float32).sum()
+                       + gv.astype(jnp.float32).sum()) * 1e-24
+                return c + dep.astype(c.dtype), None
+        else:
+            def body(c, _):
+                out = impl(c, kk, vv)
+                dep = out.astype(jnp.float32).sum() * 1e-24
+                return c + dep.astype(c.dtype), None
+
+        def make(n):
+            @jax.jit
+            def run(c):
+                c, _ = jax.lax.scan(body, c, None, length=n)
+                return c
+            return run
+
+        drain(q0)
+        t_sync = min((lambda t0: (drain(q0),
+                                  time.perf_counter() - t0)[1])(
+            time.perf_counter()) for _ in range(3))
+
+        # size the scan from a k=2 probe (one extra compile, but immune
+        # to wild per-T cost differences: 1 ms at T=1k, ~1 s at 8k fwd)
+        run2 = make(2)
+        drain(run2(q0))  # compile
+        t0 = time.perf_counter()
+        drain(run2(q0))
+        est = max((time.perf_counter() - t0 - t_sync) / 2, 1e-5)
+        # clamp the window to ~12 s of device time so a drift-poisoned
+        # probe estimate cannot produce a minutes-long scan
+        n = int(min(max(6.0 * t_sync / est, 8), 4096, 12.0 / est))
+        n = max(n, 8)
+        for attempt in range(2):
+            run_n = make(n)
+            drain(run_n(q0))  # compile
+            best = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                drain(run_n(q0))
+                best = min(best or 1e9, time.perf_counter() - t0)
+            work = best - t_sync
+            if work >= 2 * t_sync or attempt == 1:
+                break
+            # probe est was too high -> n too small: regrow from the
+            # measured per-iteration work (one extra compile)
+            per = max(work / n, 1e-7)
+            n2 = int(min(max(6.0 * t_sync / per, n * 4), 4096, 12.0 / per))
+            if n2 == n:
+                break  # capped: a recompile would reproduce this scan
+            n = n2
+        # floor at 1 ns/iter: noise can push work <= 0 on a fast backend,
+        # and a 0.0 would divide-by-zero in the tokens/s line
+        return max(work / n, 1e-9) * 1e3, n, work >= 2 * t_sync
 
     rows = []
     for t in (int(x) for x in args.seq_lens.split(",")):
         qkv = [jnp.asarray(onp.random.randn(B, H, t, D), jnp.bfloat16)
                for _ in range(3)]
-
-        for name, impl in (("dense", dense), ("flash", flash)):
-            fn = jax.jit(impl)
-            gn = jax.jit(jax.grad(
-                lambda q, k, v: impl(q, k, v).sum().astype(jnp.float32),
-                argnums=(0, 1, 2)))
-
-            def fwd():
-                return fn(*qkv)
-
-            def fwd_bwd():
-                return gn(*qkv)
-
-            try:
-                for kind, step in (("fwd", fwd), ("fwd_bwd", fwd_bwd)):
-                    for _ in range(WARMUP):
-                        step()
-                    waitall()
-                    t0 = time.perf_counter()
-                    for _ in range(ITERS):
-                        step()
-                    waitall()
-                    ms = (time.perf_counter() - t0) / ITERS * 1e3
+        for kind, grad in (("fwd", False), ("fwd_bwd", True)):
+            if kind not in args.kinds.split(","):
+                continue
+            for name, impl in (("dense", dense), ("flash", flash)):
+                try:
+                    ms, n, ok = scan_ms(impl, qkv, grad)
                     row = {
                         "metric": f"attn_{name}_{kind}_ms",
-                        "seq_len": t, "value": round(ms, 2), "unit": "ms",
+                        "seq_len": t, "value": round(ms, 3), "unit": "ms",
                         "tokens_per_s": round(B * t / (ms / 1e3)),
+                        "scan_len": n, "reliable": ok,
                     }
-                    print(json.dumps(row))
-                    rows.append(row)
-            except Exception as e:
-                row = {"metric": f"attn_{name}_error",
-                       "seq_len": t, "error": str(e)[:120]}
-                print(json.dumps(row))
+                except Exception as e:
+                    row = {"metric": f"attn_{name}_{kind}_error",
+                           "seq_len": t, "error": str(e)[:120]}
+                    if "UNAVAILABLE" in str(e):
+                        # the shared worker crashed; give it time to
+                        # restart so later combos aren't poisoned
+                        time.sleep(90)
+                print(json.dumps(row), flush=True)
                 rows.append(row)
     if args.output:
         with open(args.output, "w") as f:

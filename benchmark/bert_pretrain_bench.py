@@ -32,19 +32,25 @@ ITERS = 30
 PEAK_BF16 = 197e12  # one v5e chip
 
 
-def flops_per_token(n_dense):
+def flops_per_token(n_dense, t):
     # 6 FLOPs per dense weight per token (2 fwd + 4 bwd) + attention
-    # scores/context: 2 matmuls of 2·T·U each, fwd+bwd -> 12·T·U per
+    # scores/context: 2 matmuls of 2·t·U each, fwd+bwd -> 12·t·U per
     # layer per token
-    return 6.0 * n_dense + 12.0 * L * U * T
+    return 6.0 * n_dense + 12.0 * L * U * t
 
 
 def main():
+    global B, T
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--output", default=None)
+    p.add_argument("--batch", type=int, default=B)
+    p.add_argument("--seq", type=int, default=T)
     p.add_argument("--dp", type=int, default=0,
                    help="data-parallel mesh size (multi-host runs)")
     args = p.parse_args()
+    if args.seq > 512:
+        p.error("--seq exceeds the model's max_length=512 position table")
+    B, T = args.batch, args.seq
 
     import mxnet_tpu as mx
     from mxnet_tpu.gluon import FusedTrainStep, Trainer
@@ -81,19 +87,22 @@ def main():
         mesh = pmesh.make_mesh({"dp": args.dp})
     step = FusedTrainStep(mod, trainer, mesh=mesh)
 
+    for _ in range(WARMUP):
+        loss = step(tokens, segments, labels, batch_size=B)
+    loss.wait_to_read()
+    mx.waitall()
+
     # dense-param count for MFU: everything except the embedding tables
     # (their forward is a gather, not a matmul; the TIED mlm vocab
-    # projection is a real U x V matmul and is added back explicitly)
+    # projection is a real U x V matmul and is added back explicitly).
+    # Counted AFTER warmup: deferred shape inference leaves ~75 dense
+    # params shapeless until the first forward materialises them.
     params = model.collect_params()
     n_total = sum(int(onp.prod(p.shape)) for p in params.values())
     n_embed = sum(int(onp.prod(p.shape)) for name, p in params.items()
                   if "embed" in name.lower())
     n_dense = n_total - n_embed + U * V  # + tied vocab projection matmul
-
-    for _ in range(WARMUP):
-        loss = step(tokens, segments, labels, batch_size=B)
-    loss.wait_to_read()
-    mx.waitall()
+    assert n_total > 100e6, f"param shapes not materialised: {n_total}"
 
     windows = []
     for _ in range(3):
@@ -104,7 +113,7 @@ def main():
         windows.append(B * T * ITERS / (time.perf_counter() - t0))
 
     tok_s = max(windows)
-    fpt = flops_per_token(n_dense)
+    fpt = flops_per_token(n_dense, T)
     n_chips = max(args.dp, 1)  # tok_s is the global rate on a dp mesh
     result = {
         "metric": "bert_base_pretrain_bf16_tokens_per_s",

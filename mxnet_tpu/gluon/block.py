@@ -29,7 +29,9 @@ import numpy as onp
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..ndarray.ndarray import NDArray
-from ..ops.invoke import invoke, is_recording, is_training, set_recording, set_training
+from ..ops.invoke import (invoke, is_training, set_recording,
+                          set_training, is_backward_expected,
+                          set_backward_expected)
 from ..ops.aux_scope import aux_update_scope
 from .. import initializer as _initializer
 from .. import random as _rng
@@ -261,7 +263,7 @@ class HybridBlock(Block):
         super().__init__()
         self._active = False
         self._jit_flags = {}
-        self._jit_cache = {}      # training-flag -> jitted functional
+        self._jit_cache = {}      # (training, backward) -> jitted functional
         self._cached_param_list = None
         self._aux_param_holder = []
 
@@ -312,7 +314,7 @@ class HybridBlock(Block):
             set_recording(prev_rec)
 
     # -- the compiled path --------------------------------------------------
-    def _build_functional(self, training):
+    def _build_functional(self, training, backward):
         block = self
         holder = self._aux_param_holder
 
@@ -320,7 +322,8 @@ class HybridBlock(Block):
             # runs only at trace time (jit caches by shape after that)
             out_datas, aux = _scoped_forward(
                 block, block._cached_param_list, param_datas, key,
-                flat_inputs, _TREEDEFS[treedef_id], training)
+                flat_inputs, _TREEDEFS[treedef_id], training,
+                backward=backward)
             holder.clear()
             holder.extend(getattr(a, "_param_ref", None)
                           for a, _v in aux.updates)
@@ -336,10 +339,17 @@ class HybridBlock(Block):
             self._cached_param_list = [params[k] for k in sorted(params)]
         plist = self._cached_param_list
         training = is_training()
-        jit_fn = self._jit_cache.get(training)
+        # a predict-mode tape (autograd.record(train_mode=False)) still
+        # backprops through the cached program: trace-time policy must
+        # know, and the program differs, so it keys the cache too.
+        # is_backward_expected() also carries the flag across an
+        # enclosing trace (which forces recording off) into a nested
+        # active HybridBlock.
+        backward = is_backward_expected()  # ORs in recording + training
+        jit_fn = self._jit_cache.get((training, backward))
         if jit_fn is None:
-            jit_fn = self._build_functional(training)
-            self._jit_cache[training] = jit_fn
+            jit_fn = self._build_functional(training, backward)
+            self._jit_cache[(training, backward)] = jit_fn
 
         flat, treedef = jax.tree_util.tree_flatten(args, is_leaf=_is_nd)
         treedef_id = _intern_treedef(treedef)
@@ -437,11 +447,16 @@ class HybridBlock(Block):
 
 
 def _scoped_forward(block, plist, param_datas, key, flat_inputs, treedef,
-                    training):
+                    training, backward=None):
     """Run ``block.forward`` with parameters overridden by ``param_datas``
     under the shared trace-scope protocol (override scope + key stream +
     aux capture) — used by both the hybridize jit path and `export`.
-    Returns (out_datas, aux)."""
+    Returns (out_datas, aux).
+
+    ``backward`` tells trace-time policy code (e.g. the flash-attention
+    auto crossover) whether a backward pass will run through the traced
+    program — recording is forced off during the trace, so the tape flag
+    cannot carry that information itself.  Defaults to ``training``."""
     mapping = {}
     for p, d in zip(plist, param_datas):
         nd = NDArray(d)
@@ -451,6 +466,8 @@ def _scoped_forward(block, plist, param_datas, key, flat_inputs, treedef,
     args = jax.tree_util.tree_unflatten(treedef, wrapped)
     prev_rec = set_recording(False)
     prev_tr = set_training(training)
+    prev_bwd = set_backward_expected(
+        training if backward is None else backward)
     try:
         with _param_override_scope(mapping), _rng.key_stream_scope(key), \
                 aux_update_scope() as aux:
@@ -458,6 +475,7 @@ def _scoped_forward(block, plist, param_datas, key, flat_inputs, treedef,
     finally:
         set_recording(prev_rec)
         set_training(prev_tr)
+        set_backward_expected(prev_bwd)
     out_datas = jax.tree_util.tree_map(
         lambda o: o._data if _is_nd(o) else o, out, is_leaf=_is_nd)
     return out_datas, aux

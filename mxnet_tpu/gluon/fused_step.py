@@ -146,7 +146,7 @@ class FusedTrainStep:
                     full[k] = tws[j]
                 out_datas, aux = _scoped_forward(
                     block, plist, full, key, flat_inputs,
-                    _TREEDEFS[treedef_id], True)
+                    _TREEDEFS[treedef_id], True, backward=True)
                 holder.clear()
                 holder.extend(getattr(a, "_param_ref", None)
                               for a, _v in aux.updates)

@@ -38,11 +38,17 @@ __all__ = [
     "bert_partition_rules", "bert_base", "bert_large",
 ]
 
-# measured flash-vs-dense crossover on one v5e chip
-# (benchmark/results/attention_tpu_v5e.json, fwd+bwd): dense wins through
-# moderate T, flash wins from here up.  use_flash="auto" switches at this
-# sequence length when masks/attention-dropout allow.
-FLASH_AUTO_MIN_T = 4096
+# measured flash-vs-dense crossovers on one v5e chip with the round-4
+# Pallas kernel (benchmark/results/attention_tpu_v5e.json, discussion in
+# benchmark/ATTENTION_ANALYSIS.md).  Training (fwd+bwd): flash wins from
+# T=1024 up (0.67 vs 0.71 ms at 1024, 2.4 vs 3.8 at 2048, 9.7 vs 15.0
+# at 4096, 38 vs 58 at 8192) and is the only runnable path at T>=12288
+# where dense fails to compile.  Forward-only: XLA's fused dense
+# attention wins at short T (0.12 vs 0.24 ms at 1024), flash from 2048
+# up (0.91 vs 1.13 ms), and dense hits a reproducible HBM cliff at 8192
+# (903 vs 14 ms).
+FLASH_AUTO_MIN_T = 2048           # fwd-only (inference) crossover
+FLASH_AUTO_MIN_T_TRAINING = 1024  # fwd+bwd crossover
 
 
 def _on_tpu():
@@ -103,11 +109,26 @@ class MultiHeadAttention(HybridBlock):
 
     def _flash_now(self, t, mask):
         """Resolve the use_flash policy for this call (T is trace-static,
-        so the choice bakes into the compiled program per shape)."""
+        so the choice bakes into the compiled program per shape).  When a
+        backward pass is coming the LOWER training crossover applies —
+        the flash fwd+bwd kernels beat dense's joint schedule from
+        T=1024 up, while dense's fused forward holds out to T=2048 in
+        forward-only calls (ATTENTION_ANALYSIS.md)."""
         if self._use_flash == "auto":
+            # is_backward_expected covers every backward-bound path:
+            # eager tape (recording), train_mode, FusedTrainStep /
+            # hybridize traces (explicit backward flag — traces force
+            # recording off, so the tape flag can't carry it).  The one
+            # misread is a train_mode() forward-only run (MC-dropout
+            # style) at T in [1024, 4096), which takes flash where dense
+            # fwd is ~2x faster — accepted: both are sub-4 ms, and the
+            # opposite misread would cost real training throughput.
+            from ..ops.invoke import is_backward_expected
+            min_t = (FLASH_AUTO_MIN_T_TRAINING if is_backward_expected()
+                     else FLASH_AUTO_MIN_T)
             return (_on_tpu() and mask is None and
                     self._attn_dropout_rate == 0 and
-                    t >= FLASH_AUTO_MIN_T and
+                    t >= min_t and
                     (t <= 128 or t % 128 == 0))
         return bool(self._use_flash)
 

@@ -59,6 +59,7 @@ class _TapeState(threading.local):
     def __init__(self):
         self.recording = False
         self.training = False
+        self.backward_expected = False
 
 
 _state = _TapeState()
@@ -81,6 +82,23 @@ def set_recording(flag):
 def set_training(flag):
     prev = _state.training
     _state.training = bool(flag)
+    return prev
+
+
+def is_backward_expected():
+    """True when the current code is running (or tracing) ahead of a
+    backward pass: an eager tape is recording, train-mode is on, or a
+    compiled trace declared it explicitly (`_scoped_forward(backward=)`).
+    Trace-time policy code (flash-attention crossover) keys on this —
+    `is_recording()` alone is useless there because traces force
+    recording off."""
+    return (_state.backward_expected or _state.recording or
+            _state.training)
+
+
+def set_backward_expected(flag):
+    prev = _state.backward_expected
+    _state.backward_expected = bool(flag)
     return prev
 
 

@@ -9,6 +9,26 @@ an online-softmax accumulator (single-chip analogue of
 `parallel/ring_attention.py`, which does the same blockwise math across
 chips).
 
+Design notes (benchmark/ATTENTION_ANALYSIS.md has the measurements):
+
+- **Blocks auto-size to 512** (largest power-of-two divisor of T from a
+  512 target).  The round-3 kernel used 128x128 blocks: at T=8192 that
+  is ~131k grid invocations of tiny matmuls, and Mosaic's per-iteration
+  overhead alone (~1 us) explained the whole measured 115 ms.
+- **Dots run in the input dtype** (bf16 in production) with f32
+  accumulation via `preferred_element_type` — upcasting q/k/v to f32
+  *before* the dot quarters the MXU rate.  Tests feed f32 and stay
+  bit-comparable to the dense oracle.
+- **Every dot is the standard (m,k)x(k,n) contraction.**  Transposed
+  operands are pre-transposed OUTSIDE the kernel (an XLA copy, trivial
+  next to the attention FLOPs): Mosaic's lowering of the
+  transposed-contraction forms onto large bf16 tiles raised
+  "Bad lhs type" on this toolchain (tpu.matmul on a 512x128 bf16 tile
+  with dimension_numbers [1],[1]).
+- **The backward is two Pallas kernels** (dq; dk+dv) using the saved
+  output and the log-sum-exp from the forward — the flash recompute
+  strategy, memory O(T * block) in both directions.
+
 Kernels run in interpret mode off-TPU, so they are testable on the CPU
 mesh against dense oracles.
 """
@@ -26,10 +46,59 @@ from .invoke import invoke
 __all__ = ["flash_attention"]
 
 _NEG_INF = -1e30
+_BLOCK_TARGET = 512
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  scale, causal, block_q, block_k, nk):
+def _prec(dt):
+    """Matmul precision for kernel dots.  The package sets the ambient
+    `jax_default_matmul_precision` to float32 (true-f32 reference
+    semantics for f32 ops) — but a bf16 dot with fp32 contract precision
+    fails Mosaic lowering here ("Bad lhs type" on the tpu.matmul), and
+    the native MXU bf16-multiply/f32-accumulate path needs DEFAULT.
+    f32 inputs keep HIGHEST so the f32 kernel stays true-f32."""
+    return (jax.lax.Precision.DEFAULT if dt == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+
+
+def _pick_block(t, want):
+    """Largest power-of-two block <= want dividing t (>=128 when t allows,
+    else t itself for tiny sequences)."""
+    if t <= want:
+        return t
+    b = want
+    while b >= 128:
+        if t % b == 0:
+            return b
+        b //= 2
+    return t  # no pow2 divisor >=128: degenerate, single block
+
+
+def _causal_mask(s, qi, ki, block_q, block_k, transposed=False):
+    """Mask s (q-major), or s^T when ``transposed`` (k-major rows)."""
+    q_ax, k_ax = (1, 0) if transposed else (0, 1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, q_ax)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, k_ax)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _resolve(t, d, block_q, block_k, scale, interpret):
+    bq = _pick_block(t, _BLOCK_TARGET) if block_q is None else min(block_q, t)
+    bk = _pick_block(t, _BLOCK_TARGET) if block_k is None else min(block_k, t)
+    if t % bq or t % bk:
+        raise ValueError(
+            f"block sizes ({bq}, {bk}) must divide sequence length {t}; "
+            "pad and mask upstream")
+    sc = d ** -0.5 if scale is None else scale
+    interp = (jax.default_backend() != "tpu") if interpret is None \
+        else interpret
+    return bq, bk, sc, interp
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, scale, causal, block_q, block_k, nk):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -39,151 +108,274 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0].astype(jnp.float32)          # (block_q, D)
-    k = k_ref[0].astype(jnp.float32)          # (block_k, D)
-    v = v_ref[0].astype(jnp.float32)
+    q = q_ref[0]                               # (block_q, D), input dtype
+    kt = kt_ref[0]                             # (D, block_k)
+    v = v_ref[0]                               # (block_k, D)
 
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=_prec(q.dtype)) * scale
     if causal:
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        s = _causal_mask(s, qi, ki, block_q, block_k)
 
     m_prev = m_ref[...]                        # (block_q, 1)
     m_cur = jnp.max(s, axis=1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new)                     # (block_q, block_k)
+    p = jnp.exp(s - m_new)                     # (block_q, block_k) f32
     alpha = jnp.exp(m_prev - m_new)            # rescale of old mass
     l_ref[...] = l_ref[...] * alpha + p.sum(axis=1, keepdims=True)
     acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=_prec(v.dtype))
     m_ref[...] = m_new
 
     @pl.when(ki == nk - 1)
     def _finish():
-        o_ref[0] = (acc_ref[...] /
-                    jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l)     # (block_q, 1)
 
 
 def _flash_forward(qd, kd, vd, causal, scale, block_q, block_k, interpret):
     b, h, t, d = qd.shape
-    bq = min(block_q, t)
-    bk = min(block_k, t)
-    if t % bq or t % bk:
-        raise ValueError(
-            f"block sizes ({bq}, {bk}) must divide sequence length {t}; "
-            "pad and mask upstream")
+    bq, bk, sc, interp = _resolve(t, d, block_q, block_k, scale, interpret)
     nk = t // bk
-    sc = d ** -0.5 if scale is None else scale
-    interp = (jax.default_backend() != "tpu") if interpret is None \
-        else interpret
 
     qr = qd.reshape(b * h, t, d)
-    kr = kd.reshape(b * h, t, d)
+    ktr = kd.reshape(b * h, t, d).swapaxes(1, 2)   # (bh, D, T)
     vr = vd.reshape(b * h, t, d)
     kernel = functools.partial(
-        _flash_kernel, scale=sc, causal=causal, block_q=bq, block_k=bk,
-        nk=nk)
-    out = pl.pallas_call(
+        _fwd_kernel, scale=sc, causal=causal, block_q=bq, block_k=bk, nk=nk)
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // bq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, 0, ki)),
             pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), qd.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            # (bh, t, 1) layout: Mosaic requires the last two block dims
+            # be (multiple-of-8, multiple-of-128) or span the array, so a
+            # 2-D (1, bq) lse block is unlowereable; a trailing unit lane
+            # dim satisfies it (padded to one lane tile in VMEM)
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), qd.dtype),
+            jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),   # running max
             pltpu.VMEM((bq, 1), jnp.float32),   # running sum
             pltpu.VMEM((bq, d), jnp.float32),   # output accumulator
         ],
         interpret=interp,
-    )(qr, kr, vr)
-    return out.reshape(b, h, t, d)
+    )(qr, ktr, vr)
+    return out.reshape(b, h, t, d), lse.reshape(b, h, t)
 
 
-def _blockwise_reference(qd, kd, vd, causal, scale, block_k):
-    """Pure-jnp blockwise attention (lax.scan over K/V blocks with online
-    softmax) — numerically identical to the kernel, used to derive the
-    backward pass (flash recompute strategy: trade FLOPs for never
-    materializing the (T, T) score matrix)."""
+# ---------------------------------------------------------------------------
+# backward.  Standard flash backward:
+#   p  = exp(s*scale - lse);  dv = p^T do;  dp = do v^T
+#   ds = p * (dp - delta) * scale   with delta = rowsum(do * o)
+#   dq = ds k;  dk = ds^T q
+# The dq kernel streams K/V blocks past each q block; the dkv kernel
+# streams q/do blocks past each k block working in transposed (k-major)
+# score space so every dot stays standard-form.
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, kt_ref, k_ref, vt_ref, do_ref, lse_ref, dl_ref,
+                   dq_ref, acc_ref, *, scale, causal, block_q, block_k, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]                               # (block_q, D)
+    kt = kt_ref[0]                             # (D, block_k)
+    k = k_ref[0]                               # (block_k, D)
+    vt = vt_ref[0]                             # (D, block_k)
+    do = do_ref[0]                             # (block_q, D)
+    lse = lse_ref[0]                           # (block_q, 1) f32
+    delta = dl_ref[0]                          # (block_q, 1) f32
+
+    s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=_prec(q.dtype)) * scale
+    if causal:
+        s = _causal_mask(s, qi, ki, block_q, block_k)
+    p = jnp.exp(s - lse)                       # (block_q, block_k) f32
+    dp = jax.lax.dot_general(do, vt, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=_prec(do.dtype))
+    ds = p * (dp - delta) * scale
+    acc_ref[...] += jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=_prec(k.dtype))
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(qt_ref, q_ref, k_ref, v_ref, dot_ref, do_ref, lse_ref,
+                    dl_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, block_q, block_k, nq):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    qt = qt_ref[0]                             # (D, block_q)
+    q = q_ref[0]                               # (block_q, D)
+    k = k_ref[0]                               # (block_k, D)
+    v = v_ref[0]                               # (block_k, D)
+    dot_ = dot_ref[0]                          # (D, block_q)  = do^T
+    do = do_ref[0]                             # (block_q, D)
+    lse = lse_ref[0]                           # (1, block_q) f32
+    delta = dl_ref[0]                          # (1, block_q) f32
+
+    # k-major (transposed) score space: st[kb, qb] = s[qb, kb]
+    st = jax.lax.dot_general(k, qt, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32,
+                             precision=_prec(k.dtype)) * scale
+    if causal:
+        st = _causal_mask(st, qi, ki, block_q, block_k, transposed=True)
+    pt = jnp.exp(st - lse)                     # (block_k, block_q)
+    dv_acc[...] += jax.lax.dot_general(pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=_prec(do.dtype))
+    dpt = jax.lax.dot_general(v, dot_, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32,
+                              precision=_prec(v.dtype))
+    dst = pt * (dpt - delta) * scale
+    dk_acc[...] += jax.lax.dot_general(dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=_prec(q.dtype))
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(qd, kd, vd, out, lse, ct, causal, scale, block_q,
+                    block_k, interpret):
     b, h, t, d = qd.shape
-    bk = min(block_k, t)
-    nk = t // bk
-    sc = d ** -0.5 if scale is None else scale
-    q32 = qd.astype(jnp.float32)
-    kb = kd.astype(jnp.float32).reshape(b, h, nk, bk, d)
-    vb = vd.astype(jnp.float32).reshape(b, h, nk, bk, d)
-    q_pos = jnp.arange(t)
+    bq, bk, sc, interp = _resolve(t, d, block_q, block_k, scale, interpret)
+    nq, nk = t // bq, t // bk
 
-    # checkpoint each block step: differentiating the scan must NOT store
-    # per-step (T, block) probability residuals — recompute keeps backward
-    # memory at O(T * block), the whole point of the kernel
-    @jax.checkpoint
-    def step(carry, i):
-        m, l, acc = carry
-        s = jnp.einsum("bhqd,bhkd->bhqk", q32, kb[:, :, i]) * sc
-        if causal:
-            k_pos = i * bk + jnp.arange(bk)
-            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(-1))
-        p = jnp.exp(s - m_new[..., None])
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + p.sum(-1)
-        acc = acc * alpha[..., None] + \
-            jnp.einsum("bhqk,bhkd->bhqd", p, vb[:, :, i])
-        return (m_new, l, acc), None
+    # delta = rowsum(dO * O): cheap elementwise, XLA fuses it
+    delta = (ct.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
 
-    m0 = jnp.full((b, h, t), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, t), jnp.float32)
-    acc0 = jnp.zeros((b, h, t, d), jnp.float32)
-    (m, l, acc), _ = jax.lax.scan(step, (m0, l0, acc0), jnp.arange(nk))
-    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(qd.dtype)
+    qr = qd.reshape(b * h, t, d)
+    kr = kd.reshape(b * h, t, d)
+    vr = vd.reshape(b * h, t, d)
+    dor = ct.reshape(b * h, t, d)
+    qtr = qr.swapaxes(1, 2)                    # (bh, D, T)
+    ktr = kr.swapaxes(1, 2)
+    vtr = vr.swapaxes(1, 2)
+    dotr = dor.swapaxes(1, 2)
+    lser = lse.reshape(b * h, t, 1)
+    dltr = delta.reshape(b * h, t, 1)
+    lse_row = lse.reshape(b * h, 1, t)         # k-major kernels broadcast
+    dlt_row = delta.reshape(b * h, 1, t)       # over score ROWS
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=sc, causal=causal,
+                          block_q=bq, block_k=bk, nk=nk),
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, 0, ki)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, 0, ki)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), qd.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interp,
+    )(qr, ktr, kr, vtr, dor, lser, dltr)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=sc, causal=causal,
+                          block_q=bq, block_k=bk, nq=nq),
+        grid=(b * h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, d, bq), lambda bh, ki, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, d, bq), lambda bh, ki, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), kd.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), vd.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interp,
+    )(qtr, qr, kr, vr, dotr, dor, lse_row, dlt_row)
+
+    return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
+            dv.reshape(b, h, t, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(qd, kd, vd, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(qd, kd, vd, causal, scale, block_q, block_k,
-                          interpret)
+    out, _lse = _flash_forward(qd, kd, vd, causal, scale, block_q, block_k,
+                               interpret)
+    return out
 
 
 def _flash_fwd(qd, kd, vd, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(qd, kd, vd, causal, scale, block_q, block_k,
-                         interpret)
-    return out, (qd, kd, vd)
+    out, lse = _flash_forward(qd, kd, vd, causal, scale, block_q, block_k,
+                              interpret)
+    return out, (qd, kd, vd, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, ct):
-    qd, kd, vd = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _blockwise_reference(q, k, v, causal, scale,
-                                             block_k), qd, kd, vd)
-    return vjp(ct)
+    qd, kd, vd, out, lse = res
+    return _flash_backward(qd, kd, vd, out, lse, ct, causal, scale,
+                           block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=None):
     """Blockwise (flash) attention: q/k/v (B, H, T, D) -> (B, H, T, D).
 
-    Exact attention; the full score matrix is never materialized.  T must
-    be divisible by the block sizes (pad and mask upstream otherwise —
-    same contract as the reference's fused kernels).  The backward pass
-    recomputes blockwise (flash strategy), so memory stays O(T * block).
+    Exact attention; the full score matrix is never materialized, in
+    forward or backward (both are Pallas kernels streaming K/V blocks —
+    memory stays O(T * block) against dense's O(T^2)).  Block sizes
+    default to the largest power-of-two divisor of T up to 512; T must
+    be divisible by the blocks (pad and mask upstream otherwise — same
+    contract as the reference's fused kernels).
 
-    Validated exact on real TPU (vs XLA dense, ~3e-8).  When the (T, T)
-    score matrix FITS in HBM, plain XLA attention is faster — XLA's own
-    fusion is excellent at moderate T; use this kernel when T is large
-    enough that materializing scores is the wall, and
-    `parallel.ring_attention` when the sequence is sharded across chips.
-    Block sizes beyond the defaults can exceed the 16MB VMEM scoped limit.
+    Validated exact on real TPU (vs XLA dense).  When the (T, T) score
+    matrix FITS in HBM comfortably, plain XLA attention is still faster
+    — use this kernel at the measured crossovers
+    (`models/transformer.FLASH_AUTO_MIN_T*`,
+    benchmark/ATTENTION_ANALYSIS.md) and `parallel.ring_attention` when
+    the sequence is sharded across chips.
     """
     from ..ndarray.ndarray import NDArray
 

@@ -166,7 +166,7 @@ def test_amp_hybridized_resnet_block_hlo_dtypes():
         with mx.autograd.record():
             net(x)  # training-mode trace: BN computes batch statistics
 
-        jit_fn = net._jit_cache[True]
+        jit_fn = net._jit_cache[(True, True)]
         plist = net._cached_param_list
         param_datas = [p.data()._data for p in plist]
         key = jax.random.key(0)

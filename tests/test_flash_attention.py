@@ -117,6 +117,49 @@ def test_mha_auto_flash_policy(monkeypatch):
     assert not dropped._flash_now(tr.FLASH_AUTO_MIN_T, None)
     forced = tr.MultiHeadAttention(64, 4, use_flash=False)
     assert not forced._flash_now(tr.FLASH_AUTO_MIN_T, None)
+    # under an active tape the (lower) training crossover applies: the
+    # flash fwd+bwd kernels beat dense from FLASH_AUTO_MIN_T_TRAINING up
+    from mxnet_tpu import autograd
+    t_train = tr.FLASH_AUTO_MIN_T_TRAINING
+    assert t_train < tr.FLASH_AUTO_MIN_T  # measured relationship
+    assert not mha._flash_now(t_train, None)  # no tape: inference tier
+    with autograd.record():
+        assert mha._flash_now(t_train, None)
+        assert not mha._flash_now(t_train - 128, None)
+    # predict-mode gradients (record(train_mode=False)) still backprop
+    with autograd.record(train_mode=False):
+        assert mha._flash_now(t_train, None)
+    # compiled traces force recording off and declare the backward
+    # explicitly (_scoped_forward(backward=True))
+    from mxnet_tpu.ops.invoke import set_backward_expected
+    prev = set_backward_expected(True)
+    try:
+        assert mha._flash_now(t_train, None)
+    finally:
+        set_backward_expected(prev)
+    assert not mha._flash_now(t_train, None)
     import pytest as _pt
     with _pt.raises(ValueError, match="use_flash"):
         tr.MultiHeadAttention(64, 4, use_flash=1)
+
+
+def test_hybridize_jit_cache_keys_on_backward():
+    """A predict-mode tape around a hybridized call must compile its own
+    program (the flash policy differs), not reuse the inference one."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.models import transformer as tr
+
+    mha = tr.MultiHeadAttention(16, 2, dropout=0.0)
+    mha.initialize()
+    x = mx.np.ones((1, 8, 16))
+    mha.hybridize()
+    mha(x)                                    # inference trace
+    assert (False, False) in mha._jit_cache
+    x2 = mx.np.ones((1, 8, 16))
+    x2.attach_grad()
+    with autograd.record(train_mode=False):   # predict-mode gradients
+        out = mha(x2)
+    out.backward()
+    assert (False, True) in mha._jit_cache
+    assert x2.grad is not None
