@@ -50,6 +50,10 @@ def main():
     p.add_argument("--use-flash", default="auto",
                    choices=("auto", "true", "false"),
                    help="auto (measured crossovers) | true | false")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialization boundary around each encoder "
+                        "layer (npx.remat): backward recomputes "
+                        "activations, memory O(layers) -> O(1)")
     args = p.parse_args()
     B, T = args.batch, args.seq
 
@@ -67,7 +71,7 @@ def main():
     model = BertForPretraining(vocab_size=V, units=U, hidden_size=3072,
                                num_layers=L, num_heads=12,
                                max_length=max(512, T), dropout=drop,
-                               use_flash=use_flash)
+                               use_flash=use_flash, remat=args.remat)
     model.initialize()
     model.cast("bfloat16")
 
@@ -128,6 +132,7 @@ def main():
         "value": round(tok_s, 0),
         "unit": "tokens/s",
         "use_flash": args.use_flash,
+        "remat": args.remat,
         "dropout": drop,
         "batch": B, "seq_len": T,
         "window_tokens_per_s": [round(w) for w in windows],

@@ -207,9 +207,15 @@ class TransformerEncoderLayer(HybridBlock):
 class TransformerEncoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads,
                  dropout=0.0, layer_norm_eps=1e-12, dtype="float32",
-                 use_flash="auto"):
+                 use_flash="auto", remat=False):
         super().__init__()
         self._num_layers = num_layers
+        # remat=True puts a rematerialization boundary around every layer
+        # (npx.remat / jax.checkpoint): backward recomputes each layer's
+        # activations from its input instead of saving them — memory per
+        # layer drops from O(B*T*(U+FFN+heads*T_score)) to O(B*T*U), the
+        # long-context lever that pairs with use_flash
+        self._remat = remat
         for i in range(num_layers):
             setattr(self, f"layer{i}",
                     TransformerEncoderLayer(units, hidden_size, num_heads,
@@ -220,7 +226,11 @@ class TransformerEncoder(HybridBlock):
 
     def forward(self, x, mask=None):
         for i in range(self._num_layers):
-            x = getattr(self, f"layer{i}")(x, mask)
+            layer = getattr(self, f"layer{i}")
+            if self._remat:
+                x = npx.remat(layer)(x, mask)
+            else:
+                x = layer(x, mask)
         return x
 
 
@@ -231,7 +241,7 @@ class BertModel(HybridBlock):
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
                  num_segments=2, dropout=0.1, layer_norm_eps=1e-12,
-                 dtype="float32", use_flash="auto"):
+                 dtype="float32", use_flash="auto", remat=False):
         super().__init__()
         self._units = units
         init_std = init.Normal(0.02)
@@ -248,7 +258,8 @@ class BertModel(HybridBlock):
         self.encoder = TransformerEncoder(num_layers, units, hidden_size,
                                           num_heads, dropout=dropout,
                                           layer_norm_eps=layer_norm_eps,
-                                          dtype=dtype, use_flash=use_flash)
+                                          dtype=dtype, use_flash=use_flash,
+                                          remat=remat)
         self.pooler = nn.Dense(units, flatten=False, activation="tanh",
                                weight_initializer=init_std, dtype=dtype)
 

@@ -31,7 +31,7 @@ __all__ = [
     "reshape_like", "arange_like", "gamma", "gamma_fn", "gelu", "gammaln", "erf", "erfinv",
     "adaptive_avg_pool2d", "l2_normalization", "waitall", "cpu", "gpu", "tpu",
     "num_gpus", "num_tpus", "current_context", "save", "load", "seed",
-    "foreach", "while_loop", "cond", "flash_attention",
+    "foreach", "while_loop", "cond", "flash_attention", "remat",
     "gather_nd", "scatter_nd", "broadcast_like", "slice_like", "khatri_rao",
     "ravel_multi_index", "unravel_index", "make_loss", "multi_all_finite",
     "reset_arrays", "grid_generator", "bilinear_sampler",
@@ -137,6 +137,154 @@ def flash_attention(*args, **kwargs):
     `ops/pallas_kernels.py`)."""
     from ..ops.pallas_kernels import flash_attention as _fa
     return _fa(*args, **kwargs)
+
+
+def remat(fn):
+    """Rematerialization boundary (TPU-native; no reference analogue —
+    the reference trades memory for recompute only via its nnvm mirror
+    pass, `src/nnvm/gradient.cc:699`).  Wraps an NDArray-function (or a
+    Block) so that, under a compiled trace (hybridize / FusedTrainStep),
+    its intermediates are NOT saved for backward but recomputed from the
+    boundary's inputs — `jax.checkpoint` semantics, the standard
+    long-context memory lever.  Closed-over parameters are saved as
+    residuals (not recomputed), and RNG draws replay deterministically
+    (the mask a recomputed dropout applies is bit-identical).
+
+    Usage: ``x = npx.remat(layer)(x)`` or build transformer stacks with
+    ``remat=True``.
+
+    When ``fn`` is a Block, its parameters are routed through the
+    boundary as EXPLICIT differentiable inputs (an inner parameter
+    override scope, the hybridize-trace mechanism): the eager autograd
+    tape sees them and their gradients flow.  Auxiliary-state updates
+    (BatchNorm moving stats) are captured inside the boundary and
+    re-applied outside it — eagerly, or deferred to the enclosing trace
+    scope, exactly as `gluon/block.py:_scoped_forward` chains them.
+    A plain closure is differentiated only w.r.t. its array arguments —
+    under ``autograd.record()`` gradients would silently not reach
+    closed-over parameters, so that combination warns.
+
+    The wrapper is cached on ``fn``, so repeated ``npx.remat(layer)``
+    calls (TransformerEncoder does one per forward) reuse one closure —
+    keeping `invoke`'s cached-executable fast path eligible on the
+    eager tape instead of re-tracing the subgraph every step.
+    """
+    cached = getattr(fn, "_npx_remat_wrapped", None)
+    if cached is not None:
+        return cached
+
+    import warnings
+
+    from ..ndarray.ndarray import NDArray
+    from ..ops.control_flow import _wrap, _raw
+    from ..ops.invoke import (set_recording, set_training,
+                              set_backward_expected, is_backward_expected)
+    from ..ops.aux_scope import aux_update_scope
+
+    state = {"params": None}
+    raw_cache = {}    # (training, backward) -> (jitted raw, aux_holder)
+
+    def _make_raw(training, backward):
+        """One jitted boundary per mode: dropout/BN train-vs-eval and
+        the flash crossover are trace-time decisions, so sharing one
+        cache across modes would freeze the first-seen mode into every
+        call (the same reason HybridBlock keys _jit_cache on mode).
+        Each call also takes a FRESH PRNG key so dropout masks differ
+        per step instead of baking the trace-time key as a constant."""
+        from ..gluon.parameter import _param_override_scope
+
+        aux_holder = []   # Parameter targets, captured at trace time;
+                          # per mode: an eval trace captures NO updates
+                          # and must not clobber the train list
+
+        def raw(key, pd_, a_, kw_):
+            @jax.checkpoint
+            def inner(key2, pd2, a2, kw2):
+                mapping = {}
+                for p, d in zip(state["params"], pd2):
+                    nd = NDArray(d)
+                    nd._param_ref = p
+                    mapping[id(p)] = nd
+                aw, kww = _wrap((a2, kw2))
+                prev_tr = set_training(training)
+                prev_bwd = set_backward_expected(backward)
+                try:
+                    with _param_override_scope(mapping), \
+                            _rng.key_stream_scope(key2), \
+                            aux_update_scope() as aux:
+                        out = fn(*aw, **kww)
+                finally:
+                    set_training(prev_tr)
+                    set_backward_expected(prev_bwd)
+                aux_holder.clear()
+                aux_holder.extend(getattr(a, "_param_ref", None)
+                                  for a, _v in aux.updates)
+                aux_datas = [v._data if isinstance(v, NDArray) else v
+                             for _a, v in aux.updates]
+                return _raw(out), aux_datas
+            return inner(key, pd_, a_, kw_)
+        # jitted: on the eager tape, invoke's lazy cached-executable path
+        # (ops/invoke.py) needs a jax.stages.Wrapped with stable identity
+        # — otherwise every training step re-traces the whole subgraph
+        return jax.jit(raw), aux_holder
+
+    def wrapped(*args, **kwargs):
+        from ..ops.aux_scope import apply_aux_update
+
+        params = state["params"]
+        if params is None:
+            if hasattr(fn, "collect_params"):
+                pd = fn.collect_params()
+                # deferred shapes must materialize OUTSIDE the boundary's
+                # trace (fresh param buffers inside it would leak as
+                # tracers); training is forced off so the probe forward
+                # does not double-apply BN moving stats or burn RNG draws
+                if any(p._deferred_init is not None for p in pd.values()):
+                    prev = set_recording(False)
+                    prev_tr = set_training(False)
+                    try:
+                        fn(*args, **kwargs)
+                    finally:
+                        set_recording(prev)
+                        set_training(prev_tr)
+                    pd = fn.collect_params()
+                params = [pd[k] for k in sorted(pd)]
+            else:
+                params = []
+                if is_recording():
+                    warnings.warn(
+                        "npx.remat over a non-Block callable under "
+                        "autograd.record(): gradients will not flow to "
+                        "parameters closed over by the callable — wrap "
+                        "the Block itself", stacklevel=2)
+            # collect_params + sort walked once, not per step (a 24-layer
+            # remat stack would otherwise rewalk every subtree each step)
+            state["params"] = params
+        pdatas = [p.data() for p in params]
+
+        mode = (is_training(), is_backward_expected())
+        hit = raw_cache.get(mode)
+        if hit is None:
+            hit = raw_cache[mode] = _make_raw(*mode)
+        raw, aux_holder = hit
+        key = _rng.new_key()
+        out, aux_vals = invoke(raw, (key, pdatas, args, kwargs),
+                               name="remat")
+        for p, v in zip(aux_holder, aux_vals):
+            if p is not None:
+                tgt = p.data()
+                # tag the target so an ENCLOSING trace scope (hybridize
+                # around this boundary) can resolve it back to the
+                # Parameter when it applies its deferred updates
+                tgt._param_ref = p
+                apply_aux_update(tgt, v)
+        return out
+
+    try:
+        fn._npx_remat_wrapped = wrapped
+    except AttributeError:
+        pass
+    return wrapped
 
 
 def gelu(data, approximation="erf"):

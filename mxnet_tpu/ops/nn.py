@@ -293,9 +293,15 @@ _bn_train_core.defvjp(_bn_train_fwd_rule, _bn_train_bwd)
 
 def batch_norm_train(data, gamma, beta, momentum, eps, axis, moving_mean,
                      moving_var):
-    """Returns (out, new_moving_mean, new_moving_var)."""
+    """Returns (out, new_moving_mean, new_moving_var).
+
+    ``axis`` is canonicalized here: the reduction-axes comprehension in
+    `_bn_train_fwd`/`_bn_train_bwd` compares indices literally, and a
+    negative axis would silently reduce over EVERY axis (global instead
+    of per-channel statistics) and then crash the backward on a scalar
+    residual."""
     return _bn_train_core(data, gamma, beta, moving_mean, moving_var,
-                          momentum, eps, axis)
+                          momentum, eps, axis % data.ndim)
 
 
 def batch_norm_inference(data, gamma, beta, moving_mean, moving_var, eps, axis):

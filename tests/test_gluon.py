@@ -102,6 +102,25 @@ def test_batchnorm_train_eval():
     assert out_eval.shape == x.shape
 
 
+def test_batchnorm_negative_axis_per_channel_stats():
+    """axis=-1 must normalize per channel, not globally: the reduction
+    comprehension compared raw indices, so a negative axis silently
+    reduced over EVERY axis (wrong statistics) and crashed backward on
+    the scalar residual (round-4 regression, found via npx.remat)."""
+    bn = nn.BatchNorm(axis=-1, in_channels=8)
+    bn.initialize()
+    x = mx.np.array(onp.random.randn(4, 8).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = bn(x)
+        loss = y.sum()
+    loss.backward()
+    xa = x.asnumpy()
+    ref = (xa - xa.mean(0)) / onp.sqrt(xa.var(0) + 1e-5)
+    assert onp.abs(y.asnumpy() - ref).max() < 1e-5
+    assert onp.isfinite(x.grad.asnumpy()).all()
+
+
 def test_dropout_train_vs_eval():
     do = nn.Dropout(0.5)
     x = mx.np.ones((100,))
