@@ -471,6 +471,28 @@ def main():
                 result[k] = ab[k]
         except Exception as e:
             result["ab_error"] = str(e)[:200]
+    # transformer rider (r3 verdict #2): BERT-base pretraining tokens/s +
+    # MFU in the same artifact line.  Subprocess-isolated like the other
+    # riders; BENCH_BERT_TIMEOUT=0 skips it.
+    bert_timeout = float(os.environ.get("BENCH_BERT_TIMEOUT", "600"))
+    if bert_timeout > 0:
+        try:
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "benchmark", "bert_pretrain_bench.py")],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                timeout=bert_timeout)
+            rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+                    if l.startswith("{")]
+            if proc.returncode != 0 or not rows:
+                raise RuntimeError(
+                    f"bert rider rc={proc.returncode}: "
+                    f"{proc.stderr.strip()[-160:]}")
+            result["bert_tokens_per_s"] = rows[0]["value"]
+            result["bert_mfu_vs_197tf_bf16"] = rows[0]["mfu_vs_197tf_bf16"]
+        except Exception as e:
+            result["bert_error"] = str(e)[:200]
     print(json.dumps(result))
 
 

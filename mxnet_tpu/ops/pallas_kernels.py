@@ -43,7 +43,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .invoke import invoke
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _NEG_INF = -1e30
 _BLOCK_TARGET = 512
@@ -79,6 +79,16 @@ def _causal_mask(s, qi, ki, block_q, block_k, transposed=False):
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, q_ax)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, k_ax)
     return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct matching ``like``'s mesh-axis variance: under
+    shard_map (ring attention) `check_vma` requires pallas outputs to
+    declare how they vary across mesh axes."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _resolve(t, d, block_q, block_k, scale, interpret):
@@ -163,8 +173,8 @@ def _flash_forward(qd, kd, vd, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), qd.dtype),
-            jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32),
+            _sds((b * h, t, d), qd.dtype, qr),
+            _sds((b * h, t, 1), jnp.float32, qr),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),   # running max
@@ -266,13 +276,17 @@ def _bwd_dkv_kernel(qt_ref, q_ref, k_ref, v_ref, dot_ref, do_ref, lse_ref,
 
 
 def _flash_backward(qd, kd, vd, out, lse, ct, causal, scale, block_q,
-                    block_k, interpret):
+                    block_k, interpret, dlse=None):
     b, h, t, d = qd.shape
     bq, bk, sc, interp = _resolve(t, d, block_q, block_k, scale, interpret)
     nq, nk = t // bq, t // bk
 
-    # delta = rowsum(dO * O): cheap elementwise, XLA fuses it
+    # delta = rowsum(dO * O): cheap elementwise, XLA fuses it.  A
+    # cotangent on the log-sum-exp output folds in here: d s_ij picks up
+    # + p_ij * dlse_i, and ds = p * (dp - (delta - dlse)) absorbs it.
     delta = (ct.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     qr = qd.reshape(b * h, t, d)
     kr = kd.reshape(b * h, t, d)
@@ -301,7 +315,7 @@ def _flash_backward(qd, kd, vd, out, lse, ct, causal, scale, block_q,
             pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), qd.dtype),
+        out_shape=_sds((b * h, t, d), qd.dtype, qr),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interp,
     )(qr, ktr, kr, vtr, dor, lser, dltr)
@@ -325,8 +339,8 @@ def _flash_backward(qd, kd, vd, out, lse, ct, causal, scale, block_q,
             pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, t, d), kd.dtype),
-            jax.ShapeDtypeStruct((b * h, t, d), vd.dtype),
+            _sds((b * h, t, d), kd.dtype, qr),
+            _sds((b * h, t, d), vd.dtype, qr),
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
@@ -357,6 +371,40 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, ct):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(qd, kd, vd, causal, scale, block_q, block_k, interpret):
+    """Flash attention returning (out, lse) — the log-sum-exp output is
+    what lets independently-computed attention partials merge exactly
+    (ring attention's per-ring-step building block)."""
+    return _flash_forward(qd, kd, vd, causal, scale, block_q, block_k,
+                          interpret)
+
+
+def _flash_lse_fwd(qd, kd, vd, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_forward(qd, kd, vd, causal, scale, block_q, block_k,
+                              interpret)
+    return (out, lse), (qd, kd, vd, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, cts):
+    qd, kd, vd, out, lse = res
+    ct, dlse = cts
+    return _flash_backward(qd, kd, vd, out, lse, ct, causal, scale,
+                           block_q, block_k, interpret, dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, scale=None,
+                             block_q=None, block_k=None, interpret=None):
+    """`flash_attention` that also returns the per-query log-sum-exp
+    (B, H, T) in f32.  Partials over disjoint K/V shards merge exactly:
+    ``lse = logaddexp(lse_a, lse_b); out = out_a*exp(lse_a-lse) +
+    out_b*exp(lse_b-lse)`` — see `parallel/ring_attention.py`."""
+    return _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,

@@ -23,12 +23,20 @@ __all__ = ["ring_attention", "ring_attention_local"]
 
 
 def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
-                         extra_vary_axes=()):
+                         extra_vary_axes=(), use_flash=False):
     """Per-shard body (runs under shard_map).
 
     q/k/v: (B, H, T_local, D) — the local sequence block.  Returns the exact
     attention output for the local queries against the *global* key/value
     sequence.
+
+    With ``use_flash`` the per-ring-step block attention runs through the
+    Pallas flash kernel (`ops/pallas_kernels.flash_attention_with_lse`)
+    instead of a dense einsum: each step produces an exact (out, lse)
+    partial for the resident K/V block, merged across ring steps with
+    log-sum-exp arithmetic — per-chip memory stays O(T_local * block)
+    even while T_local is long, compounding the kernel-level crossovers
+    (benchmark/ATTENTION_ANALYSIS.md) with the ICI ring.
     """
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -36,6 +44,14 @@ def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
     t_k = k.shape[2]
     if scale is None:
         scale = d ** -0.5
+
+    if use_flash:
+        # NOTE for direct callers (outside the `ring_attention` entry
+        # point): the pallas interpret-mode internals are invisible to
+        # shard_map's variance checker — wrap with check_vma=False, as
+        # ring_attention does
+        return _ring_flash(q, k, v, axis_name, axis_size, my_idx, causal,
+                           scale)
 
     q32 = q.astype(jnp.float32)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
@@ -81,8 +97,67 @@ def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
     return out.astype(q.dtype)
 
 
+def _ring_flash(q, k, v, axis_name, axis_size, my_idx, causal, scale):
+    """Flash-kernel ring body: merge per-block (out, lse) partials.
+
+    Ring step i processes the K/V block that started at position
+    my_idx - i, so step 0 is ALWAYS the local (diagonal) block — it runs
+    peeled, with the causal kernel, and the scanned steps all use the
+    unmasked kernel (off-diagonal blocks are either fully visible or,
+    for causal, fully masked — handled by discarding their lse).  No
+    per-device branching between two pallas programs is needed."""
+    from ..ops.pallas_kernels import flash_attention_with_lse
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    b, h, t_q, d = q.shape
+
+    def _block(qq, kk, vv, causal_):
+        return flash_attention_with_lse(qq, kk, vv, causal=causal_,
+                                        scale=scale)
+
+    def merge(out_acc, lse_acc, out_i, lse_i):
+        lse_new = jnp.logaddexp(lse_acc, lse_i)
+        # -inf lanes: exp(-inf - -inf) is NaN, and a NaN inside where()
+        # still poisons gradients — sanitize the exponents themselves
+        safe_new = jnp.where(jnp.isneginf(lse_new), 0.0, lse_new)
+        w_old = jnp.where(jnp.isneginf(lse_acc), 0.0,
+                          jnp.exp(jnp.where(jnp.isneginf(lse_acc), 0.0,
+                                            lse_acc) - safe_new))
+        w_i = jnp.where(jnp.isneginf(lse_i), 0.0,
+                        jnp.exp(jnp.where(jnp.isneginf(lse_i), 0.0,
+                                          lse_i) - safe_new))
+        out_new = (out_acc * w_old[..., None] +
+                   out_i.astype(jnp.float32) * w_i[..., None])
+        return out_new, lse_new
+
+    # peeled diagonal step (i = 0): the only block that needs the
+    # in-kernel causal mask (same global offsets -> local pattern)
+    out_d, lse_d = _block(q, k, v, causal)
+    out_acc = out_d.astype(jnp.float32)
+    lse_acc = lse_d
+    k = lax.ppermute(k, axis_name, perm)
+    v = lax.ppermute(v, axis_name, perm)
+
+    def step(carry, i):
+        out_acc, lse_acc, k_cur, v_cur = carry
+        src_idx = (my_idx - i) % axis_size
+        out_i, lse_i = _block(q, k_cur, v_cur, False)
+        if causal:
+            # blocks from the future are fully masked for every query
+            lse_i = jnp.where(src_idx > my_idx, -jnp.inf, lse_i)
+        out_new, lse_new = merge(out_acc, lse_acc, out_i, lse_i)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (out_new, lse_new, k_next, v_next), None
+
+    if axis_size > 1:
+        (out_acc, _lse, _k, _v), _ = lax.scan(
+            step, (out_acc, lse_acc, k, v), jnp.arange(1, axis_size))
+    return out_acc.astype(q.dtype)
+
+
 def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
-                   batch_axis=None):
+                   batch_axis=None, use_flash=False):
     """Sharded entry point: q/k/v are global (B, H, T, D) arrays whose T axis
     is (to be) sharded over ``axis_name``; returns attention output with the
     same sharding.  Accepts NDArrays or jax arrays."""
@@ -94,10 +169,15 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
     fn = shard_map(
         functools.partial(ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale,
-                          extra_vary_axes=extra),
+                          extra_vary_axes=extra, use_flash=use_flash),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas interpret mode's internal block dynamic_slices mix
+        # varying operands with invariant grid indices, which the vma
+        # checker rejects (jax suggests exactly this workaround); the
+        # einsum path keeps full variance checking
+        check_vma=not use_flash,
     )
     if isinstance(q, NDArray):
         return invoke(fn, (q, k, v), name="ring_attention")
