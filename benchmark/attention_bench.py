@@ -34,6 +34,9 @@ def main():
                    help="comma-separated sequence lengths")
     p.add_argument("--kinds", default="fwd,fwd_bwd",
                    help="comma-separated subset of fwd,fwd_bwd")
+    p.add_argument("--causal", action="store_true",
+                   help="causal variants: dense applies a tril mask, flash "
+                        "skips fully-masked blocks (metric gains '_causal')")
     args = p.parse_args()
 
     import jax
@@ -41,13 +44,19 @@ def main():
 
     from mxnet_tpu.ops import pallas_kernels as pk
 
+    causal = args.causal
+
     def dense(q, k, v):
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+        if causal:
+            t = s.shape[-1]
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            s = jnp.where(mask, s, -1e30)
         p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
         return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
     def flash(q, k, v):
-        return pk._flash(q, k, v, False, None, None, None, None)
+        return pk._flash(q, k, v, causal, None, None, None, None)
 
     def drain(x):
         onp.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0])
@@ -125,16 +134,17 @@ def main():
             if kind not in args.kinds.split(","):
                 continue
             for name, impl in (("dense", dense), ("flash", flash)):
+                tag = f"{name}_{kind}" + ("_causal" if causal else "")
                 try:
                     ms, n, ok = scan_ms(impl, qkv, grad)
                     row = {
-                        "metric": f"attn_{name}_{kind}_ms",
+                        "metric": f"attn_{tag}_ms",
                         "seq_len": t, "value": round(ms, 3), "unit": "ms",
                         "tokens_per_s": round(B * t / (ms / 1e3)),
                         "scan_len": n, "reliable": ok,
                     }
                 except Exception as e:
-                    row = {"metric": f"attn_{name}_{kind}_error",
+                    row = {"metric": f"attn_{tag}_error",
                            "seq_len": t, "error": str(e)[:120]}
                     if "UNAVAILABLE" in str(e):
                         # the shared worker crashed; give it time to

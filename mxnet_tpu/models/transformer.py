@@ -46,7 +46,11 @@ __all__ = [
 # where dense fails to compile.  Forward-only: XLA's fused dense
 # attention wins at short T (0.12 vs 0.24 ms at 1024), flash from 2048
 # up (0.91 vs 1.13 ms), and dense hits a reproducible HBM cliff at 8192
-# (903 vs 14 ms).
+# (903 vs 14 ms).  The CAUSAL crossovers were measured separately in
+# round 5 (results/attention_causal_tpu_v5e.json) with the
+# masked-block-skipping kernel and land on the SAME thresholds:
+# causal fwd+bwd crosses at 1024 (0.54 vs 0.69 ms), causal fwd-only at
+# 2048 (0.62 vs 1.16 ms) — so one pair of constants serves both.
 FLASH_AUTO_MIN_T = 2048           # fwd-only (inference) crossover
 FLASH_AUTO_MIN_T_TRAINING = 1024  # fwd+bwd crossover
 
@@ -236,7 +240,14 @@ class TransformerEncoder(HybridBlock):
 
 class BertModel(HybridBlock):
     """BERT encoder: token + segment + position embeddings -> encoder ->
-    (sequence output, pooled output)."""
+    (sequence output, pooled output).
+
+    ``use_flash="auto"`` (default) picks the Pallas flash kernel at the
+    measured crossovers; note the auto policy reads "is a backward
+    expected" from the tape, so forward-only passes that run in *train
+    mode* (e.g. MC-dropout inference) at 1024 <= T < 2048 get the
+    training tier where dense forward is ~2x faster — pass
+    ``use_flash=False`` explicitly for that usage pattern."""
 
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,

@@ -81,6 +81,22 @@ def _causal_mask(s, qi, ki, block_q, block_k, transposed=False):
     return jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
 
+def _ki_clamp(block_q, block_k):
+    """Fetch-index clamp for causal q-major grids: K blocks past the last
+    valid one re-fetch the last valid block (copy elided by Mosaic)."""
+    def clamp(qi, ki):
+        return jnp.minimum(ki, ((qi + 1) * block_q - 1) // block_k)
+    return clamp
+
+
+def _qi_clamp(block_q, block_k):
+    """Fetch-index clamp for causal k-major grids: Q blocks before the
+    first valid one re-fetch the first valid block."""
+    def clamp(ki, qi):
+        return jnp.maximum(qi, (ki * block_k) // block_q)
+    return clamp
+
+
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct matching ``like``'s mesh-axis variance: under
     shard_map (ring attention) `check_vma` requires pallas outputs to
@@ -111,6 +127,12 @@ def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                 *, scale, causal, block_q, block_k, nk):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    # Causal: K blocks entirely above the diagonal contribute nothing —
+    # the last useful block for q block qi covers position (qi+1)*bq - 1.
+    # Compute is skipped past it (and the BlockSpec index maps clamp the
+    # fetch, so no HBM traffic moves either); the finish epilogue fires
+    # at the last VALID block, not nk-1.
+    last_ki = ((qi + 1) * block_q - 1) // block_k if causal else nk - 1
 
     @pl.when(ki == 0)
     def _init():
@@ -118,28 +140,34 @@ def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]                               # (block_q, D), input dtype
-    kt = kt_ref[0]                             # (D, block_k)
-    v = v_ref[0]                               # (block_k, D)
+    def _compute():
+        q = q_ref[0]                           # (block_q, D), input dtype
+        kt = kt_ref[0]                         # (D, block_k)
+        v = v_ref[0]                           # (block_k, D)
 
-    s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32,
-                            precision=_prec(q.dtype)) * scale
+        s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_prec(q.dtype)) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+
+        m_prev = m_ref[...]                    # (block_q, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                 # (block_q, block_k) f32
+        alpha = jnp.exp(m_prev - m_new)        # rescale of old mass
+        l_ref[...] = l_ref[...] * alpha + p.sum(axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=_prec(v.dtype))
+        m_ref[...] = m_new
+
     if causal:
-        s = _causal_mask(s, qi, ki, block_q, block_k)
+        pl.when(ki <= last_ki)(_compute)
+    else:
+        _compute()
 
-    m_prev = m_ref[...]                        # (block_q, 1)
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new)                     # (block_q, block_k) f32
-    alpha = jnp.exp(m_prev - m_new)            # rescale of old mass
-    l_ref[...] = l_ref[...] * alpha + p.sum(axis=1, keepdims=True)
-    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=_prec(v.dtype))
-    m_ref[...] = m_new
-
-    @pl.when(ki == nk - 1)
+    @pl.when(ki == last_ki)
     def _finish():
         l = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
@@ -156,13 +184,17 @@ def _flash_forward(qd, kd, vd, causal, scale, block_q, block_k, interpret):
     vr = vd.reshape(b * h, t, d)
     kernel = functools.partial(
         _fwd_kernel, scale=sc, causal=causal, block_q=bq, block_k=bk, nk=nk)
+    # Causal: clamp the K/V fetch index for skipped (fully-masked) blocks
+    # to the last valid one — an unchanged block index means Mosaic elides
+    # the copy, so skipped grid steps move no HBM traffic.
+    ck = _ki_clamp(bq, bk) if causal else (lambda qi, ki: ki)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // bq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, 0, ki)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, 0, ck(qi, ki))),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ck(qi, ki), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -199,34 +231,42 @@ def _bwd_dq_kernel(q_ref, kt_ref, k_ref, vt_ref, do_ref, lse_ref, dl_ref,
                    dq_ref, acc_ref, *, scale, causal, block_q, block_k, nk):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    last_ki = ((qi + 1) * block_q - 1) // block_k if causal else nk - 1
 
     @pl.when(ki == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]                               # (block_q, D)
-    kt = kt_ref[0]                             # (D, block_k)
-    k = k_ref[0]                               # (block_k, D)
-    vt = vt_ref[0]                             # (D, block_k)
-    do = do_ref[0]                             # (block_q, D)
-    lse = lse_ref[0]                           # (block_q, 1) f32
-    delta = dl_ref[0]                          # (block_q, 1) f32
+    def _compute():
+        q = q_ref[0]                           # (block_q, D)
+        kt = kt_ref[0]                         # (D, block_k)
+        k = k_ref[0]                           # (block_k, D)
+        vt = vt_ref[0]                         # (D, block_k)
+        do = do_ref[0]                         # (block_q, D)
+        lse = lse_ref[0]                       # (block_q, 1) f32
+        delta = dl_ref[0]                      # (block_q, 1) f32
 
-    s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.float32,
-                            precision=_prec(q.dtype)) * scale
+        s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32,
+                                precision=_prec(q.dtype)) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)                   # (block_q, block_k) f32
+        dp = jax.lax.dot_general(do, vt, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=_prec(do.dtype))
+        ds = p * (dp - delta) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(k.dtype))
+
     if causal:
-        s = _causal_mask(s, qi, ki, block_q, block_k)
-    p = jnp.exp(s - lse)                       # (block_q, block_k) f32
-    dp = jax.lax.dot_general(do, vt, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32,
-                             precision=_prec(do.dtype))
-    ds = p * (dp - delta) * scale
-    acc_ref[...] += jax.lax.dot_general(ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=_prec(k.dtype))
+        pl.when(ki <= last_ki)(_compute)
+    else:
+        _compute()
 
-    @pl.when(ki == nk - 1)
+    @pl.when(ki == last_ki)
     def _finish():
         dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
 
@@ -236,38 +276,49 @@ def _bwd_dkv_kernel(qt_ref, q_ref, k_ref, v_ref, dot_ref, do_ref, lse_ref,
                     *, scale, causal, block_q, block_k, nq):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
+    # Causal, k-major: Q blocks strictly before the diagonal see nothing
+    # of this K block; the first contributing block holds position ki*bk.
+    first_qi = (ki * block_k) // block_q if causal else 0
 
-    @pl.when(qi == 0)
+    @pl.when(qi == first_qi)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    qt = qt_ref[0]                             # (D, block_q)
-    q = q_ref[0]                               # (block_q, D)
-    k = k_ref[0]                               # (block_k, D)
-    v = v_ref[0]                               # (block_k, D)
-    dot_ = dot_ref[0]                          # (D, block_q)  = do^T
-    do = do_ref[0]                             # (block_q, D)
-    lse = lse_ref[0]                           # (1, block_q) f32
-    delta = dl_ref[0]                          # (1, block_q) f32
+    def _compute():
+        qt = qt_ref[0]                         # (D, block_q)
+        q = q_ref[0]                           # (block_q, D)
+        k = k_ref[0]                           # (block_k, D)
+        v = v_ref[0]                           # (block_k, D)
+        dot_ = dot_ref[0]                      # (D, block_q)  = do^T
+        do = do_ref[0]                         # (block_q, D)
+        lse = lse_ref[0]                       # (1, block_q) f32
+        delta = dl_ref[0]                      # (1, block_q) f32
 
-    # k-major (transposed) score space: st[kb, qb] = s[qb, kb]
-    st = jax.lax.dot_general(k, qt, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32,
-                             precision=_prec(k.dtype)) * scale
+        # k-major (transposed) score space: st[kb, qb] = s[qb, kb]
+        st = jax.lax.dot_general(k, qt, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32,
+                                 precision=_prec(k.dtype)) * scale
+        if causal:
+            st = _causal_mask(st, qi, ki, block_q, block_k, transposed=True)
+        pt = jnp.exp(st - lse)                 # (block_k, block_q)
+        dv_acc[...] += jax.lax.dot_general(
+            pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(do.dtype))
+        dpt = jax.lax.dot_general(v, dot_, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32,
+                                  precision=_prec(v.dtype))
+        dst = pt * (dpt - delta) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_prec(q.dtype))
+
     if causal:
-        st = _causal_mask(st, qi, ki, block_q, block_k, transposed=True)
-    pt = jnp.exp(st - lse)                     # (block_k, block_q)
-    dv_acc[...] += jax.lax.dot_general(pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=_prec(do.dtype))
-    dpt = jax.lax.dot_general(v, dot_, (((1,), (0,)), ((), ())),
-                              preferred_element_type=jnp.float32,
-                              precision=_prec(v.dtype))
-    dst = pt * (dpt - delta) * scale
-    dk_acc[...] += jax.lax.dot_general(dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=_prec(q.dtype))
+        pl.when(qi >= first_qi)(_compute)
+    else:
+        _compute()
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -301,15 +352,18 @@ def _flash_backward(qd, kd, vd, out, lse, ct, causal, scale, block_q,
     lse_row = lse.reshape(b * h, 1, t)         # k-major kernels broadcast
     dlt_row = delta.reshape(b * h, 1, t)       # over score ROWS
 
+    ck = _ki_clamp(bq, bk) if causal else (lambda qi, ki: ki)
+    cq = _qi_clamp(bq, bk) if causal else (lambda ki, qi: qi)
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=sc, causal=causal,
                           block_q=bq, block_k=bk, nk=nk),
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, 0, ki)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, 0, ki)),
+            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, 0, ck(qi, ki))),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ck(qi, ki), 0)),
+            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, 0, ck(qi, ki))),
             pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
@@ -325,14 +379,14 @@ def _flash_backward(qd, kd, vd, out, lse, ct, causal, scale, block_q,
                           block_q=bq, block_k=bk, nq=nq),
         grid=(b * h, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, d, bq), lambda bh, ki, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, d, bq), lambda bh, ki, qi: (bh, 0, cq(ki, qi))),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, cq(ki, qi), 0)),
             pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, d, bq), lambda bh, ki, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, d, bq), lambda bh, ki, qi: (bh, 0, cq(ki, qi))),
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, cq(ki, qi), 0)),
+            pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, cq(ki, qi))),
+            pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, cq(ki, qi))),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),

@@ -102,10 +102,23 @@ def _ring_flash(q, k, v, axis_name, axis_size, my_idx, causal, scale):
 
     Ring step i processes the K/V block that started at position
     my_idx - i, so step 0 is ALWAYS the local (diagonal) block — it runs
-    peeled, with the causal kernel, and the scanned steps all use the
-    unmasked kernel (off-diagonal blocks are either fully visible or,
-    for causal, fully masked — handled by discarding their lse).  No
-    per-device branching between two pallas programs is needed."""
+    peeled, with the causal kernel (which skips its own fully-masked
+    sub-blocks, benchmark/ATTENTION_ANALYSIS.md round-5 table), and the
+    scanned steps all use the unmasked kernel (off-diagonal blocks are
+    either fully visible or, for causal, fully masked — handled by
+    discarding their lse).  No per-device branching between two pallas
+    programs is needed.
+
+    Why causal future ring steps are NOT skipped: which steps are masked
+    depends on ``my_idx`` — a per-device runtime value under SPMD — so
+    skipping would need `lax.cond` around the pallas call, which this
+    toolchain cannot lower under shard_map+scan; and it would not help
+    wall-clock anyway: the ring is synchronous (every step ends in a
+    collective ppermute), so step i's latency is set by the axis_size−i
+    devices that DO compute, not by the i devices idling.  Balancing the
+    causal triangle needs a different K/V layout (zigzag/striped ring),
+    which changes the sharding contract — documented as the upgrade
+    path, not done here."""
     from ..ops.pallas_kernels import flash_attention_with_lse
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
@@ -176,7 +189,11 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
         # pallas interpret mode's internal block dynamic_slices mix
         # varying operands with invariant grid indices, which the vma
         # checker rejects (jax suggests exactly this workaround); the
-        # einsum path keeps full variance checking
+        # einsum path keeps full variance checking.  The checker being
+        # off for the whole flash body is guarded by
+        # test_ring_attention_flash_gradients_match_einsum_path, which
+        # asserts the two bodies agree (fwd + grads) — a variance bug in
+        # the flash ring/merge logic shows up there as a value mismatch
         check_vma=not use_flash,
     )
     if isinstance(q, NDArray):

@@ -68,6 +68,66 @@ def test_flash_gradients_match_dense():
             onp.abs(got.asnumpy() - onp.asarray(expect)).max()
 
 
+@pytest.mark.parametrize("bq,bk", [(16, 32), (32, 16), (64, 64)])
+def test_flash_causal_block_skip_grads(bq, bk):
+    """Causal kernels skip fully-masked blocks (fwd: ki past the diagonal,
+    dkv: qi before it).  Unequal block shapes exercise the last_ki /
+    first_qi index arithmetic in both directions; gradients must still
+    match the dense oracle exactly."""
+    onp.random.seed(3)
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import autograd
+    qn = onp.random.randn(1, 2, 64, 8).astype(onp.float32)
+    kn = onp.random.randn(1, 2, 64, 8).astype(onp.float32)
+    vn = onp.random.randn(1, 2, 64, 8).astype(onp.float32)
+    q, k, v = (mx.np.array(a) for a in (qn, kn, vn))
+    for a in (q, k, v):
+        a.attach_grad()
+    with autograd.record():
+        loss = (flash_attention(q, k, v, causal=True, block_q=bq,
+                                block_k=bk) ** 2).sum()
+    loss.backward()
+
+    def dense_loss(qj, kj, vj):
+        d = qj.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", qj, kj) * d ** -0.5
+        t = s.shape[-1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return (jnp.einsum("bhqk,bhkd->bhqd", p, vj) ** 2).sum()
+
+    gq, gk, gv = jax.grad(dense_loss, argnums=(0, 1, 2))(qn, kn, vn)
+    for got, expect in [(q.grad, gq), (k.grad, gk), (v.grad, gv)]:
+        assert onp.allclose(got.asnumpy(), onp.asarray(expect), atol=1e-3), \
+            onp.abs(got.asnumpy() - onp.asarray(expect)).max()
+
+
+def test_flash_causal_lse_matches_dense():
+    """Causal lse (what ring attention's peeled diagonal step merges on)
+    must equal the dense masked logsumexp even with skipped blocks."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas_kernels import flash_attention_with_lse
+    onp.random.seed(4)
+    b, h, t, d = 1, 2, 64, 8
+    qn = onp.random.randn(b, h, t, d).astype(onp.float32)
+    kn = onp.random.randn(b, h, t, d).astype(onp.float32)
+    vn = onp.random.randn(b, h, t, d).astype(onp.float32)
+    _out, lse = flash_attention_with_lse(
+        jnp.asarray(qn), jnp.asarray(kn), jnp.asarray(vn), causal=True,
+        block_q=16, block_k=16, interpret=True)
+    s = onp.einsum("bhqd,bhkd->bhqk", qn, kn) * d ** -0.5
+    mask = onp.tril(onp.ones((t, t), bool))
+    s = onp.where(mask, s, -1e30)
+    m = s.max(-1)
+    expect = m + onp.log(onp.exp(s - m[..., None]).sum(-1))
+    assert onp.allclose(onp.asarray(lse), expect, atol=2e-5), \
+        onp.abs(onp.asarray(lse) - expect).max()
+
+
 def test_flash_rejects_indivisible_length():
     q = mx.np.ones((1, 1, 50, 8))
     with pytest.raises(ValueError, match="divide"):
