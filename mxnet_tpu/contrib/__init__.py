@@ -8,7 +8,16 @@ from ..ops.contrib import (box_iou, box_nms, bipartite_matching, roi_align,
                            interleaved_matmul_selfatt_qk,
                            interleaved_matmul_selfatt_valatt,
                            interleaved_matmul_encdec_qk,
-                           interleaved_matmul_encdec_valatt)
+                           interleaved_matmul_encdec_valatt,
+                           quadratic, box_encode, box_decode, edge_id,
+                           getnnz, dynamic_reshape, bilinear_resize_2d)
+# int8 surface under its reference contrib home
+# (`src/operator/quantization/*.cc` registers `_contrib_quantize*`)
+from ..ops.quantization import (quantize, quantize_v2, dequantize,
+                                requantize, quantized_fully_connected,
+                                quantized_conv)
+# group-sparse optimizer kernel (`_contrib_group_adagrad_update`)
+from ..ndarray.legacy import group_adagrad_update
 # control flow lives under mx.nd.contrib in the reference
 # (`python/mxnet/ndarray/contrib.py`: foreach/while_loop/cond)
 from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
@@ -24,6 +33,50 @@ def div_sqrt_dim(data):
     return invoke(lambda x: x / math.sqrt(x.shape[-1]), (data,),
                   name="div_sqrt_dim")
 
+def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """`_contrib_calibrate_entropy` (`src/operator/quantization/
+    calibrate.cc`): KL-minimizing threshold from an activation histogram.
+    Returns (min_threshold, max_threshold) like the reference (symmetric
+    around zero)."""
+    import numpy as _onp
+
+    from .quantization import _entropy_threshold_from_hist
+    h = _onp.asarray(hist.asnumpy() if hasattr(hist, "asnumpy") else hist)
+    e = _onp.asarray(hist_edges.asnumpy()
+                     if hasattr(hist_edges, "asnumpy") else hist_edges)
+    amax = float(_onp.abs(e).max())
+    t = _entropy_threshold_from_hist(h, amax, num_quantized_bins)
+    return -t, t
+
+
+def AdaptiveAvgPooling2D(data, output_size=1):  # noqa: N802
+    """`_contrib_AdaptiveAvgPooling2D` (`src/operator/contrib/
+    adaptive_avg_pooling.cc`): NCHW adaptive average pool."""
+    from ..ops.invoke import invoke as _inv
+    from ..ops.nn import adaptive_avg_pool2d
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _inv(lambda x: adaptive_avg_pool2d(x, tuple(output_size)),
+                (data,), name="AdaptiveAvgPooling2D")
+
+
+def BatchNormWithReLU(*args, **kwargs):  # noqa: N802
+    """`_contrib_BatchNormWithReLU`: BN + ReLU — on TPU the fusion is
+    XLA's job; the composite compiles to one kernel."""
+    from ..ndarray import legacy as _leg
+    if kwargs.get("output_mean_var"):
+        raise ValueError("BatchNormWithReLU does not return mean/var "
+                         "(same as the reference fused op)")
+    out_buf = kwargs.pop("out", None)   # relu applies before the rebind
+    res = _leg.relu(_leg.BatchNorm(*args, **kwargs))
+    if out_buf is not None:
+        out_buf._rebind(res._data)
+        return out_buf
+    return res
+
+
+BilinearResize2D = bilinear_resize_2d  # reference CamelCase registration
+
 # reference CamelCase aliases (mx.nd.contrib.ROIAlign)
 ROIAlign = roi_align
 MultiBoxDetection = multibox_detection
@@ -36,4 +89,10 @@ __all__ = ["box_iou", "box_nms", "bipartite_matching", "roi_align",
            "circ_conv", "k_smallest_flags", "hawkes_ll",
            "foreach", "while_loop", "cond", "div_sqrt_dim",
            "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
-           "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt"]
+           "interleaved_matmul_encdec_qk", "interleaved_matmul_encdec_valatt",
+           "quadratic", "box_encode", "box_decode", "edge_id", "getnnz",
+           "dynamic_reshape", "bilinear_resize_2d", "BilinearResize2D",
+           "AdaptiveAvgPooling2D", "BatchNormWithReLU", "calibrate_entropy",
+           "quantize", "quantize_v2", "dequantize", "requantize",
+           "quantized_fully_connected", "quantized_conv",
+           "group_adagrad_update"]

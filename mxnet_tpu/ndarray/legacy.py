@@ -1184,6 +1184,202 @@ preloaded_multi_mp_sgd_update = _preloaded(multi_mp_sgd_update)
 preloaded_multi_mp_sgd_mom_update = _preloaded(multi_mp_sgd_mom_update)
 
 
+def adamw_update(weight, grad, mean, var, rescale_grad=1.0, lr=0.001,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                 clip_gradient=-1.0, out=None):
+    """AdamW with decoupled weight decay (`src/operator/contrib/adamw.cc:79`).
+    ``rescale_grad`` may be an NDArray (the reference passes the dynamic
+    loss-scale as a tensor input) — it folds into the gradient here, which
+    is the same math (scale applies before clipping in both)."""
+    if isinstance(rescale_grad, NDArray):
+        grad = grad * rescale_grad
+        rescale_grad = 1.0
+    new_w, new_mean, new_var = invoke(
+        _lm.adamw_update, (weight, grad, mean, var),
+        dict(lr=_f(lr, 0.001), beta1=_f(beta1, 0.9), beta2=_f(beta2, 0.999),
+             epsilon=_f(epsilon, 1e-8), wd=_f(wd, 0.0), eta=_f(eta, 1.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="adamw_update", differentiable=False)
+    _inplace(mean, new_mean)
+    _inplace(var, new_var)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad=1.0,
+                    lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                    eta=1.0, clip_gradient=-1.0, out=None):
+    """`src/operator/contrib/adamw.cc:34` — f32 master weights."""
+    if isinstance(rescale_grad, NDArray):
+        grad = grad * rescale_grad
+        rescale_grad = 1.0
+    new_w, new_mean, new_var, new_w32 = invoke(
+        _lm.mp_adamw_update, (weight, grad, mean, var, weight32),
+        dict(lr=_f(lr, 0.001), beta1=_f(beta1, 0.9), beta2=_f(beta2, 0.999),
+             epsilon=_f(epsilon, 1e-8), wd=_f(wd, 0.0), eta=_f(eta, 1.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="mp_adamw_update", differentiable=False)
+    _inplace(mean, new_mean)
+    _inplace(var, new_var)
+    _inplace(weight32, new_w32)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def _multi_4state(single, mp, name, extra_lists=("etas",)):
+    """Multi-tensor adamw/lamb/lans variants
+    (`src/operator/contrib/adamw.cc:143`, `multi_lamb.cc`,
+    `multi_lans.cc`): flattened [w_i, g_i, mean_i, var_i(, w32_i)] inputs,
+    per-tensor lrs/wds (+etas for adamw, step_count for lamb/lans)."""
+    stride = 5 if mp else 4
+
+    def op(*data, lrs=(), wds=(), etas=(), step_count=(), num_tensors=None,
+           num_weights=None, rescale_grad=1.0, clip_gradient=-1.0,
+           beta1=0.9, beta2=0.999, epsilon=None, bias_correction=True,
+           lower_bound=-1.0, upper_bound=-1.0, out=None, **kw):
+        n = num_tensors if num_tensors is not None else (
+            num_weights if num_weights is not None else len(data) // stride)
+        # reference layout: per-tensor consecutive [w_i, g_i, mean_i,
+        # var_i(, w32_i)] (`multi_lans-inl.h` FillMultiLANSKernelParam)
+        groups = [data[i * stride:(i + 1) * stride] for i in range(n)]
+        outs = out if out is not None else [_nd(g[0]) for g in groups]
+        for i, g in enumerate(groups):
+            kwargs = dict(lr=lrs[i], wd=wds[i], rescale_grad=rescale_grad,
+                          clip_gradient=clip_gradient, beta1=beta1,
+                          beta2=beta2, out=outs[i])
+            if epsilon is not None:   # else each single's reference
+                kwargs["epsilon"] = epsilon  # default (1e-8 adamw, 1e-6
+                #                              lamb/lans) applies
+            if "etas" in extra_lists:
+                kwargs["eta"] = etas[i] if etas else 1.0
+            if "step_count" in extra_lists:
+                kwargs["t"] = int(step_count[i]) if len(step_count) else 1
+                kwargs["lower_bound"] = lower_bound
+                kwargs["upper_bound"] = upper_bound
+            if "bias_correction" in extra_lists:
+                kwargs["bias_correction"] = bias_correction
+            single(*g, **kwargs)
+        return outs
+    op.__name__ = name
+    return op
+
+
+def _lamb_single(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0, lower_bound=-1.0,
+                 upper_bound=-1.0, out=None):
+    new_w, new_mean, new_var = invoke(
+        _lm.full_lamb_update, (weight, grad, mean, var),
+        dict(lr=_f(lr, 0.0), beta1=_f(beta1, 0.9), beta2=_f(beta2, 0.999),
+             epsilon=_f(epsilon, 1e-6), t=int(t),
+             bias_correction=bool(bias_correction), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0),
+             lower_bound=_f(lower_bound, -1.0),
+             upper_bound=_f(upper_bound, -1.0)),
+        name="multi_lamb_update", differentiable=False)
+    _inplace(mean, new_mean)
+    _inplace(var, new_var)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def _lans_single(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, t=1, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0, lower_bound=-1.0, upper_bound=-1.0,
+                 out=None):
+    new_w, new_mean, new_var = invoke(
+        _lm.lans_update, (weight, grad, mean, var),
+        dict(lr=_f(lr, 0.0), beta1=_f(beta1, 0.9), beta2=_f(beta2, 0.999),
+             epsilon=_f(epsilon, 1e-6), t=int(t), wd=_f(wd, 0.0),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0),
+             lower_bound=_f(lower_bound, -1.0),
+             upper_bound=_f(upper_bound, -1.0)),
+        name="multi_lans_update", differentiable=False)
+    _inplace(mean, new_mean)
+    _inplace(var, new_var)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def _adamw_single(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, out=None):
+    return adamw_update(weight, grad, mean, var, rescale_grad=rescale_grad,
+                        lr=lr, beta1=beta1, beta2=beta2, epsilon=epsilon,
+                        wd=wd, eta=eta, clip_gradient=clip_gradient, out=out)
+
+
+def _mp_single(single):
+    def op(weight, grad, mean, var, weight32, **kw):
+        out = kw.pop("out", None)
+        # single() rebinds weight32 in place (its mutate contract); the
+        # low-precision copy tracks it
+        new_w32 = single(weight32, _nd(grad).astype("float32"), mean, var,
+                         **kw)
+        low = _nd(new_w32).astype(_nd(weight).dtype)
+        return _ret(low, out if out is not None else _nd(weight))
+    return op
+
+
+multi_adamw_update = _multi_4state(_adamw_single, False,
+                                   "multi_adamw_update")
+multi_mp_adamw_update = _multi_4state(_mp_single(_adamw_single), True,
+                                      "multi_mp_adamw_update")
+multi_lamb_update = _multi_4state(_lamb_single, False, "multi_lamb_update",
+                                  extra_lists=("step_count",
+                                               "bias_correction"))
+multi_mp_lamb_update = _multi_4state(_mp_single(_lamb_single), True,
+                                     "multi_mp_lamb_update",
+                                     extra_lists=("step_count",
+                                                  "bias_correction"))
+multi_lans_update = _multi_4state(_lans_single, False, "multi_lans_update",
+                                  extra_lists=("step_count",))
+multi_mp_lans_update = _multi_4state(_mp_single(_lans_single), True,
+                                     "multi_mp_lans_update",
+                                     extra_lists=("step_count",))
+
+
+def sparse_adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    """`_sparse_adagrad_update` (`src/operator/optimizer_op.cc:888`).
+    Weight decay is rejected exactly like the reference ("non-zero values
+    for the weight decay option are not supported") — without a wd term,
+    densified row_sparse grads are exact: a zero row leaves both the
+    history and the weight row unchanged."""
+    if _f(wd, 0.0) != 0.0:
+        raise ValueError("sparse_adagrad_update does not support weight "
+                         "decay (reference contract)")
+    from . import sparse as _sp
+    if isinstance(grad, _sp._SparseNDArray):
+        grad = grad.tostype("default")
+    new_w, new_hist = invoke(
+        _lm.adagrad_update, (weight, grad, history),
+        dict(lr=_f(lr, 0.0), epsilon=_f(epsilon, 1e-7),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="sparse_adagrad_update", differentiable=False)
+    _inplace(history, new_hist)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
+def group_adagrad_update(weight, grad, history, lr, epsilon=1e-5,
+                         rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    """`_contrib_group_adagrad_update`
+    (`src/operator/contrib/optimizer_op-inl.h:96`): one accumulator per
+    weight row."""
+    from . import sparse as _sp
+    if isinstance(grad, _sp._SparseNDArray):
+        grad = grad.tostype("default")
+    new_w, new_hist = invoke(
+        _lm.group_adagrad_update, (weight, grad, history),
+        dict(lr=_f(lr, 0.0), epsilon=_f(epsilon, 1e-5),
+             rescale_grad=_f(rescale_grad, 1.0),
+             clip_gradient=_f(clip_gradient, -1.0)),
+        name="group_adagrad_update", differentiable=False)
+    _inplace(history, new_hist)
+    return _ret(new_w, out if out is not None else _nd(weight))
+
+
 def multi_sum_sq(*arrays, num_arrays=None, out=None):
     return _ret(invoke(_lm.multi_sum_sq, arrays, name="multi_sum_sq",
                        differentiable=False), out)

@@ -268,3 +268,17 @@ def retain(rs, indices):
     mask = onp.isin(have, want)
     return RowSparseNDArray(onp.asarray(rs.data)[mask], have[mask], rs.shape,
                             rs.dtype)
+
+
+def adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    """`_sparse_adagrad_update` (`src/operator/optimizer_op.cc:888`) under
+    its reference home `mx.nd.sparse.adagrad_update`; accepts dense or
+    row_sparse gradients (see `ndarray.legacy.sparse_adagrad_update`)."""
+    from .legacy import sparse_adagrad_update
+    return sparse_adagrad_update(weight, grad, history, lr, epsilon=epsilon,
+                                 wd=wd, rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient, out=out)
+
+
+__all__.append("adagrad_update")

@@ -157,6 +157,31 @@ for _name in ["nanargmax", "nanargmin", "isin", "intersect1d", "union1d",
         continue
     _g[_name] = _make_op(_jf, _name, differentiable=_name not in _NON_DIFF)
 
+# window functions (`_npi_blackman/hamming/hanning`,
+# `src/operator/numpy/np_window_op.cc`) and index raveling
+# (`_ravel_multi_index`, `src/operator/tensor/ravel.cc`)
+for _name in ["blackman", "hamming", "hanning", "bartlett", "kaiser"]:
+    _jf = getattr(jnp, _name, None)
+    if _jf is not None and _name not in _g:
+        _g[_name] = _make_op(_jf, _name, differentiable=False)
+def _ravel_multi_index(multi_index, dims, mode="raise", order="C"):
+    # jnp has no traced 'raise' mode; do the bounds check on host values
+    # (this op is eager-only anyway — flat indices feed host-side code)
+    if mode == "raise":
+        idx = onp.asarray(multi_index.asnumpy()
+                          if hasattr(multi_index, "asnumpy")
+                          else multi_index)
+        lim = onp.asarray(dims).reshape((-1,) + (1,) * (idx.ndim - 1))
+        if (idx < 0).any() or (idx >= lim).any():
+            raise ValueError("invalid entry in coordinates array")
+        mode = "clip"   # already validated; clip is now a no-op
+    return jnp.ravel_multi_index(tuple(multi_index), tuple(dims),
+                                 mode=mode, order=order)
+
+
+ravel_multi_index = _make_op(_ravel_multi_index, "ravel_multi_index",
+                             differentiable=False)
+
 # renamed/removed jnp aliases with reference-era numpy names
 row_stack = _g.get("vstack")
 trapz = _make_op(jnp.trapezoid, "trapz")

@@ -617,3 +617,150 @@ def interleaved_matmul_encdec_valatt(keys_values, attention, heads):
 
     return invoke(f, (keys_values, attention),
                   name="interleaved_matmul_encdec_valatt")
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """`_contrib_quadratic` (`src/operator/contrib/quadratic_op.cc`): the
+    reference's operator-tutorial op, f(x) = a*x^2 + b*x + c."""
+    return invoke(lambda x: a * jnp.square(x) + b * x + c, (data,),
+                  name="quadratic")
+
+
+def box_encode(samples, matches, anchors, refs, means=None, stds=None):
+    """`_contrib_box_encode` (`src/operator/contrib/bounding_box-inl.h:847`):
+    SSD training targets — normalized center offsets of each anchor's
+    matched reference box.  samples (B, N) in {+1, -1, 0}; matches (B, N)
+    indices into refs (B, M, 4, corner); means/stds (4,).  Returns
+    (targets (B, N, 4), masks (B, N, 4))."""
+    def f(smp, mat, anc, ref, mean, std):
+        ref_m = jnp.take_along_axis(
+            ref, mat.astype(jnp.int32)[..., None], axis=1)  # (B, N, 4)
+        rw = ref_m[..., 2] - ref_m[..., 0]
+        rh = ref_m[..., 3] - ref_m[..., 1]
+        rx = ref_m[..., 0] + rw * 0.5
+        ry = ref_m[..., 1] + rh * 0.5
+        aw = anc[..., 2] - anc[..., 0]
+        ah = anc[..., 3] - anc[..., 1]
+        ax = anc[..., 0] + aw * 0.5
+        ay = anc[..., 1] + ah * 0.5
+        valid = (smp > 0.5).astype(anc.dtype)[..., None]     # (B, N, 1)
+        t = jnp.stack([((rx - ax) / aw - mean[0]) / std[0],
+                       ((ry - ay) / ah - mean[1]) / std[1],
+                       (jnp.log(rw / aw) - mean[2]) / std[2],
+                       (jnp.log(rh / ah) - mean[3]) / std[3]], axis=-1)
+        masks = jnp.broadcast_to(valid, anc.shape)
+        return jnp.where(valid > 0.5, t, 0.0), masks
+
+    if means is None:
+        means = jnp.zeros(4)
+    if stds is None:
+        stds = jnp.array([0.1, 0.1, 0.2, 0.2])
+    return invoke(f, (samples, matches, anchors, refs, means, stds),
+                  name="box_encode")
+
+
+def box_decode(data, anchors, std0=1.0, std1=1.0, std2=1.0, std3=1.0,
+               clip=-1.0, format="center"):  # noqa: A002
+    """`_contrib_box_decode` (`src/operator/contrib/bounding_box-inl.h:992`):
+    predicted offsets (B, N, 4) + anchors (1, N, 4) -> corner boxes."""
+    def f(x, anc):
+        ax, ay, aw, ah = (anc[..., i] for i in range(4))
+        if format == "corner":
+            aw = aw - ax
+            ah = ah - ay
+            ax = ax + aw * 0.5
+            ay = ay + ah * 0.5
+        ox = x[..., 0] * std0 * aw + ax
+        oy = x[..., 1] * std1 * ah + ay
+        dw = x[..., 2] * std2
+        dh = x[..., 3] * std3
+        if clip > 0:
+            dw = jnp.minimum(dw, clip)
+            dh = jnp.minimum(dh, clip)
+        ow = jnp.exp(dw) * aw * 0.5
+        oh = jnp.exp(dh) * ah * 0.5
+        return jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+
+    return invoke(f, (data, anchors), name="box_decode")
+
+
+def edge_id(data, u, v):
+    """`_contrib_edge_id` (`src/operator/contrib/dgl_graph.cc`): for a CSR
+    adjacency, the edge id (stored value) of each (u, v) pair, -1 when
+    absent.  Host-side — graph sampling is irregular host work, like the
+    reference's CPU-only implementation."""
+    import numpy as onp
+    from ..ndarray.sparse import CSRNDArray
+    if not isinstance(data, CSRNDArray):
+        raise TypeError("edge_id expects a CSRNDArray adjacency")
+    indptr = onp.asarray(data.indptr)
+    indices = onp.asarray(data.indices)
+    vals = onp.asarray(data.data)
+    uu = onp.asarray(u if not hasattr(u, "asnumpy") else u.asnumpy(),
+                     onp.int64).ravel()
+    vv = onp.asarray(v if not hasattr(v, "asnumpy") else v.asnumpy(),
+                     onp.int64).ravel()
+    out = onp.full(uu.shape, -1.0, onp.float32)
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        row = indices[indptr[a]:indptr[a + 1]]
+        hit = onp.nonzero(row == b)[0]
+        if hit.size:
+            out[i] = vals[indptr[a] + hit[0]]
+    from ..ndarray.ndarray import NDArray
+    return NDArray(jnp.asarray(out))
+
+
+def getnnz(data, axis=None):
+    """`_contrib_getnnz` (`src/operator/contrib/nnz.cc`): stored-element
+    count of a CSR array (axis=None -> scalar; axis=0/1 per col/row)."""
+    import numpy as onp
+    from ..ndarray.sparse import CSRNDArray
+    if not isinstance(data, CSRNDArray):
+        raise TypeError("getnnz expects a CSRNDArray")
+    indptr = onp.asarray(data.indptr)
+    indices = onp.asarray(data.indices)
+    if axis is None:
+        return int(indices.size)
+    if axis == 1:
+        return onp.diff(indptr).astype(onp.int64)
+    if axis == 0:
+        return onp.bincount(indices,
+                            minlength=data.shape[1]).astype(onp.int64)
+    raise ValueError("axis must be None, 0, or 1")
+
+
+def dynamic_reshape(data, shape):
+    """`_contrib_dynamic_reshape`: reshape where the target comes from a
+    tensor's runtime VALUES, honoring the legacy Reshape special codes
+    (0 = copy input dim, -1 infer, -2/-3/-4 — same grammar as
+    `nd.Reshape`).  Data-dependent shapes can't live under jit (XLA
+    static shapes) — this reads the shape eagerly, the documented
+    TPU-side contract."""
+    import numpy as onp
+
+    from .legacy_math import legacy_reshape
+    tgt = tuple(int(s) for s in onp.asarray(
+        shape.asnumpy() if hasattr(shape, "asnumpy") else shape).ravel())
+    return invoke(lambda x: legacy_reshape(x, tgt), (data,),
+                  name="dynamic_reshape")
+
+
+def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
+                       scale_width=None):
+    """`_contrib_BilinearResize2D` (`src/operator/contrib/
+    bilinear_resize.cc`): NCHW bilinear resize via jax.image.  Each output
+    dim needs either its absolute size or its scale."""
+    if height is None and scale_height is None:
+        raise ValueError("bilinear_resize_2d needs height or scale_height")
+    if width is None and scale_width is None:
+        raise ValueError("bilinear_resize_2d needs width or scale_width")
+
+    def f(x):
+        n, c, h, w = x.shape
+        oh = int(height) if height is not None else int(round(
+            h * scale_height))
+        ow = int(width) if width is not None else int(round(
+            w * scale_width))
+        return jax.image.resize(x, (n, c, oh, ow), method="bilinear")
+
+    return invoke(f, (data,), name="bilinear_resize_2d")

@@ -593,6 +593,114 @@ def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
     return weight - lr * ratio * g
 
 
+def adamw_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    """`src/operator/contrib/adamw-inl.h:103-118`: decoupled weight decay
+    — wd applies to the weight directly, outside the adaptive term, and
+    the whole step is scaled by the schedule multiplier ``eta``.  No bias
+    correction in the kernel (the python optimizer folds it into lr)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+                        + wd * weight)
+    return w, new_mean, new_var
+
+
+def mp_adamw_update(weight, grad, mean, var, weight32, lr, beta1=0.9,
+                    beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    new_w32, new_mean, new_var = adamw_update(
+        weight32, grad.astype(jnp.float32), mean, var, lr, beta1, beta2,
+        epsilon, wd, eta, rescale_grad, clip_gradient)
+    return new_w32.astype(weight.dtype), new_mean, new_var, new_w32
+
+
+def full_lamb_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                     epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                     rescale_grad=1.0, clip_gradient=-1.0,
+                     lower_bound=-1.0, upper_bound=-1.0):
+    """Single-tensor fused LAMB (phase1 + trust-ratio phase2 in one
+    program — the multi-tensor `_multi_lamb_update` per-tensor body,
+    `src/operator/contrib/multi_lamb.cc`)."""
+    g, new_mean, new_var = lamb_update_phase1(
+        weight, grad, mean, var, beta1, beta2, epsilon, t,
+        bias_correction, wd, rescale_grad, clip_gradient)
+    w32 = weight.astype(jnp.float32)
+    r1 = jnp.sqrt(jnp.sum(jnp.square(w32)))
+    r2 = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+    new_w = lamb_update_phase2(weight, g, r1, r2, lr, lower_bound,
+                               upper_bound)
+    return new_w, new_mean, new_var
+
+
+def lans_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-6, t=1, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lower_bound=-1.0, upper_bound=-1.0):
+    """`src/operator/contrib/multi_lans.cc:38-121` per-tensor body:
+    LANS normalizes the gradient by its own L2 norm, then applies a
+    Nesterov-style two-part LAMB step — the momentum direction and the
+    raw-gradient direction each get their own trust ratio, weighted
+    beta1 / (1-beta1)."""
+    g = grad.astype(jnp.float32) * rescale_grad
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g / jnp.maximum(g_norm, 1e-30)
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    mean_hat = new_mean / (1 - beta1 ** t)
+    var_hat = jnp.sqrt(new_var / (1 - beta2 ** t)) + epsilon
+    w32 = weight.astype(jnp.float32)
+    p_m = mean_hat / var_hat + wd * w32
+    p_g = g / var_hat + wd * w32
+    r1 = jnp.sqrt(jnp.sum(jnp.square(w32)))
+    if lower_bound is not None and lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    r2_m = jnp.sqrt(jnp.sum(jnp.square(p_m)))
+    r2_g = jnp.sqrt(jnp.sum(jnp.square(p_g)))
+    r_m = beta1 * jnp.where((r1 > 0) & (r2_m > 0), r1 / r2_m, 1.0)
+    r_g = (1 - beta1) * jnp.where((r1 > 0) & (r2_g > 0), r1 / r2_g, 1.0)
+    new_w32 = w32 - lr * r_m * p_m - lr * r_g * p_g
+    return new_w32.astype(weight.dtype), new_mean, new_var
+
+
+def adagrad_update(weight, grad, history, lr, epsilon=1e-7,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    """`src/operator/optimizer_op.cc:888` `_sparse_adagrad_update` math:
+    ``history += g^2; w -= lr * g / sqrt(history + epsilon)`` (epsilon
+    inside the sqrt; the reference op documents that weight decay is NOT
+    supported, so there is no wd term — which is also what makes
+    densified row_sparse grads exact: a zero row leaves both history and
+    weight untouched)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_hist = history + jnp.square(g)
+    new_w = weight - lr * g / jnp.sqrt(new_hist + epsilon)
+    return new_w, new_hist
+
+
+def group_adagrad_update(weight, grad, history, lr, epsilon=1e-5,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    """`src/operator/contrib/optimizer_op-inl.h:96-137`: Adagrad with one
+    shared accumulator per weight ROW — history[row] accumulates the
+    row-mean of squared gradients (group sparsity for embeddings)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    row = jnp.mean(jnp.square(g), axis=tuple(range(1, g.ndim)))
+    new_hist = history + row
+    denom = jnp.sqrt(new_hist) + epsilon
+    new_w = weight - lr * g / denom.reshape((-1,) + (1,) * (g.ndim - 1))
+    return new_w, new_hist
+
+
 def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
     new_w32, new_mom = nag_mom_update(

@@ -1,0 +1,131 @@
+"""Round-5 optimizer update kernels: adamw / multi_lamb / multi_lans /
+sparse+group adagrad families (reference `src/operator/contrib/adamw.cc`,
+`multi_lamb.cc`, `multi_lans.cc`, `optimizer_op.cc:888`,
+`contrib/optimizer_op-inl.h`)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_adamw_decoupled_wd_math():
+    w = mx.np.array(onp.ones(4), dtype="float32")
+    g = mx.np.array(onp.full(4, 0.5), dtype="float32")
+    m = mx.np.zeros((4,))
+    v = mx.np.zeros((4,))
+    out = mx.nd.adamw_update(w, g, m, v, lr=0.1, wd=0.01, eta=1.0)
+    # m=0.05, v=2.5e-4; step = eta*(lr*m/(sqrt(v)+eps) + wd*w)
+    exp = 1 - (0.1 * 0.05 / (onp.sqrt(2.5e-4) + 1e-8) + 0.01)
+    assert onp.allclose(out.asnumpy(), exp, atol=1e-6)
+    assert onp.allclose(m.asnumpy(), 0.05)          # state mutated
+    assert onp.allclose(w.asnumpy(), exp, atol=1e-6)  # weight rebound
+
+
+def test_adamw_tensor_rescale_grad():
+    """The reference passes the loss-scale as a tensor input."""
+    w = mx.np.array(onp.ones(4), dtype="float32")
+    g = mx.np.array(onp.ones(4), dtype="float32")
+    m = mx.np.zeros((4,))
+    v = mx.np.zeros((4,))
+    scale = mx.np.array([0.5])
+    o1 = mx.nd.adamw_update(w, g, m, v, rescale_grad=scale, lr=0.1).asnumpy()
+    w2 = mx.np.array(onp.ones(4), dtype="float32")
+    m2 = mx.np.zeros((4,))
+    v2 = mx.np.zeros((4,))
+    o2 = mx.nd.adamw_update(w2, g * 0.5, m2, v2, lr=0.1).asnumpy()
+    assert onp.allclose(o1, o2)
+
+
+def test_mp_adamw_updates_master_weights():
+    w = mx.np.array(onp.ones(4), dtype="float16")
+    w32 = mx.np.array(onp.ones(4), dtype="float32")
+    g = mx.np.array(onp.full(4, 0.5), dtype="float16")
+    m = mx.np.zeros((4,))
+    v = mx.np.zeros((4,))
+    out = mx.nd.mp_adamw_update(w, g, m, v, w32, lr=0.1, wd=0.0)
+    assert out.dtype == onp.float16
+    assert not onp.allclose(w32.asnumpy(), 1.0)   # master copy stepped
+    assert onp.allclose(out.asnumpy(), w32.asnumpy().astype("float16"))
+
+
+def test_multi_lamb_matches_phase1_phase2():
+    """The fused multi-tensor LAMB equals the two-phase kernels the
+    Trainer path uses."""
+    onp.random.seed(0)
+    wn = onp.random.randn(6).astype("float32")
+    gn = onp.random.randn(6).astype("float32")
+    w1 = mx.np.array(wn)
+    m1 = mx.np.zeros((6,))
+    v1 = mx.np.zeros((6,))
+    (out,) = mx.nd.multi_lamb_update(w1, mx.np.array(gn), m1, v1,
+                                     lrs=[0.01], wds=[0.1], step_count=[1])
+    w2 = mx.np.array(wn)
+    m2 = mx.np.zeros((6,))
+    v2 = mx.np.zeros((6,))
+    g2 = mx.nd.lamb_update_phase1(w2, mx.np.array(gn), m2, v2, t=1, wd=0.1)
+    r1 = onp.sqrt((wn ** 2).sum())
+    r2 = onp.sqrt((g2.asnumpy() ** 2).sum())
+    exp = mx.nd.lamb_update_phase2(w2, g2, mx.np.array([r1]),
+                                   mx.np.array([r2]), lr=0.01)
+    assert onp.allclose(out.asnumpy(), exp.asnumpy(), atol=1e-6)
+    assert onp.allclose(m1.asnumpy(), m2.asnumpy())
+
+
+def test_multi_lans_normalizes_gradient():
+    """LANS is invariant to gradient magnitude (per-tensor L2 normalize)."""
+    onp.random.seed(1)
+    wn = onp.random.randn(8).astype("float32")
+    gn = onp.random.randn(8).astype("float32")
+    outs = []
+    for scale in (1.0, 100.0):
+        w = mx.np.array(wn)
+        m = mx.np.zeros((8,))
+        v = mx.np.zeros((8,))
+        (o,) = mx.nd.multi_lans_update(w, mx.np.array(gn * scale), m, v,
+                                       lrs=[0.01], wds=[0.0],
+                                       step_count=[1])
+        outs.append(o.asnumpy())
+    assert onp.allclose(outs[0], outs[1], atol=1e-6)
+    assert not onp.allclose(outs[0], wn)
+
+
+def test_multi_lamb_default_epsilon_is_reference_1e6():
+    """Regression: the multi wrapper must not override the per-kernel
+    reference default (1e-6 for lamb/lans) with adamw's 1e-8."""
+    wn = onp.ones(4, "float32")
+    gn = onp.full(4, 0.5, "float32")
+
+    def run(eps_kw):
+        w = mx.np.array(wn)
+        m = mx.np.zeros((4,))
+        v = mx.np.zeros((4,))
+        (o,) = mx.nd.multi_lamb_update(w, mx.np.array(gn), m, v,
+                                       lrs=[0.1], wds=[0.0],
+                                       step_count=[1], **eps_kw)
+        return o.asnumpy()
+
+    assert onp.allclose(run({}), run({"epsilon": 1e-6}))
+
+
+def test_sparse_adagrad_row_sparse_grad_and_wd_contract():
+    w = mx.np.array(onp.ones((4, 2)), dtype="float32")
+    h = mx.np.zeros((4, 2))
+    grad = mx.nd.sparse.row_sparse_array(
+        (onp.full((2, 2), 2.0, "float32"), onp.array([0, 3])), shape=(4, 2))
+    out = mx.nd.sparse.adagrad_update(w, grad, h, lr=0.1)
+    got = out.asnumpy()
+    exp_touched = 1 - 0.1 * 2.0 / onp.sqrt(4.0 + 1e-7)
+    assert onp.allclose(got[[0, 3]], exp_touched, atol=1e-6)
+    assert onp.allclose(got[[1, 2]], 1.0)          # untouched rows exact
+    assert onp.allclose(h.asnumpy()[[1, 2]], 0.0)
+    with pytest.raises(ValueError, match="weight decay"):
+        mx.nd.sparse.adagrad_update(w, grad, h, lr=0.1, wd=0.1)
+
+
+def test_group_adagrad_per_row_history():
+    w = mx.np.array(onp.ones((3, 2)), dtype="float32")
+    g = mx.np.array(onp.array([[1., 1.], [0, 0], [2., 2.]], "float32"))
+    h = mx.np.zeros((3,))
+    out = mx.nd.contrib.group_adagrad_update(w, g, h, lr=0.1)
+    assert onp.allclose(h.asnumpy(), [1.0, 0.0, 4.0])   # row-mean of g^2
+    assert onp.allclose(out.asnumpy()[1], 1.0)
