@@ -1,0 +1,227 @@
+"""Why does BERT MFU sag from 35.6% (T=128) to 26.9% (T=512)?
+
+Round-4 verdict #1: the 9-point drop is batch-invariant and was "the
+next lever to profile, not yet explained".  This experiment explains it
+with the mfu_residuals methodology: every comparison is a PAIR of
+compiled programs interleaved in ONE process window (drift cancels;
+separate windows differ ±10% through the tunnel), one subprocess per
+pair so a shared-HBM OOM can't poison the rest.
+
+Pairs (all dense attention, B·T = 4096 tokens/step):
+
+  sag        base128  vs base512        the effect itself, same-window
+  drop512    base512  vs nodrop512      attention-dropout RNG+mask cost
+  drop128    base128  vs nodrop128      (scales with B·H·T² = tokens·H·T,
+                                        so its per-token cost grows with T)
+  attn512    base512  vs noattn512      attention-mix excised: q/k/v/proj
+  attn128    base128  vs noattn128      matmuls kept (damped by 1e-30 so
+                                        XLA can't DCE them), score/softmax/
+                                        dropout/context removed
+  head512    base512  vs bf16head512    MLM log-softmax: f32 upcast vs
+  head128    base128  vs bf16head128    bf16 with f32-accumulated sum
+
+Each pair reports per-round tokens/s for both variants and the median
+same-round ratio.  Attribution logic: if excising X closes the sag by
+the same number of points at T=512 but not T=128, X is the T-scaling
+cost.  Results: `results/bert_t_scaling_tpu_v5e.json`, discussion in
+BERT_ANALYSIS.md (round-5 section).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+L, U, V = 12, 768, 30522
+WARMUP = 5
+ITERS = 25
+ROUNDS = 3
+PEAK = 197e12
+
+CONFIGS = {
+    # name: (B, T, dropout, surgery)
+    "base128": (32, 128, 0.1, None),
+    "base512": (8, 512, 0.1, None),
+    "nodrop128": (32, 128, 0.0, None),
+    "nodrop512": (8, 512, 0.0, None),
+    "noattn128": (32, 128, 0.1, "noattn"),
+    "noattn512": (8, 512, 0.1, "noattn"),
+    "bf16head128": (32, 128, 0.1, "bf16head"),
+    "bf16head512": (8, 512, 0.1, "bf16head"),
+}
+
+PAIRS = {
+    "sag": ("base128", "base512"),
+    "drop512": ("base512", "nodrop512"),
+    "drop128": ("base128", "nodrop128"),
+    "attn512": ("base512", "noattn512"),
+    "attn128": ("base128", "noattn128"),
+    "head512": ("base512", "bf16head512"),
+    "head128": ("base128", "bf16head128"),
+}
+
+
+def _flops_per_token(n_dense, t, with_attention=True):
+    return 6.0 * n_dense + (12.0 * L * U * t if with_attention else 0.0)
+
+
+def _build_step(name):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import FusedTrainStep, Trainer
+    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.models import BertForPretraining
+    from mxnet_tpu.models import transformer as tr
+
+    b, t, drop, surgery = CONFIGS[name]
+
+    if surgery == "noattn":
+        # keep all four dense projections live (1e-30 damping defeats the
+        # algebraic simplifier without letting q/k affect the result),
+        # drop the score/softmax/attn-dropout/context chain — the only
+        # parts whose cost scales with T at fixed B·T
+        def noattn_forward(self, x, mask=None):
+            q = self.query(x)
+            k = self.key(x)
+            v = self.value(x)
+            return self.proj(v + (q + k) * 1e-30)
+        tr.MultiHeadAttention.forward = noattn_forward
+
+    model = BertForPretraining(vocab_size=V, units=U, hidden_size=3072,
+                               num_layers=L, num_heads=12,
+                               max_length=512, dropout=drop,
+                               use_flash=False)
+    model.initialize()
+    model.cast("bfloat16")
+
+    bf16_head = surgery == "bf16head"
+
+    class PretrainLoss(HybridBlock):
+        def __init__(self, m):
+            super().__init__()
+            self.m = m
+
+        def forward(self, tokens, segments, labels):
+            mlm_logits, nsp_logits = self.m(tokens, segments)
+            if bf16_head:
+                # bf16 shift/exp with f32-accumulated sum: skips the
+                # 2·(B·T·V) f32 materialisation (~1 GB/step at T=512)
+                s = mlm_logits - mx.np.max(mlm_logits, axis=-1,
+                                           keepdims=True)
+                lse = mx.np.log(mx.np.sum(mx.np.exp(s), axis=-1,
+                                          keepdims=True,
+                                          dtype="float32"))
+                logp = s.astype("float32") - lse
+            else:
+                logp = mx.npx.log_softmax(
+                    mlm_logits.astype("float32"), axis=-1)
+            mlm = -mx.np.mean(mx.npx.pick(logp, labels, axis=-1))
+            nsp = -mx.np.mean(
+                mx.npx.log_softmax(nsp_logits.astype("float32"))[:, 0])
+            return mlm + nsp
+
+    mod = PretrainLoss(model)
+    tokens = mx.np.array(onp.random.randint(0, V, (b, t)), dtype="int32")
+    segments = mx.np.array(onp.zeros((b, t)), dtype="int32")
+    labels = mx.np.array(onp.random.randint(0, V, (b, t)), dtype="int32")
+    trainer = Trainer(model.collect_params(), "adam",
+                      {"learning_rate": 1e-4})
+    step = FusedTrainStep(mod, trainer)
+
+    for _ in range(WARMUP):
+        step(tokens, segments, labels, batch_size=b)
+    mx.waitall()
+
+    params = model.collect_params()
+    n_total = sum(int(onp.prod(p.shape)) for p in params.values())
+    n_embed = sum(int(onp.prod(p.shape)) for pn, p in params.items()
+                  if "embed" in pn.lower())
+    n_dense = n_total - n_embed + U * V
+    assert n_total > 100e6
+
+    def run_window():
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            step(tokens, segments, labels, batch_size=b)
+        import mxnet_tpu as _mx
+        _mx.waitall()
+        return b * t * ITERS / (time.perf_counter() - t0)
+
+    return run_window, n_dense, b, t
+
+
+def run_pair(pair):
+    a_name, b_name = PAIRS[pair]
+    run_a, nd_a, ba, ta = _build_step(a_name)
+    # surgery monkeypatches are process-global; a pair never mixes two
+    # different surgeries (base is always the A side), but B must build
+    # AFTER A so a surgery B-side patch doesn't leak into A's trace
+    run_b, nd_b, bb, tb = _build_step(b_name)
+
+    rows = []
+    ratios = []
+    for r in range(ROUNDS):
+        tok_a = run_a()
+        tok_b = run_b()
+        ratios.append(tok_b / tok_a)
+        rows.append({"round": r, a_name: round(tok_a), b_name: round(tok_b)})
+    ratios.sort()
+    med = ratios[len(ratios) // 2]
+
+    def mfu(tok, nd, t, attn=True):
+        return round(tok * _flops_per_token(nd, t, attn) / PEAK, 4)
+
+    out = {
+        "experiment": f"bert_t_scaling:{pair}",
+        "pair": [a_name, b_name],
+        "rounds": rows,
+        "median_ratio_b_over_a": round(med, 4),
+        "mfu_a": mfu(max(r[a_name] for r in rows), nd_a, ta),
+        "mfu_b": mfu(max(r[b_name] for r in rows), nd_b, tb,
+                     attn=not b_name.startswith("noattn")),
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--pair", default=None, choices=sorted(PAIRS))
+    p.add_argument("--output", default=None)
+    args = p.parse_args()
+
+    if args.pair:
+        run_pair(args.pair)
+        return
+
+    rows = []
+    for pair in PAIRS:
+        for attempt in range(2):
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--pair", pair],
+                capture_output=True, text=True, timeout=2400)
+            lines = [ln for ln in res.stdout.splitlines()
+                     if ln.startswith("{")]
+            if lines:
+                rows.append(json.loads(lines[-1]))
+                break
+            err = (res.stderr or "")[-400:]
+            print(json.dumps({"experiment": f"bert_t_scaling:{pair}",
+                              "error": err}), flush=True)
+            if "UNAVAILABLE" in err:
+                time.sleep(90)   # shared worker restart
+                continue
+            break
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

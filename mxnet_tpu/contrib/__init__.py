@@ -35,9 +35,9 @@ def div_sqrt_dim(data):
 
 def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
     """`_contrib_calibrate_entropy` (`src/operator/quantization/
-    calibrate.cc`): KL-minimizing threshold from an activation histogram.
-    Returns (min_threshold, max_threshold) like the reference (symmetric
-    around zero)."""
+    calibrate.cc:95-96`): KL-minimizing symmetric threshold from an
+    activation histogram.  Returns ``(threshold, divergence)`` — the
+    reference op's two outputs."""
     import numpy as _onp
 
     from .quantization import _entropy_threshold_from_hist
@@ -45,8 +45,9 @@ def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
     e = _onp.asarray(hist_edges.asnumpy()
                      if hasattr(hist_edges, "asnumpy") else hist_edges)
     amax = float(_onp.abs(e).max())
-    t = _entropy_threshold_from_hist(h, amax, num_quantized_bins)
-    return -t, t
+    t, kl = _entropy_threshold_from_hist(h, amax, num_quantized_bins,
+                                         return_divergence=True)
+    return t, kl
 
 
 def AdaptiveAvgPooling2D(data, output_size=1):  # noqa: N802

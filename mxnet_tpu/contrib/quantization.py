@@ -52,7 +52,8 @@ def calib_entropy_threshold(arr, num_bins=2048, num_quantized_bins=255):
     return _entropy_threshold_from_hist(hist, amax, num_quantized_bins)
 
 
-def _entropy_threshold_from_hist(hist, amax, num_quantized_bins=255):
+def _entropy_threshold_from_hist(hist, amax, num_quantized_bins=255,
+                                 return_divergence=False):
     num_bins = hist.size
     edges = onp.linspace(0.0, amax, num_bins + 1)
     best_kl, best_t = onp.inf, amax
@@ -85,7 +86,10 @@ def _entropy_threshold_from_hist(hist, amax, num_quantized_bins=255):
         kl = float((pm * onp.log(pm / qm)).sum())
         if kl < best_kl:
             best_kl, best_t = kl, float(t)
-    return max(best_t, 1e-8)
+    t = max(best_t, 1e-8)
+    if return_divergence:
+        return t, (best_kl if onp.isfinite(best_kl) else 0.0)
+    return t
 
 
 class _CalibCollector:

@@ -1188,12 +1188,22 @@ def adamw_update(weight, grad, mean, var, rescale_grad=1.0, lr=0.001,
                  beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
                  clip_gradient=-1.0, out=None):
     """AdamW with decoupled weight decay (`src/operator/contrib/adamw.cc:79`).
-    ``rescale_grad`` may be an NDArray (the reference passes the dynamic
-    loss-scale as a tensor input) — it folds into the gradient here, which
-    is the same math (scale applies before clipping in both)."""
+    ``rescale_grad`` may be an NDArray — the reference passes the dynamic
+    loss-scale as a tensor input and SKIPS the whole update (weight decay
+    and EMA state included) when it is 0 or non-finite, the overflow-step
+    contract of dynamic loss scaling (`adamw-inl.h:454`)."""
     if isinstance(rescale_grad, NDArray):
-        grad = grad * rescale_grad
-        rescale_grad = 1.0
+        new_w, new_mean, new_var = invoke(
+            _lm.adamw_update_dynamic,
+            (weight, grad, mean, var, rescale_grad),
+            dict(lr=_f(lr, 0.001), beta1=_f(beta1, 0.9),
+                 beta2=_f(beta2, 0.999), epsilon=_f(epsilon, 1e-8),
+                 wd=_f(wd, 0.0), eta=_f(eta, 1.0),
+                 clip_gradient=_f(clip_gradient, -1.0)),
+            name="adamw_update", differentiable=False)
+        _inplace(mean, new_mean)
+        _inplace(var, new_var)
+        return _ret(new_w, out if out is not None else _nd(weight))
     new_w, new_mean, new_var = invoke(
         _lm.adamw_update, (weight, grad, mean, var),
         dict(lr=_f(lr, 0.001), beta1=_f(beta1, 0.9), beta2=_f(beta2, 0.999),
@@ -1209,10 +1219,21 @@ def adamw_update(weight, grad, mean, var, rescale_grad=1.0, lr=0.001,
 def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad=1.0,
                     lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
                     eta=1.0, clip_gradient=-1.0, out=None):
-    """`src/operator/contrib/adamw.cc:34` — f32 master weights."""
+    """`src/operator/contrib/adamw.cc:34` — f32 master weights; tensor
+    loss-scale gets the same skip-on-overflow contract as adamw_update."""
     if isinstance(rescale_grad, NDArray):
-        grad = grad * rescale_grad
-        rescale_grad = 1.0
+        new_w, new_mean, new_var, new_w32 = invoke(
+            _lm.mp_adamw_update_dynamic,
+            (weight, grad, mean, var, weight32, rescale_grad),
+            dict(lr=_f(lr, 0.001), beta1=_f(beta1, 0.9),
+                 beta2=_f(beta2, 0.999), epsilon=_f(epsilon, 1e-8),
+                 wd=_f(wd, 0.0), eta=_f(eta, 1.0),
+                 clip_gradient=_f(clip_gradient, -1.0)),
+            name="mp_adamw_update", differentiable=False)
+        _inplace(mean, new_mean)
+        _inplace(var, new_var)
+        _inplace(weight32, new_w32)
+        return _ret(new_w, out if out is not None else _nd(weight))
     new_w, new_mean, new_var, new_w32 = invoke(
         _lm.mp_adamw_update, (weight, grad, mean, var, weight32),
         dict(lr=_f(lr, 0.001), beta1=_f(beta1, 0.9), beta2=_f(beta2, 0.999),

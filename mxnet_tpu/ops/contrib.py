@@ -714,19 +714,23 @@ def getnnz(data, axis=None):
     """`_contrib_getnnz` (`src/operator/contrib/nnz.cc`): stored-element
     count of a CSR array (axis=None -> scalar; axis=0/1 per col/row)."""
     import numpy as onp
+
+    from ..ndarray.ndarray import NDArray
     from ..ndarray.sparse import CSRNDArray
     if not isinstance(data, CSRNDArray):
         raise TypeError("getnnz expects a CSRNDArray")
     indptr = onp.asarray(data.indptr)
     indices = onp.asarray(data.indices)
     if axis is None:
-        return int(indices.size)
-    if axis == 1:
-        return onp.diff(indptr).astype(onp.int64)
-    if axis == 0:
-        return onp.bincount(indices,
-                            minlength=data.shape[1]).astype(onp.int64)
-    raise ValueError("axis must be None, 0, or 1")
+        res = onp.int64(indices.size)
+    elif axis == 1:
+        res = onp.diff(indptr).astype(onp.int64)
+    elif axis == 0:
+        res = onp.bincount(indices,
+                           minlength=data.shape[1]).astype(onp.int64)
+    else:
+        raise ValueError("axis must be None, 0, or 1")
+    return NDArray(jnp.asarray(res))
 
 
 def dynamic_reshape(data, shape):
@@ -746,9 +750,12 @@ def dynamic_reshape(data, shape):
 
 
 def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
-                       scale_width=None):
+                       scale_width=None, align_corners=True):
     """`_contrib_BilinearResize2D` (`src/operator/contrib/
-    bilinear_resize.cc`): NCHW bilinear resize via jax.image.  Each output
+    bilinear_resize-inl.h:101-124`): NCHW bilinear resize.  The reference
+    samples corner-aligned — src = dst·(in−1)/(out−1), output corners land
+    exactly on input corners — which jax.image's half-pixel convention
+    does not match, so the default path gathers explicitly.  Each output
     dim needs either its absolute size or its scale."""
     if height is None and scale_height is None:
         raise ValueError("bilinear_resize_2d needs height or scale_height")
@@ -761,6 +768,28 @@ def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
             h * scale_height))
         ow = int(width) if width is not None else int(round(
             w * scale_width))
-        return jax.image.resize(x, (n, c, oh, ow), method="bilinear")
+        if not align_corners:
+            return jax.image.resize(x, (n, c, oh, ow), method="bilinear")
+
+        def axis_coords(out_len, in_len):
+            if out_len == 1 or in_len == 1:
+                z = jnp.zeros((out_len,))
+                return z, z.astype(jnp.int32), z.astype(jnp.int32)
+            pos = jnp.arange(out_len) * ((in_len - 1) / (out_len - 1))
+            lo = jnp.floor(pos).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, in_len - 1)
+            return (pos - lo).astype(x.dtype), lo, hi
+
+        fy, y0, y1 = axis_coords(oh, h)
+        fx, x0, x1 = axis_coords(ow, w)
+
+        def interp_w(rows):                      # rows (N, C, oh, W)
+            a = jnp.take(rows, x0, axis=3)
+            b = jnp.take(rows, x1, axis=3)
+            return a + (b - a) * fx              # fx broadcasts on axis 3
+
+        top = interp_w(jnp.take(x, y0, axis=2))
+        bot = interp_w(jnp.take(x, y1, axis=2))
+        return top + (bot - top) * fy[:, None]
 
     return invoke(f, (data,), name="bilinear_resize_2d")

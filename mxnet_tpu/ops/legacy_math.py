@@ -619,6 +619,37 @@ def mp_adamw_update(weight, grad, mean, var, weight32, lr, beta1=0.9,
     return new_w32.astype(weight.dtype), new_mean, new_var, new_w32
 
 
+def adamw_update_dynamic(weight, grad, mean, var, scale, lr, beta1=0.9,
+                         beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                         clip_gradient=-1.0):
+    """AdamW with a TENSOR loss-scale (`adamw-inl.h:454`): when the scale
+    is 0 or non-finite (dynamic-loss-scaling overflow step) the reference
+    skips the update ENTIRELY — weight decay and the EMA state must not
+    advance either."""
+    s = scale.astype(jnp.float32).reshape(())
+    ok = jnp.isfinite(s) & (s != 0)
+    new_w, new_mean, new_var = adamw_update(
+        weight, grad, mean, var, lr, beta1, beta2, epsilon, wd, eta,
+        jnp.where(ok, s, 0.0), clip_gradient)
+    return (jnp.where(ok, new_w, weight),
+            jnp.where(ok, new_mean, mean),
+            jnp.where(ok, new_var, var))
+
+
+def mp_adamw_update_dynamic(weight, grad, mean, var, weight32, scale, lr,
+                            beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                            eta=1.0, clip_gradient=-1.0):
+    s = scale.astype(jnp.float32).reshape(())
+    ok = jnp.isfinite(s) & (s != 0)
+    new_w, new_mean, new_var, new_w32 = mp_adamw_update(
+        weight, grad, mean, var, weight32, lr, beta1, beta2, epsilon, wd,
+        eta, jnp.where(ok, s, 0.0), clip_gradient)
+    return (jnp.where(ok, new_w, weight),
+            jnp.where(ok, new_mean, mean),
+            jnp.where(ok, new_var, var),
+            jnp.where(ok, new_w32, weight32))
+
+
 def full_lamb_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
                      epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
                      rescale_grad=1.0, clip_gradient=-1.0,

@@ -36,6 +36,74 @@ def test_pipeline_matches_sequential(n_stages, microbatches):
         onp.abs(onp.asarray(got) - onp.asarray(expect)).max()
 
 
+@pytest.mark.parametrize("n_stages,microbatches", [(4, 4), (4, 8), (2, 4)])
+def test_pipeline_gradients_match_sequential(n_stages, microbatches):
+    """Round-4 verdict #4: the GPipe ring must be differentiable end to
+    end — gradients for EVERY stage's params through the scan+ppermute
+    schedule equal the sequential oracle's."""
+    d = 8
+    mesh = make_mesh({"pp": n_stages})
+    params = _stacked_params(jax.random.key(2), n_stages, d)
+    x = jax.random.normal(jax.random.key(3), (16, d))
+
+    def pp_loss(params, x):
+        y = pipeline_apply(_stage_fn, params, x, mesh,
+                           num_microbatches=microbatches)
+        return (y ** 2).sum()
+
+    def seq_loss(params, x):
+        h = x
+        for s in range(n_stages):
+            h = _stage_fn({"w": params["w"][s], "b": params["b"][s]}, h)
+        return (h ** 2).sum()
+
+    v1, g1 = jax.value_and_grad(pp_loss)(params, x)
+    v2, g2 = jax.value_and_grad(seq_loss)(params, x)
+    assert float(v1) == pytest.approx(float(v2), rel=1e-6)
+    for k in ("w", "b"):
+        err = float(jnp.abs(g1[k] - g2[k]).max())
+        assert err < 1e-5, (k, err)
+    # every stage received a real (nonzero) gradient — the ring carried
+    # cotangents all the way back to stage 0
+    per_stage = jnp.abs(g1["w"]).max(axis=(1, 2))
+    assert float(per_stage.min()) > 0
+
+
+def test_pipeline_training_trajectory_matches_sequential():
+    """GPipe microbatch training equals sequential training step for
+    step: run SGD on the pipelined loss and on the oracle loss from the
+    same init — the loss TRAJECTORIES must match, not just decrease."""
+    d, n_stages, steps = 8, 4, 8
+    mesh = make_mesh({"pp": n_stages})
+    x = jax.random.normal(jax.random.key(4), (16, d))
+    tgt = jax.random.normal(jax.random.key(5), (16, d)) * 0.1
+
+    def pp_loss(params):
+        y = pipeline_apply(_stage_fn, params, x, mesh, num_microbatches=4)
+        return ((y - tgt) ** 2).mean()
+
+    def seq_loss(params):
+        h = x
+        for s in range(n_stages):
+            h = _stage_fn({"w": params["w"][s], "b": params["b"][s]}, h)
+        return ((h - tgt) ** 2).mean()
+
+    lr = 0.2
+    traj = {}
+    for name, loss_fn in (("pp", pp_loss), ("seq", seq_loss)):
+        params = _stacked_params(jax.random.key(6), n_stages, d)
+        losses = []
+        vg = jax.jit(jax.value_and_grad(loss_fn))
+        for _ in range(steps):
+            v, g = vg(params)
+            losses.append(float(v))
+            params = jax.tree_util.tree_map(
+                lambda p, gg: p - lr * gg, params, g)
+        traj[name] = losses
+    assert traj["pp"] == pytest.approx(traj["seq"], rel=1e-5), traj
+    assert traj["pp"][-1] < traj["pp"][0]
+
+
 def test_pipeline_rejects_indivisible_batch():
     mesh = make_mesh({"pp": 4})
     params = _stacked_params(jax.random.key(0), 4, 4)

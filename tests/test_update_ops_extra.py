@@ -122,6 +122,59 @@ def test_sparse_adagrad_row_sparse_grad_and_wd_contract():
         mx.nd.sparse.adagrad_update(w, grad, h, lr=0.1, wd=0.1)
 
 
+def test_adamw_overflow_scale_skips_update_entirely():
+    """Dynamic loss scaling passes scale=0 (or inf/nan) on overflow steps;
+    the reference skips the WHOLE update — weight decay and EMA state must
+    not advance (`adamw-inl.h:454`)."""
+    for bad in (0.0, onp.inf, onp.nan):
+        w = mx.np.array(onp.ones(4), dtype="float32")
+        g = mx.np.array(onp.ones(4), dtype="float32")
+        m = mx.np.array(onp.full(4, 0.3), dtype="float32")
+        v = mx.np.array(onp.full(4, 0.2), dtype="float32")
+        out = mx.nd.adamw_update(w, g, m, v,
+                                 rescale_grad=mx.np.array([bad]),
+                                 lr=0.1, wd=0.01)
+        assert onp.allclose(out.asnumpy(), 1.0), (bad, out.asnumpy())
+        assert onp.allclose(m.asnumpy(), 0.3)
+        assert onp.allclose(v.asnumpy(), 0.2)
+    # mp variant: master weights must not move either
+    w = mx.np.array(onp.ones(4), dtype="float16")
+    w32 = mx.np.array(onp.ones(4), dtype="float32")
+    m = mx.np.zeros((4,))
+    v = mx.np.zeros((4,))
+    out = mx.nd.mp_adamw_update(w, mx.np.array(onp.ones(4), dtype="float16"),
+                                m, v, w32, rescale_grad=mx.np.array([0.0]),
+                                lr=0.1, wd=0.01)
+    assert onp.allclose(w32.asnumpy(), 1.0)
+    assert onp.allclose(out.asnumpy(), 1.0)
+
+
+def test_contrib_fixups_round5():
+    """calibrate_entropy returns (threshold, divergence); getnnz returns
+    NDArrays; BilinearResize2D is corner-aligned like the reference."""
+    rs = onp.random.RandomState(0)
+    hist, edges = onp.histogram(rs.randn(4096), bins=64)
+    t, kl = mx.nd.contrib.calibrate_entropy(
+        mx.np.array(hist.astype("f")), mx.np.array(edges.astype("f")))
+    assert t > 0 and kl >= 0
+
+    csr = mx.nd.sparse.csr_matrix(
+        (onp.array([1., 2., 3.], "float32"), onp.array([0, 2, 1]),
+         onp.array([0, 2, 2, 3])), shape=(3, 3))
+    total = mx.nd.contrib.getnnz(csr)
+    per_row = mx.nd.contrib.getnnz(csr, axis=1)
+    assert int(total.asnumpy()) == 3
+    assert per_row.asnumpy().tolist() == [2, 0, 1]
+
+    # corner alignment: output corners equal input corners exactly
+    x = mx.np.array(onp.arange(4, dtype="f").reshape(1, 1, 2, 2))
+    y = mx.nd.contrib.BilinearResize2D(x, height=4, width=4).asnumpy()
+    assert y[0, 0, 0, 0] == 0 and y[0, 0, 3, 3] == 3
+    assert y[0, 0, 0, 3] == 1 and y[0, 0, 3, 0] == 2
+    # interior is the (in-1)/(out-1) linear ramp
+    assert onp.allclose(y[0, 0, 0], [0, 1 / 3, 2 / 3, 1], atol=1e-6)
+
+
 def test_group_adagrad_per_row_history():
     w = mx.np.array(onp.ones((3, 2)), dtype="float32")
     g = mx.np.array(onp.array([[1., 1.], [0, 0], [2., 2.]], "float32"))
