@@ -1,0 +1,97 @@
+"""BASELINE config 1: Gluon LeNet on MNIST-shaped data, one chip.
+
+Trivial by FLOPs (the model is ~0.4 MFLOP/image forward) — the number
+this config actually measures is the framework's per-step overhead at
+small scale: Gluon model → FusedTrainStep → one donated XLA program.
+Drained windows, bf16.  Reference entrypoint: `example/gluon/mnist.py`
+(ctx=mx.gpu() → the TPU context here).
+
+Usage: python benchmark/lenet_mnist_bench.py [--batch 256] [--output F]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+WARMUP = 5
+ITERS = 30
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--output", default=None)
+    args = p.parse_args()
+    b = args.batch
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import FusedTrainStep, Trainer, nn
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(20, kernel_size=5, activation="tanh"),
+            nn.MaxPool2D(pool_size=2, strides=2),
+            nn.Conv2D(50, kernel_size=5, activation="tanh"),
+            nn.MaxPool2D(pool_size=2, strides=2),
+            nn.Flatten(),
+            nn.Dense(500, activation="tanh"),
+            nn.Dense(10))
+    net.initialize()
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+
+    class WithLoss(HybridBlock):
+        def __init__(self, m):
+            super().__init__()
+            self.m = m
+            self.loss = gloss.SoftmaxCrossEntropyLoss()
+
+        def forward(self, x, y):
+            return self.loss(self.m(x), y).mean()
+
+    mod = WithLoss(net)
+    x = mx.np.array(onp.random.rand(b, 1, 28, 28), dtype=args.dtype)
+    y = mx.np.array(onp.random.randint(0, 10, (b,)), dtype="int32")
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9})
+    step = FusedTrainStep(mod, trainer)
+
+    for _ in range(WARMUP):
+        loss = step(x, y, batch_size=b)
+    loss.wait_to_read()
+    mx.waitall()
+
+    windows = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            step(x, y, batch_size=b)
+        mx.waitall()
+        windows.append(b * ITERS / (time.perf_counter() - t0))
+
+    result = {
+        "metric": "lenet_mnist_train_imgs_per_s",
+        "value": round(max(windows)),
+        "unit": "imgs/s",
+        "dtype": args.dtype, "batch": b,
+        "window_imgs_per_s": [round(w) for w in windows],
+        "steps_per_s": round(max(windows) / b, 1),
+    }
+    line = json.dumps(result)
+    print(line, flush=True)
+    if args.output:
+        with open(args.output, "a") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,27 @@ from ..ops.invoke import invoke
 
 __all__ = ["NDArray", "array", "empty", "from_jax", "waitall"]
 
+# Large-tensor stance (reference builds with USE_INT64_TENSOR_SIZE,
+# CMakeLists.txt:84 region; nightly fence tests/nightly/test_large_array.py):
+# arrays may exceed 2^31 elements — XLA tracks shapes/sizes in 64 bits,
+# so creation, elementwise ops, reductions, and slices STARTING below
+# the boundary (any length) work above it (fenced by
+# tests/test_large_tensor.py on the host backend; 16 GB HBM bounds
+# TPU-resident arrays to ~the boundary for int8/bf16 anyway).  What
+# cannot cross 2^31 is a POSITION operand — an element index or slice
+# start: jax runs in 32-bit index mode, where gather would
+# OverflowError deep in dispatch and scatter SILENTLY DROPS writes on
+# any >2^31-element operand, so NDArray indexing raises this IndexError
+# up front instead.  Arithmetic dtypes cap at 32 bits in the same mode
+# (an int64 compute request truncates to int32 with a jax warning) —
+# 64-bit here means sizes/shapes, not accumulator width; use f32/f64
+# accumulation for boundary-crossing reductions.
+_INT64_INDEX_MSG = (
+    "index position beyond 2^31-1 is not supported (32-bit index mode); "
+    "whole-array ops and below-boundary slice starts on >2^31-element "
+    "arrays ARE supported — see tests/test_large_tensor.py for the "
+    "boundary contract")
+
 
 class NDArray:
     _slots = (
@@ -312,20 +333,74 @@ class NDArray:
             return key._data
         return key
 
+    def _check_index_bounds(self, key):
+        """Positional access that RESOLVES past 2^31-1 must fail loudly:
+        jax's 32-bit index mode would otherwise OverflowError deep in
+        dispatch (gather) or, worse, silently clamp (scatter) — see
+        _INT64_INDEX_MSG.  Negative forms resolve against the dim."""
+        lim = 2 ** 31 - 1
+
+        def resolve(v, dim):
+            v = int(v)
+            return v + dim if (v < 0 and dim is not None) else v
+
+        keys = key if isinstance(key, tuple) else (key,)
+        # map key elements to axes the way numpy does: None (newaxis)
+        # consumes no input axis, Ellipsis consumes the unmatched middle
+        n_explicit = sum(1 for k in keys
+                         if k is not None and k is not Ellipsis)
+        axis = 0
+        dims = []
+        for k in keys:
+            if k is None:
+                dims.append(None)
+            elif k is Ellipsis:
+                dims.append(None)
+                axis += max(len(self.shape) - n_explicit, 0)
+            else:
+                dims.append(self.shape[axis]
+                            if axis < len(self.shape) else None)
+                axis += 1
+        for k, dim in zip(keys, dims):
+            if k is None or k is Ellipsis:
+                continue
+            if isinstance(k, (int, onp.integer)):
+                if resolve(k, dim) > lim:
+                    raise IndexError(_INT64_INDEX_MSG)
+            elif isinstance(k, slice):
+                # the slice START becomes a 32-bit dynamic_slice operand;
+                # a large STOP with a small start only sets the (64-bit
+                # static) size, so a[:huge] stays legal
+                if k.start is not None and resolve(k.start, dim) > lim:
+                    raise IndexError(_INT64_INDEX_MSG)
+
     def __getitem__(self, key):
+        self._check_index_bounds(key)
         k = self._index_data(key)
-        return invoke(lambda x: x[k], (self,), name="getitem")
+        try:
+            return invoke(lambda x: x[k], (self,), name="getitem")
+        except OverflowError:
+            raise IndexError(_INT64_INDEX_MSG) from None
 
     def __setitem__(self, key, value):
+        # scatter on a >2^31-element array silently NO-OPS in 32-bit
+        # index mode (jax truncates the index dtype and the write is
+        # dropped, at any position — probed in tests/test_large_tensor.py)
+        if self.size > 2 ** 31 - 1:
+            raise IndexError(_INT64_INDEX_MSG)
+        self._check_index_bounds(key)
         k = self._index_data(key)
-        if isinstance(value, NDArray):
-            def setter(x, v):
-                return x.at[k].set(v.astype(x.dtype))
-            self._rebind(invoke(setter, (self, value), name="setitem"))
-        else:
-            def setter(x):
-                return x.at[k].set(value)
-            self._rebind(invoke(setter, (self,), name="setitem"))
+        try:
+            if isinstance(value, NDArray):
+                def setter(x, v):
+                    return x.at[k].set(v.astype(x.dtype))
+                self._rebind(invoke(setter, (self, value), name="setitem"))
+            else:
+                def setter(x):
+                    return x.at[k].set(value)
+                self._rebind(invoke(setter, (self,), name="setitem"))
+        except OverflowError:
+            raise IndexError(_INT64_INDEX_MSG) from None
 
     # ------------------------------------------------------------------
     # shape ops (delegate to jnp through the dispatcher)
