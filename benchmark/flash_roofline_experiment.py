@@ -143,31 +143,54 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--seq-lens", default="4096,8192")
     p.add_argument("--output", default=None)
+    p.add_argument("--blocks", action="store_true",
+                   help="sweep production-kernel block shapes instead of "
+                        "the ablation kernels: wider K blocks mean fewer "
+                        "per-block VPU reduction/rescale passes (the 49%% "
+                        "softmax share the ablations measured)")
+    p.add_argument("--grad", action="store_true",
+                   help="with --blocks: time fwd+bwd instead of fwd")
     args = p.parse_args()
 
     import jax.numpy as jnp
 
     from mxnet_tpu.ops import pallas_kernels as pk
 
-    variants = {
-        "full": lambda q, k, v: pk._flash(q, k, v, False, None, None,
-                                          None, None),
-        "probe_ref": _variant_kernel("ref"),
-        "noexp": _variant_kernel("noexp"),
-        "nosoftmax": _variant_kernel("nosoftmax"),
-        "bf16exp": _variant_kernel("bf16exp"),
-    }
+    if args.blocks:
+        def prod(bq, bk):
+            return lambda q, k, v: pk._flash(q, k, v, False, None,
+                                             bq, bk, None)
+        variants = {
+            "bq512_bk512": prod(512, 512),
+            "bq512_bk1024": prod(512, 1024),
+            "bq256_bk1024": prod(256, 1024),
+            "bq512_bk2048": prod(512, 2048),
+            "bq256_bk2048": prod(256, 2048),
+            "bq1024_bk512": prod(1024, 512),
+        }
+    else:
+        variants = {
+            "full": lambda q, k, v: pk._flash(q, k, v, False, None, None,
+                                              None, None),
+            "probe_ref": _variant_kernel("ref"),
+            "noexp": _variant_kernel("noexp"),
+            "nosoftmax": _variant_kernel("nosoftmax"),
+            "bf16exp": _variant_kernel("bf16exp"),
+        }
 
     rows = []
     for t in (int(x) for x in args.seq_lens.split(",")):
         qkv = [jnp.asarray(onp.random.randn(B, H, t, D), jnp.bfloat16)
                for _ in range(3)]
         flops = 4.0 * B * H * t * t * D
+        if args.grad:
+            flops *= 3.5   # dq + dkv recompute + deltas, approx
+        kind = "fwd_bwd" if args.grad else "fwd"
         for name, impl in variants.items():
             try:
-                ms, n, ok = scan_ms(impl, qkv, grad=False)
+                ms, n, ok = scan_ms(impl, qkv, grad=args.grad)
                 rows.append({
-                    "metric": f"flash_roofline_{name}_fwd_ms",
+                    "metric": f"flash_roofline_{name}_{kind}_ms",
                     "seq_len": t, "value": round(ms, 3), "unit": "ms",
                     "tf_per_s": round(flops / (ms / 1e3) / 1e12, 1),
                     "scan_len": n, "reliable": ok,
@@ -176,6 +199,11 @@ def main():
                 rows.append({"metric": f"flash_roofline_{name}_error",
                              "seq_len": t, "error": str(e)[:160]})
             print(json.dumps(rows[-1]), flush=True)
+    if args.blocks:
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(rows, f, indent=1)
+        return
     # bf16exp accuracy vs the f32-exp probe (same ablation harness, so
     # the only difference IS the exp dtype)
     qkv = [jnp.asarray(onp.random.randn(B, H, 2048, D), jnp.bfloat16)

@@ -8,10 +8,11 @@ projection batched OUTSIDE the scan (one MXU matmul over all T,
 is only the h→h recurrence.  This bench measures the classic
 example/rnn "medium" word-LM shape — emb 650, 2×LSTM(650), tied-free
 vocab head, bptt 35 — train step via FusedTrainStep, bf16, drained
-windows (see bench.py for the tunnel sync rationale).
+windows (the repo-root ``bench.py`` documents the tunnel sync rationale).
 
-Where scan-RNN lands vs the roofline (see results + RNN_LM_ANALYSIS
-section in BERT_ANALYSIS.md):
+Where scan-RNN lands vs the roofline (committed chip numbers:
+``results/rnn_lm_tpu_v5e.json``; discussion in BERT_ANALYSIS.md
+"Config 5" section):
 
 - per-token train FLOPs = 3·2·[Σ_l 4H(in_l+H) + H·V] (3 = fwd + 2×bwd)
 - the h→h matmul (B, H)x(H, 4H) inside the scan serializes over T

@@ -110,6 +110,26 @@ class MultiHeadAttention(HybridBlock):
         self.proj = nn.Dense(units, flatten=False, use_bias=use_bias,
                              weight_initializer=init_std, dtype=dtype)
         self.attn_dropout = nn.Dropout(dropout)
+        self._sp_mesh = None
+        self._sp_axis = "sp"
+        self._sp_batch_axis = None
+
+    def bind_sp_mesh(self, mesh, axis_name="sp", batch_axis=None):
+        """Sequence parallelism: route attention through
+        `parallel.ring_attention` — the T axis of the incoming activations
+        is (to be) sharded over ``mesh[axis_name]``, K/V blocks rotate on
+        the ICI ring, and with flash eligible each ring step runs the
+        Pallas kernel (lse-merged).  Composes with ``use_flash`` and the
+        encoder-level ``remat`` boundary — the three long-context levers
+        stack (benchmark/ATTENTION_ANALYSIS.md, recipe section).
+        Attention dropout and masks are excluded, like the flash kernel."""
+        if self._attn_dropout_rate > 0:
+            raise ValueError("sequence parallelism excludes attention "
+                             "dropout; set dropout=0")
+        self._sp_mesh = mesh
+        self._sp_axis = axis_name
+        self._sp_batch_axis = batch_axis
+        return self
 
     def _flash_now(self, t, mask):
         """Resolve the use_flash policy for this call (T is trace-static,
@@ -142,6 +162,27 @@ class MultiHeadAttention(HybridBlock):
         q = self.query(x).reshape(b, t, h, d)
         k = self.key(x).reshape(b, t, h, d)
         v = self.value(x).reshape(b, t, h, d)
+        if self._sp_mesh is not None:
+            if mask is not None:
+                raise ValueError("sequence-parallel attention cannot "
+                                 "apply masks (ring kernel contract)")
+            from ..parallel.ring_attention import ring_attention
+            # flash inside the ring: forced True honors it (and raises on
+            # kernel-contract violations, same as single-chip); auto
+            # requires TPU AND the per-ring-step block length (T / sp) to
+            # satisfy the kernel's divisibility contract — the crossover
+            # itself is considered passed (sp is chosen because T is long)
+            t_local = t // self._sp_mesh.shape[self._sp_axis]
+            flash = (self._use_flash is True or
+                     (self._use_flash == "auto" and _on_tpu() and
+                      (t_local <= 128 or t_local % 128 == 0)))
+            out = ring_attention(
+                q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
+                mesh=self._sp_mesh, axis_name=self._sp_axis,
+                causal=False, batch_axis=self._sp_batch_axis,
+                use_flash=flash)
+            out = out.swapaxes(1, 2).reshape(b, t, h * d)
+            return self.proj(out)
         if self._flash_now(t, mask):
             if mask is not None:
                 raise ValueError(
@@ -202,6 +243,10 @@ class TransformerEncoderLayer(HybridBlock):
         self.ffn_ln = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
         self.dropout = nn.Dropout(dropout)
 
+    def bind_sp_mesh(self, mesh, axis_name="sp", batch_axis=None):
+        self.attention.bind_sp_mesh(mesh, axis_name, batch_axis)
+        return self
+
     def forward(self, x, mask=None):
         x = self.attn_ln(x + self.dropout(self.attention(x, mask)))
         x = self.ffn_ln(x + self.ffn(x))
@@ -227,6 +272,15 @@ class TransformerEncoder(HybridBlock):
                                             layer_norm_eps=layer_norm_eps,
                                             dtype=dtype,
                                             use_flash=use_flash))
+
+    def bind_sp_mesh(self, mesh, axis_name="sp", batch_axis=None):
+        """Bind every layer's attention to the sp ring (see
+        MultiHeadAttention.bind_sp_mesh); composes with ``remat`` — the
+        checkpoint boundary wraps the ring step like any other layer."""
+        for i in range(self._num_layers):
+            getattr(self, f"layer{i}").bind_sp_mesh(mesh, axis_name,
+                                                    batch_axis)
+        return self
 
     def forward(self, x, mask=None):
         for i in range(self._num_layers):
@@ -274,6 +328,15 @@ class BertModel(HybridBlock):
         self.pooler = nn.Dense(units, flatten=False, activation="tanh",
                                weight_initializer=init_std, dtype=dtype)
 
+    def bind_sp_mesh(self, mesh, axis_name="sp", batch_axis=None):
+        """The long-context recipe, one call: attention rides the sp ring
+        (flash per ring step where eligible), composing with
+        ``use_flash`` and ``remat`` — construct with
+        ``BertModel(use_flash=..., remat=True)`` then bind.  Requires
+        dropout=0 (ring/flash kernel contract)."""
+        self.encoder.bind_sp_mesh(mesh, axis_name, batch_axis)
+        return self
+
     def forward(self, tokens, segments=None, valid_mask=None):
         b, t = tokens.shape
         x = self.word_embed(tokens)
@@ -304,6 +367,10 @@ class BertForPretraining(HybridBlock):
                                   shape=(self.bert.word_embed._input_dim,),
                                   init=init.Zero())
         self.nsp = nn.Dense(2, flatten=False, weight_initializer=init_std)
+
+    def bind_sp_mesh(self, mesh, axis_name="sp", batch_axis=None):
+        self.bert.bind_sp_mesh(mesh, axis_name, batch_axis)
+        return self
 
     def forward(self, tokens, segments=None, valid_mask=None):
         seq, pooled = self.bert(tokens, segments, valid_mask)

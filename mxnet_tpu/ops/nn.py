@@ -472,8 +472,33 @@ def dropout(data, key, p=0.5, axes=None, mode="training"):
             if i not in axes:
                 shape[i] = 1
     keep = 1.0 - p
-    mask = jax.random.bernoulli(key, keep, tuple(shape))
+    mask = jax.random.bernoulli(_dropout_key(key), keep, tuple(shape))
     return jnp.where(mask, data / keep, 0).astype(data.dtype)
+
+
+def _dropout_key(key, impl=None):
+    """Dropout mask bits come from the XLA hardware RNG (`rbg`) by
+    default: threefry mask generation measured as 28% of a BERT-base
+    train step at T=128 and 43% at T=512 — switching the BULK draw to
+    RngBitGenerator recovered nearly all of it
+    (benchmark/results/bert_t_scaling_tpu_v5e.json, rbg/ drop pairs;
+    BERT_ANALYSIS.md round-5 section).  The key STREAM stays threefry
+    (cheap scalar fold_ins); only the per-site key re-wraps.  Same
+    Bernoulli marginals; bits are backend-stable but differ from the
+    threefry stream — set MXNET_DROPOUT_RNG=threefry for the old bits
+    (``impl`` overrides the env var; benchmarks pin it).  Reference
+    analogue: dropout uses the cuDNN/GPU hardware RNG, not the CPU one
+    (`src/operator/nn/dropout-inl.h`)."""
+    import os
+    if impl is None:
+        impl = os.environ.get("MXNET_DROPOUT_RNG", "rbg")
+    if impl != "rbg":
+        return key
+    kd = jax.random.key_data(key).ravel()
+    if kd.size >= 4:        # already an rbg-layout key: no re-wrap
+        return key
+    return jax.random.wrap_key_data(
+        jnp.tile(kd, 2)[:4].astype(jnp.uint32), impl="rbg")
 
 
 # ---------------------------------------------------------------------------

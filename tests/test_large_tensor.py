@@ -32,7 +32,7 @@ def _enough_ram():
 
 
 pytestmark = pytest.mark.skipif(
-    not _enough_ram() or os.environ.get("MX_SKIP_LARGE_TENSOR"),
+    bool(not _enough_ram() or os.environ.get("MX_SKIP_LARGE_TENSOR")),
     reason="needs ~8 GB free RAM for the >2^31-element arrays")
 
 

@@ -120,3 +120,91 @@ def test_bert_forward_ulysses_attention_matches_dense():
     onp.testing.assert_allclose(onp.asarray(out_sp),
                                 onp.asarray(dense(q, k, v)),
                                 rtol=2e-4, atol=1e-5)
+
+
+def test_long_context_recipe_levers_stack():
+    """Round-4 verdict #8: flash + remat + sp composed through ONE
+    configuration — `BertForPretraining(use_flash=..., remat=True)
+    .bind_sp_mesh(mesh)` driven by `FusedTrainStep(mesh=...)`, the
+    product recipe — must reproduce the plain single-device training
+    step: same loss, same updated weights.  The attention rides
+    `ring_attention(use_flash=True)` (per-ring-step Pallas kernel in
+    interpret mode on this CPU mesh), every encoder layer sits behind an
+    npx.remat boundary (inlined into the mesh-spanning fused program —
+    an EAGER remat boundary is a single-device jit and cannot contain
+    the 2-device ring), and the sequence axis is sharded sp=2 via
+    data_spec=P(None, 'sp')."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import FusedTrainStep, Trainer
+
+    b, t, vocab = 2, 256, 64
+
+    def build(remat, sp, flash):
+        onp.random.seed(7)
+        mx.random.seed(7)
+        m = BertForPretraining(vocab_size=vocab, units=16, hidden_size=32,
+                               num_layers=2, num_heads=2, max_length=t,
+                               dropout=0.0, use_flash=flash, remat=remat)
+        m.initialize()
+        m(mx.np.zeros((1, 4), dtype="int32"),
+          mx.np.zeros((1, 4), dtype="int32"))
+        if sp:
+            mesh = pmesh.make_mesh({"sp": 2}, devices=jax.devices()[:2])
+            m.bind_sp_mesh(mesh)
+            return m, mesh
+        return m, None
+
+    class PretrainLoss(gluon.HybridBlock):
+        def __init__(self, m):
+            super().__init__()
+            self.m = m
+
+        def forward(self, tokens, segments):
+            mlm, nsp = self.m(tokens, segments)
+            return (mlm.astype("float32") ** 2).mean() + \
+                (nsp.astype("float32") ** 2).mean()
+
+    tokens = mx.np.array(
+        onp.random.RandomState(1).randint(0, vocab, (b, t)), dtype="int32")
+    segments = mx.np.zeros((b, t), dtype="int32")
+
+    def one_step(m, mesh):
+        trainer = Trainer(m.collect_params(), "sgd",
+                          {"learning_rate": 0.1})
+        kw = {}
+        if mesh is not None:
+            kw = {"mesh": mesh, "data_spec": P(None, "sp")}
+        step = FusedTrainStep(PretrainLoss(m), trainer, **kw)
+        loss = step(tokens, segments, batch_size=b)
+        weights = {k: p.data().asnumpy()
+                   for k, p in sorted(m.collect_params().items())}
+        return float(loss.asnumpy()), weights
+
+    base, _ = build(remat=False, sp=False, flash=False)
+    base_loss, base_w = one_step(base, None)
+    # all three levers on.  Weights copy explicitly: deferred init under
+    # the remat trace draws from the traced key stream, so seeding alone
+    # does not reproduce the same init
+    full, mesh = build(remat=True, sp=True, flash=True)
+    rebuilt, _m0 = build(remat=False, sp=False, flash=False)
+    for k, p in rebuilt.collect_params().items():
+        full.collect_params()[k].set_data(p.data())
+    full_loss, full_w = one_step(full, mesh)
+    onp.testing.assert_allclose(full_loss, base_loss, rtol=2e-5)
+    assert base_w.keys() == full_w.keys()
+    for k in base_w:
+        onp.testing.assert_allclose(
+            full_w[k], base_w[k], rtol=5e-4, atol=2e-5,
+            err_msg=f"updated weight {k} diverged with the levers "
+                    "stacked")
+
+
+def test_sp_mesh_rejects_attention_dropout():
+    import pytest as _pt
+
+    m = BertForPretraining(vocab_size=32, units=16, hidden_size=32,
+                           num_layers=1, num_heads=2, max_length=16,
+                           dropout=0.1)
+    mesh = pmesh.make_mesh({"sp": 2}, devices=jax.devices()[:2])
+    with _pt.raises(ValueError, match="dropout"):
+        m.bind_sp_mesh(mesh)
