@@ -296,33 +296,56 @@ def _bench_ab(batch):
                       dtype="uint8")
     y = mx.np.array(rs.randint(0, 1000, (batch,)), dtype="int32")
 
+    # leg C (round-4 verdict weak #5): the device-resident RECORDIO step —
+    # a real JPEG-decoded batch through the same prologue program,
+    # interleaved in this same window.  Closes the last cross-window gap:
+    # round-4's `chip_only` was measured in a different window than the
+    # headline and sat 16% under it, bracketed only by inference.
+    rec_it = mx.io.ImageRecordIter(
+        path_imgrec=_ensure_bench_rec(), batch_size=batch,
+        data_shape=(3, 224, 224), rand_crop=True, rand_mirror=True,
+        shuffle=True)
+    data_rec, labels_rec = rec_it.next_arrays()
+    x_c = mx.np.array(data_rec)               # uint8 NHWC, device-resident
+    y_c = mx.np.array(labels_rec.astype(onp.int32))
+    rec_it.close()
+
     for _ in range(WARMUP):
         fused_a(x_a, y, batch_size=batch)
         fused_b(x_b, y, batch_size=batch)
+        fused_b(x_c, y_c, batch_size=batch)
     mx.waitall()
 
-    def window(fused, x):
+    def window(fused, x, yy):
         t0 = time.perf_counter()
         for _ in range(AB_ITERS):
-            fused(x, y, batch_size=batch)
+            fused(x, yy, batch_size=batch)
         mx.waitall()
         return batch * AB_ITERS / (time.perf_counter() - t0)
 
-    rates_a, rates_b, ratios = [], [], []
+    rates_a, rates_b, rates_c, ratios, ratios_c = [], [], [], [], []
     for _round in range(AB_ROUNDS):
-        ra = window(fused_a, x_a)
-        rb = window(fused_b, x_b)
+        ra = window(fused_a, x_a, y)
+        rb = window(fused_b, x_b, y)
+        rc = window(fused_b, x_c, y_c)
         rates_a.append(ra)
         rates_b.append(rb)
+        rates_c.append(rc)
         ratios.append(rb / ra)
+        ratios_c.append(rc / ra)
     ratios.sort()
-    med_ratio = ratios[len(ratios) // 2]
+    ratios_c.sort()
     return {
         "ab_synthetic_img_per_s": round(max(rates_a), 2),
         "ab_prologue_img_per_s": round(max(rates_b), 2),
+        "ab_chip_only_img_per_s": round(max(rates_c), 2),
         "ab_rounds_synthetic": [round(r, 2) for r in rates_a],
         "ab_rounds_prologue": [round(r, 2) for r in rates_b],
-        "ab_prologue_over_synthetic": round(med_ratio, 4),
+        "ab_rounds_chip_only": [round(r, 2) for r in rates_c],
+        "ab_prologue_over_synthetic": round(
+            ratios[len(ratios) // 2], 4),
+        "ab_chip_only_over_synthetic": round(
+            ratios_c[len(ratios_c) // 2], 4),
     }
 
 
@@ -467,7 +490,9 @@ def main():
         try:
             ab = run_mode("ab", timeout=ab_timeout)
             for k in ("ab_synthetic_img_per_s", "ab_prologue_img_per_s",
-                      "ab_prologue_over_synthetic"):
+                      "ab_prologue_over_synthetic",
+                      "ab_chip_only_img_per_s",
+                      "ab_chip_only_over_synthetic"):
                 result[k] = ab[k]
         except Exception as e:
             result["ab_error"] = str(e)[:200]

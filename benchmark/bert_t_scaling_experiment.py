@@ -294,11 +294,15 @@ def main():
                 rows.append(json.loads(lines[-1]))
                 break
             err = (res.stderr or "")[-400:]
-            print(json.dumps({"experiment": f"bert_t_scaling:{pair}",
-                              "error": err}), flush=True)
-            if "UNAVAILABLE" in err:
+            err_row = {"experiment": f"bert_t_scaling:{pair}",
+                       "error": err}
+            print(json.dumps(err_row), flush=True)
+            if "UNAVAILABLE" in err and attempt == 0:
                 time.sleep(90)   # shared worker restart
                 continue
+            # a failed re-run must not leave the pair's STALE row in the
+            # artifact looking fresh — the error row replaces it
+            rows.append(err_row)
             break
     if args.output:
         merged = rows

@@ -62,6 +62,13 @@ def _on_tpu():
     return jax.default_backend() == "tpu"
 
 
+def _flash_shape_ok(t):
+    """The Pallas kernel's shape contract (single source for the single-
+    chip policy and the sp ring's per-step check): T must be <=128 or a
+    multiple of 128 (ops/pallas_kernels._resolve divisibility)."""
+    return t <= 128 or t % 128 == 0
+
+
 class MultiHeadAttention(HybridBlock):
     """Scaled dot-product multi-head attention.
 
@@ -152,8 +159,7 @@ class MultiHeadAttention(HybridBlock):
                      else FLASH_AUTO_MIN_T)
             return (_on_tpu() and mask is None and
                     self._attn_dropout_rate == 0 and
-                    t >= min_t and
-                    (t <= 128 or t % 128 == 0))
+                    t >= min_t and _flash_shape_ok(t))
         return bool(self._use_flash)
 
     def forward(self, x, mask=None):
@@ -175,7 +181,7 @@ class MultiHeadAttention(HybridBlock):
             t_local = t // self._sp_mesh.shape[self._sp_axis]
             flash = (self._use_flash is True or
                      (self._use_flash == "auto" and _on_tpu() and
-                      (t_local <= 128 or t_local % 128 == 0)))
+                      _flash_shape_ok(t_local)))
             out = ring_attention(
                 q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
                 mesh=self._sp_mesh, axis_name=self._sp_axis,

@@ -11,10 +11,13 @@ chips).
 
 Design notes (benchmark/ATTENTION_ANALYSIS.md has the measurements):
 
-- **Blocks auto-size to 512** (largest power-of-two divisor of T from a
-  512 target).  The round-3 kernel used 128x128 blocks: at T=8192 that
-  is ~131k grid invocations of tiny matmuls, and Mosaic's per-iteration
-  overhead alone (~1 us) explained the whole measured 115 ms.
+- **Blocks auto-size to q=512, k=1024** (largest power-of-two divisor
+  of T from those targets).  The round-3 kernel used 128x128 blocks: at
+  T=8192 that is ~131k grid invocations of tiny matmuls, and Mosaic's
+  per-iteration overhead alone (~1 us) explained the whole measured
+  115 ms.  Round 5's sweep found wide K blocks amortize the per-block
+  VPU softmax chain (49% of kernel time at 512x512): bk=1024 lifts fwd
+  from 39 to 67 TF/s (see _BLOCK_TARGET_K note).
 - **Dots run in the input dtype** (bf16 in production) with f32
   accumulation via `preferred_element_type` — upcasting q/k/v to f32
   *before* the dot quarters the MXU rate.  Tests feed f32 and stay
@@ -46,7 +49,17 @@ from .invoke import invoke
 __all__ = ["flash_attention", "flash_attention_with_lse"]
 
 _NEG_INF = -1e30
-_BLOCK_TARGET = 512
+# Default block targets, measured (benchmark/results/
+# flash_roofline_tpu_v5e.json block sweep): K blocks of 1024 beat 512 by
+# 1.68x fwd / 1.36x fwd+bwd at T=4096-8192 — the ablations attribute the
+# old kernel's gap to the per-block VPU softmax chain (49% of kernel
+# time), which wider K rows amortize (half the m/l merge + acc-rescale
+# rounds, better row-reduction vectorization).  Wider q blocks do
+# nothing (1024x512 ~= 512x512): the q loop is the outer grid, its
+# per-block work is already amortized.  bk=2048 ties 1024 within noise
+# and costs 2x the VMEM for the f32 score block — 1024 is the default.
+_BLOCK_TARGET_Q = 512
+_BLOCK_TARGET_K = 1024
 
 
 def _prec(dt):
@@ -108,8 +121,10 @@ def _sds(shape, dtype, like):
 
 
 def _resolve(t, d, block_q, block_k, scale, interpret):
-    bq = _pick_block(t, _BLOCK_TARGET) if block_q is None else min(block_q, t)
-    bk = _pick_block(t, _BLOCK_TARGET) if block_k is None else min(block_k, t)
+    bq = _pick_block(t, _BLOCK_TARGET_Q) if block_q is None \
+        else min(block_q, t)
+    bk = _pick_block(t, _BLOCK_TARGET_K) if block_k is None \
+        else min(block_k, t)
     if t % bq or t % bk:
         raise ValueError(
             f"block sizes ({bq}, {bk}) must divide sequence length {t}; "
@@ -468,9 +483,10 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     Exact attention; the full score matrix is never materialized, in
     forward or backward (both are Pallas kernels streaming K/V blocks —
     memory stays O(T * block) against dense's O(T^2)).  Block sizes
-    default to the largest power-of-two divisor of T up to 512; T must
-    be divisible by the blocks (pad and mask upstream otherwise — same
-    contract as the reference's fused kernels).
+    default to the largest power-of-two divisors of T up to 512 (q) and
+    1024 (k) — measured optimum, see module notes; T must be divisible
+    by the blocks (pad and mask upstream otherwise — same contract as
+    the reference's fused kernels).
 
     Validated exact on real TPU (vs XLA dense).  When the (T, T) score
     matrix FITS in HBM comfortably, plain XLA attention is still faster
