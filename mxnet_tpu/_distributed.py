@@ -55,4 +55,48 @@ def init_from_env():
     # raises "not fully addressable" on first use.  Pin the per-process
     # default to the first local device (the multi-controller contract).
     jax.config.update("jax_default_device", jax.local_devices()[0])
+    _maybe_profile_rank(spec[2])
     return True
+
+
+def _maybe_profile_rank(rank):
+    """Remote-rank profiling (reference analogue: rank 0 switches a
+    server's profiler over a kvstore command, `src/kvstore/
+    kvstore_dist.h:99`).  In SPMD there is no server role, so the
+    launcher carries the request instead: `tools/launch.py
+    --profile-rank N [--profile-dir D]` sets MXNET_PROFILE_RANK /
+    MXNET_PROFILE_DIR for every worker, and the matching rank starts the
+    profiler here and dumps `D/profile_rank{N}.json` (chrome://tracing)
+    at exit.  MXNET_PROFILE_RANK=-1 profiles every rank."""
+    import os
+    import warnings
+    want = os.environ.get("MXNET_PROFILE_RANK")
+    if want is None:
+        return
+    try:
+        want = int(want)
+    except ValueError:
+        # same warn-don't-crash contract as read_env(): a malformed env
+        # var must not make the package unimportable
+        warnings.warn(f"MXNET_PROFILE_RANK={want!r} is not an integer; "
+                      "profiling request ignored")
+        return
+    if want != -1 and want != rank:
+        return
+    import atexit
+
+    from . import profiler
+    out_dir = os.environ.get("MXNET_PROFILE_DIR", ".")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"profile_rank{rank}.json")
+    profiler.set_config(filename=path, profile_all=True)
+    profiler.set_state("run")
+
+    def _dump():
+        try:
+            profiler.set_state("stop")
+            profiler.dump()
+        except Exception as e:   # teardown must not fail the worker,
+            warnings.warn(       # but silence would hide a lost trace
+                f"profiler dump to {path} failed: {e}")
+    atexit.register(_dump)

@@ -383,3 +383,32 @@ def test_dead_nodes_startup_grace(monkeypatch):
     fake.key_value_set("mxtpu/heartbeat/1", repr(_time.time() - 999))
     kv._started_at = _time.time()
     assert kv.get_dead_nodes(timeout=60) == [1]
+
+
+def test_launcher_profile_rank(tmp_path):
+    """`--profile-rank N` (reference analogue: rank 0 toggling a remote
+    server's profiler over a kvstore command, kvstore_dist.h:99): the
+    requested rank auto-starts the profiler at distributed init and dumps
+    a chrome-trace at exit; other ranks do not."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        f"import sys; sys.path.insert(0, {str(REPO)!r})\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import _distributed\n"
+        "_distributed.init_from_env()\n"
+        "a = mx.np.ones((8,))\n"
+        "(a + a).asnumpy()\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--profile-rank", "1",
+         "--profile-dir", str(tmp_path),
+         "--", sys.executable, str(script)],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-500:]
+    out = tmp_path / "profile_rank1.json"
+    assert out.exists(), sorted(p.name for p in tmp_path.iterdir())
+    assert not (tmp_path / "profile_rank0.json").exists()
+    import json as _json
+    trace = _json.loads(out.read_text())
+    assert "traceEvents" in trace

@@ -153,6 +153,14 @@ def main(argv=None):
                    help="directory to cd into on each host before running")
     p.add_argument("--devices-per-worker", type=int, default=0,
                    help="virtual CPU devices per process (testing)")
+    p.add_argument("--profile-rank", type=int, default=None,
+                   help="profile worker rank N from the launcher "
+                        "(reference: rank 0 toggling a server profiler "
+                        "over a kvstore command, kvstore_dist.h:99); the "
+                        "rank dumps profile_rank{N}.json at exit; -1 = "
+                        "every rank")
+    p.add_argument("--profile-dir", default=".",
+                   help="directory for --profile-rank dumps")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command (prefix with --)")
     args = p.parse_args(argv)
@@ -162,6 +170,9 @@ def main(argv=None):
     if not command:
         p.error("no command given")
     env_extra, env_forward = {}, []
+    if args.profile_rank is not None:
+        env_extra["MXNET_PROFILE_RANK"] = str(args.profile_rank)
+        env_extra["MXNET_PROFILE_DIR"] = args.profile_dir
     for item in args.env:
         if "=" in item:
             k, v = item.split("=", 1)
