@@ -68,8 +68,6 @@ def _maybe_profile_rank(rank):
     MXNET_PROFILE_DIR for every worker, and the matching rank starts the
     profiler here and dumps `D/profile_rank{N}.json` (chrome://tracing)
     at exit.  MXNET_PROFILE_RANK=-1 profiles every rank."""
-    import os
-    import warnings
     want = os.environ.get("MXNET_PROFILE_RANK")
     if want is None:
         return
@@ -95,7 +93,11 @@ def _maybe_profile_rank(rank):
     def _dump():
         try:
             profiler.set_state("stop")
-            profiler.dump()
+            # write to the captured path directly: the training script may
+            # have re-pointed the profiler's global filename at its own
+            # trace, and the launcher-requested one must not clobber it
+            with open(path, "w") as f:
+                f.write(profiler.dumps(format="json"))
         except Exception as e:   # teardown must not fail the worker,
             warnings.warn(       # but silence would hide a lost trace
                 f"profiler dump to {path} failed: {e}")

@@ -171,6 +171,9 @@ def main(argv=None):
         p.error("no command given")
     env_extra, env_forward = {}, []
     if args.profile_rank is not None:
+        if args.profile_rank >= args.num_workers or args.profile_rank < -1:
+            p.error(f"--profile-rank {args.profile_rank} out of range "
+                    f"(ranks are 0..{args.num_workers - 1}, or -1 for all)")
         env_extra["MXNET_PROFILE_RANK"] = str(args.profile_rank)
         env_extra["MXNET_PROFILE_DIR"] = args.profile_dir
     for item in args.env:
