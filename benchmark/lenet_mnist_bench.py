@@ -21,7 +21,6 @@ import numpy as onp
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 WARMUP = 5
-ITERS = 30
 
 
 def main():
@@ -70,13 +69,21 @@ def main():
     loss.wait_to_read()
     mx.waitall()
 
+    # drain-aware window sizing (shared helper; LeNet steps are ~5-9 ms)
+    from timing_util import window_iters
+    t0 = time.perf_counter()
+    for _ in range(3):
+        step(x, y, batch_size=b)
+    mx.waitall()
+    iters = window_iters(max((time.perf_counter() - t0 - 0.1) / 3, 1e-3))
+
     windows = []
     for _ in range(3):
         t0 = time.perf_counter()
-        for _ in range(ITERS):
+        for _ in range(iters):
             step(x, y, batch_size=b)
         mx.waitall()
-        windows.append(b * ITERS / (time.perf_counter() - t0))
+        windows.append(b * iters / (time.perf_counter() - t0))
 
     result = {
         "metric": "lenet_mnist_train_imgs_per_s",

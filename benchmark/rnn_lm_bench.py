@@ -37,7 +37,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 V, E, H, L = 10000, 650, 650, 2     # example/rnn "medium" (PTB vocab)
 WARMUP = 5
-ITERS = 20
 PEAK_BF16 = 197e12
 
 
@@ -99,13 +98,22 @@ def main():
     loss.wait_to_read()
     mx.waitall()
 
+    # drain-aware window sizing (shared): at b=32 a step is ~9 ms, and a
+    # short window counts the ~100 ms tunnel drain as compute
+    from timing_util import window_iters
+    t0 = time.perf_counter()
+    for _ in range(3):
+        step(data, target, batch_size=b)
+    mx.waitall()
+    iters = window_iters(max((time.perf_counter() - t0 - 0.1) / 3, 1e-3))
+
     windows = []
     for _ in range(3):
         t0 = time.perf_counter()
-        for _ in range(ITERS):
+        for _ in range(iters):
             step(data, target, batch_size=b)
         mx.waitall()
-        windows.append(b * t * ITERS / (time.perf_counter() - t0))
+        windows.append(b * t * iters / (time.perf_counter() - t0))
 
     tok_s = max(windows)
     fpt = flops_per_token()

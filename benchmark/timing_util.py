@@ -83,3 +83,14 @@ def scan_ms(impl, args, grad=False, max_seconds=12.0):
             break
         n = n2
     return max(work / n, 1e-9) * 1e3, n, work >= 2 * t_sync
+
+
+def window_iters(est_step_s, target_s=3.0, min_iters=10, max_iters=600):
+    """Size a throughput window from a measured per-step time so the
+    ~100 ms tunnel drain stays a small fraction of it (~3% at the 3 s
+    default).  Shared by the FusedTrainStep-style benches
+    (bert_pretrain / rnn_lm / lenet_mnist) so the drain-avoidance logic
+    lives in one place; the cap bounds wall-time via iteration count
+    for very fast steps rather than re-introducing short windows."""
+    return int(min(max(target_s / max(est_step_s, 1e-4), min_iters),
+                   max_iters))
