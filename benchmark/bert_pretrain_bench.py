@@ -106,14 +106,10 @@ def main():
 
     # size the window from a measured step so it dwarfs the ~100 ms
     # tunnel drain (a 0.85 s window at T=128 understated tokens/s ~10%)
-    from timing_util import window_iters
-    t0 = time.perf_counter()
-    for _ in range(3):
-        step(tokens, segments, labels, batch_size=B)
-    mx.waitall()
-    est_step = max((time.perf_counter() - t0 - 0.1) / 3, 1e-3)
+    from timing_util import measured_step_s, window_iters
     global ITERS
-    ITERS = window_iters(est_step)
+    ITERS = window_iters(measured_step_s(
+        lambda: step(tokens, segments, labels, batch_size=B), mx.waitall))
 
     # dense-param count for MFU: everything except the embedding tables
     # (their forward is a gather, not a matmul; the TIED mlm vocab

@@ -69,13 +69,10 @@ def main():
     loss.wait_to_read()
     mx.waitall()
 
-    # drain-aware window sizing (shared helper; LeNet steps are ~5-9 ms)
-    from timing_util import window_iters
-    t0 = time.perf_counter()
-    for _ in range(3):
-        step(x, y, batch_size=b)
-    mx.waitall()
-    iters = window_iters(max((time.perf_counter() - t0 - 0.1) / 3, 1e-3))
+    # drain-aware window sizing (shared helper; LeNet steps are ~2-3 ms)
+    from timing_util import measured_step_s, window_iters
+    iters = window_iters(measured_step_s(
+        lambda: step(x, y, batch_size=b), mx.waitall))
 
     windows = []
     for _ in range(3):

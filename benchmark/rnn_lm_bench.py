@@ -98,14 +98,11 @@ def main():
     loss.wait_to_read()
     mx.waitall()
 
-    # drain-aware window sizing (shared): at b=32 a step is ~9 ms, and a
+    # drain-aware window sizing (shared): at b=32 a step is ~4 ms, and a
     # short window counts the ~100 ms tunnel drain as compute
-    from timing_util import window_iters
-    t0 = time.perf_counter()
-    for _ in range(3):
-        step(data, target, batch_size=b)
-    mx.waitall()
-    iters = window_iters(max((time.perf_counter() - t0 - 0.1) / 3, 1e-3))
+    from timing_util import measured_step_s, window_iters
+    iters = window_iters(measured_step_s(
+        lambda: step(data, target, batch_size=b), mx.waitall))
 
     windows = []
     for _ in range(3):

@@ -85,12 +85,27 @@ def scan_ms(impl, args, grad=False, max_seconds=12.0):
     return max(work / n, 1e-9) * 1e3, n, work >= 2 * t_sync
 
 
-def window_iters(est_step_s, target_s=3.0, min_iters=10, max_iters=600):
+DRAIN_S = 0.1   # one ~100 ms tunnel readback per window (see module doc)
+
+
+def window_iters(est_step_s, target_s=3.0, min_iters=10, max_iters=5000):
     """Size a throughput window from a measured per-step time so the
-    ~100 ms tunnel drain stays a small fraction of it (~3% at the 3 s
-    default).  Shared by the FusedTrainStep-style benches
-    (bert_pretrain / rnn_lm / lenet_mnist) so the drain-avoidance logic
-    lives in one place; the cap bounds wall-time via iteration count
-    for very fast steps rather than re-introducing short windows."""
+    tunnel drain stays a small fraction of it (~3% at the 3 s default).
+    Shared by the FusedTrainStep-style benches (bert_pretrain / rnn_lm /
+    lenet_mnist) so the drain-avoidance logic lives in one place.  The
+    iteration cap is a runaway guard only — it must stay far above
+    target_s / fastest-real-step (~2 ms) or it would silently
+    re-shorten windows for exactly the benches this exists for."""
     return int(min(max(target_s / max(est_step_s, 1e-4), min_iters),
                    max_iters))
+
+
+def measured_step_s(run_step, drain, n=3):
+    """Per-step seconds from ``n`` steps + one drain (DRAIN_S subtracted)
+    — the probe every bench feeds into :func:`window_iters`."""
+    import time
+    t0 = time.perf_counter()
+    for _ in range(n):
+        run_step()
+    drain()
+    return max((time.perf_counter() - t0 - DRAIN_S) / n, 1e-3)
