@@ -1,0 +1,57 @@
+"""Cross-version jax compatibility shims.
+
+The single place that papers over jax API moves so the rest of the
+codebase imports one stable symbol.  Today that is ``shard_map``:
+
+* jax >= 0.6 exports it at top level (``jax.shard_map``) and its
+  replication checker is spelled ``check_vma``;
+* the pinned 0.4.x line keeps it under
+  ``jax.experimental.shard_map`` and spells the checker ``check_rep``.
+
+Every ``shard_map`` user in the tree (``parallel/ring_attention.py``,
+``parallel/pipeline.py``, ``parallel/ulysses.py``,
+``kvstore/tpu_ici.py``; ``parallel/layers.py`` and
+``ops/pallas_kernels.py`` reference it in docs only) must import it
+from here, never from jax directly.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:  # pinned line: the experimental home (primary per ISSUE #1)
+    from jax.experimental.shard_map import shard_map as _shard_map
+except ImportError:  # newer jax removed the experimental alias
+    from jax import shard_map as _shard_map
+
+_accepts_check_vma = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(f, mesh=None, *, in_specs, out_specs, check_vma=True, **kw):
+    """`jax.shard_map` with the modern keyword surface on any jax.
+
+    ``check_vma`` is translated to the old ``check_rep`` spelling when
+    running on a jax whose shard_map predates the rename.
+    """
+    if _accepts_check_vma:
+        kw["check_vma"] = check_vma
+    else:
+        kw["check_rep"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+try:  # jax >= 0.5 re-exports it at top level
+    from jax import enable_x64
+except ImportError:
+    from jax.experimental import enable_x64  # noqa: F401  (pinned line)
+
+
+def pcast(x, axis_names, to="varying"):
+    """`jax.lax.pcast` where it exists (the vma type system, jax >= 0.7);
+    identity on the pinned line, whose `check_rep` tracker has no
+    varying-type annotations to satisfy."""
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_names, to=to)
+    return x

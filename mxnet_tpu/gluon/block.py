@@ -220,6 +220,22 @@ class Block:
     def forward(self, *args, **kwargs):  # pragma: no cover - abstract
         raise NotImplementedError
 
+    def as_endpoint(self, **serve_kwargs):
+        """Expose this block as a batched inference service
+        (:class:`mxnet_tpu.serve.Endpoint`): a bounded request queue, a
+        shape-bucketed dynamic micro-batcher, and an executable cache
+        that keeps steady-state traffic retrace-free.  The endpoint
+        runs the block in predict mode on its current parameters::
+
+            ep = net.as_endpoint(max_batch_size=16, max_latency_ms=5)
+            ep.warmup(example_batch)
+            future = ep.submit(request)
+
+        Keyword arguments are forwarded to ``Endpoint``.
+        """
+        from ..serve import Endpoint
+        return Endpoint(self, **serve_kwargs)
+
     def summary(self, *inputs):
         """Print a per-layer summary (reference block.py `summary`)."""
         lines = []

@@ -62,7 +62,7 @@ def _allreduce_fn(devices, shape, dtype):
     and the output keeps the same sharding — every device holds the sum
     locally, so writing back to the per-device copies is transfer-free.
     """
-    from jax.experimental.shard_map import shard_map
+    from .._compat import shard_map
 
     mesh = Mesh(onp.asarray(devices), ("dev",))
     sharding = NamedSharding(mesh, P("dev"))
@@ -82,7 +82,7 @@ def _compressed_allreduce_fn(devices, shape, out_dtype, threshold):
     and each device rescales its own shard by the threshold — the same
     sharded shard_map+psum shape as `_allreduce_fn`, no hub device
     (round-3 verdict weak #5)."""
-    from jax.experimental.shard_map import shard_map
+    from .._compat import shard_map
 
     mesh = Mesh(onp.asarray(devices), ("dev",))
     sharding = NamedSharding(mesh, P("dev"))
@@ -147,6 +147,19 @@ class TPUICIStore(KVStoreBase):
         except Exception:
             return None
 
+    @staticmethod
+    def _kv_try_get(client, key):
+        """Non-blocking KV read -> value or None.  The pinned jax line's
+        client has no ``key_value_try_get`` (added later), only the
+        blocking get — a short timeout emulates try-get there."""
+        try_get = getattr(client, "key_value_try_get", None)
+        try:
+            if try_get is not None:
+                return try_get(key)
+            return client.blocking_key_value_get(key, 200)  # ms
+        except Exception:
+            return None
+
     def _start_heartbeat(self):
         import os
         import threading
@@ -188,10 +201,7 @@ class TPUICIStore(KVStoreBase):
         now = time.time()
         dead = []
         for r in range(self._size):
-            try:
-                stamp = client.key_value_try_get(f"mxtpu/heartbeat/{r}")
-            except Exception:
-                stamp = None
+            stamp = self._kv_try_get(client, f"mxtpu/heartbeat/{r}")
             if stamp is None:
                 # never heartbeat: dead only if it had time to start —
                 # within the grace window after this store's own startup

@@ -62,6 +62,27 @@ class Monitor:
         walk(block, root_name or type(block).__name__)
         return self
 
+    def install_endpoint(self, endpoint, name=None):
+        """Watch a serving endpoint (`mxnet_tpu.serve.Endpoint`): every
+        dispatched batch records occupancy (real rows / bucket slots)
+        and device latency into the same tic/toc queue as tensor stats,
+        so a training-style monitor loop can watch serving health."""
+        _name = name or endpoint.name
+
+        def hook(_ep, real_rows, bucket_rows, latency_s):
+            if not self.activated:
+                return
+            occ_key = f"{_name}_batch_occupancy"
+            lat_key = f"{_name}_batch_latency_ms"
+            if self.pattern.match(occ_key):
+                self.queue.append((self.step, occ_key,
+                                   real_rows / max(bucket_rows, 1)))
+            if self.pattern.match(lat_key):
+                self.queue.append((self.step, lat_key, latency_s * 1e3))
+
+        self._handles.append(endpoint.register_batch_hook(hook))
+        return self
+
     def tic(self):
         """Start collecting for this batch if the interval hits."""
         if self.step % self.interval == 0:

@@ -113,8 +113,11 @@ def _qi_clamp(block_q, block_k):
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct matching ``like``'s mesh-axis variance: under
     shard_map (ring attention) `check_vma` requires pallas outputs to
-    declare how they vary across mesh axes."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    declare how they vary across mesh axes.  On jax lines predating the
+    vma type system (no `jax.typeof`, pinned 0.4.x) there is nothing to
+    declare — a plain struct is correct."""
+    typeof = getattr(jax, "typeof", None)
+    vma = getattr(typeof(like), "vma", None) if typeof is not None else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

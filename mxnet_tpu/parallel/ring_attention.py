@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from .._compat import pcast, shard_map
 
 __all__ = ["ring_attention", "ring_attention_local"]
 
@@ -89,7 +90,7 @@ def ring_attention_local(q, k, v, axis_name, causal=False, scale=None,
     # ring axis (and the batch axis, when sharded) so the scan carry types
     # match the rotating k/v blocks
     vary = (axis_name,) + tuple(extra_vary_axes)
-    m0, l0, acc0 = (lax.pcast(x, vary, to="varying")
+    m0, l0, acc0 = (pcast(x, vary, to="varying")
                     for x in (m0, l0, acc0))
     (m, l, acc, _k, _v), _ = lax.scan(
         step, (m0, l0, acc0, k, v), jnp.arange(axis_size))

@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+from .._compat import shard_map
 
 __all__ = ["ulysses_attention", "ulysses_attention_local"]
 

@@ -184,6 +184,12 @@ class Counter:
         if value is not None:
             self.set_value(value)
 
+    @property
+    def value(self):
+        """Current counter value (readable with the profiler stopped —
+        serving `stats()` polls this)."""
+        return self._value
+
     def set_value(self, value):
         self._value = value
         if _running:

@@ -1,0 +1,37 @@
+"""mxnet_tpu.serve — batched TPU inference serving.
+
+The request-driven counterpart to the training stack: wrap any Gluon
+block (or jit-able callable) in an :class:`Endpoint` and it becomes a
+thread-safe service — a bounded request queue, a dynamic micro-batcher
+that pads traffic onto a shape-bucket grid, an explicit executable
+cache (zero steady-state retraces), per-request futures with deadlines
+and error isolation, and profiler-integrated metrics.
+
+Quickstart::
+
+    import mxnet_tpu as mx
+
+    net = mx.gluon.model_zoo.vision.resnet18_v1()
+    net.initialize()
+
+    ep = mx.serve.Endpoint(net, max_batch_size=8, max_latency_ms=5)
+    ep.warmup(mx.np.zeros((1, 3, 224, 224)))       # precompile the grid
+
+    fut = ep.submit(batch_of_images)               # -> Future
+    probs = fut.result()
+    print(ep.stats())                              # qps, p99, occupancy...
+    ep.shutdown(drain=True)
+
+See ``docs/SERVING.md`` for bucket-grid sizing and the full API.
+"""
+from .bucketing import BucketSpec, pick_bucket, pow2_buckets
+from .cache import ExecutableCache
+from .endpoint import Endpoint, EndpointClosed, QueueFullError, \
+    RequestTimeout
+from .metrics import EndpointMetrics
+
+__all__ = [
+    "Endpoint", "BucketSpec", "ExecutableCache", "EndpointMetrics",
+    "QueueFullError", "RequestTimeout", "EndpointClosed",
+    "pick_bucket", "pow2_buckets",
+]

@@ -1,0 +1,85 @@
+"""Executable cache: compiled XLA programs keyed by bucket shape.
+
+``jax.jit`` keeps its own trace cache, but serving wants the cache to
+be *explicit*: (1) hit/miss counts are a first-class health metric — a
+steady-state miss means the bucket grid is wrong and every miss is a
+multi-second compile stall in the latency tail; (2) ``warmup()`` must
+precompile the whole bucket grid from shape specs alone, before any
+traffic, which is the AOT ``lower().compile()`` path, not the tracing
+path.  Entries hold the fully-compiled executable, so a hit does zero
+tracing work.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["ExecutableCache"]
+
+
+class ExecutableCache:
+    """Maps ``(input shapes, dtypes, donate)`` -> compiled executable
+    for one endpoint function ``fn(*arrays)``."""
+
+    def __init__(self, fn, metrics=None, static_args=()):
+        self._fn = fn
+        # params (or other per-endpoint constants) closed over every
+        # executable; never donated — they are reused across calls.
+        self._static_args = tuple(static_args)
+        self._metrics = metrics
+        self._entries = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def key_for(arrays, donate):
+        return (tuple((a.shape, str(a.dtype)) for a in arrays),
+                bool(donate))
+
+    def _compile(self, specs, donate):
+        n_static = len(self._static_args)
+        donate_argnums = tuple(
+            n_static + i for i in range(len(specs))) if donate else ()
+        jitted = jax.jit(self._fn, donate_argnums=donate_argnums)
+        return jitted.lower(*self._static_args, *specs).compile()
+
+    def get(self, arrays, donate=False, count=True):
+        """Compiled executable for these concrete arrays (compiling on
+        miss).  Call it as ``exe(*static_args, *arrays)``."""
+        key = self.key_for(arrays, donate)
+        with self._lock:
+            exe = self._entries.get(key)
+        if exe is not None:
+            if count and self._metrics:
+                self._metrics.incr("cache_hits")
+            return exe
+        if count and self._metrics:
+            self._metrics.incr("cache_misses")
+        specs = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
+        exe = self._compile(specs, donate)
+        with self._lock:
+            # a concurrent compile of the same key may have won; keep one
+            exe = self._entries.setdefault(key, exe)
+        return exe
+
+    def warm(self, shapes_dtypes, donate=False):
+        """AOT-compile one entry from ``[(shape, dtype), ...]`` specs
+        (no example data needed).  Warmup misses are not charged to the
+        miss counter — the hit-rate metric measures *traffic* behavior."""
+        specs = [jax.ShapeDtypeStruct(s, d) for s, d in shapes_dtypes]
+        key = self.key_for(specs, donate)
+        with self._lock:
+            if key in self._entries:
+                return False
+        exe = self._compile(specs, donate)
+        with self._lock:
+            self._entries.setdefault(key, exe)
+        return True
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def __call__(self, arrays, donate=False):
+        exe = self.get(arrays, donate=donate)
+        return exe(*self._static_args, *arrays)

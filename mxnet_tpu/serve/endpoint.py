@@ -1,0 +1,460 @@
+"""Batched inference endpoint: the serving front-end for Gluon blocks.
+
+Architecture (the TF-Serving batching design, arxiv 1605.08695, on the
+jax AOT stack):
+
+* callers ``submit()`` requests into a **bounded queue** (backpressure:
+  raise ``QueueFullError`` or block, per config);
+* one background **batcher thread** drains the queue, accumulating
+  requests until ``max_batch_size`` rows are waiting or the oldest
+  request has waited ``max_latency_ms`` — then pads/concats compatible
+  requests onto the endpoint's shape-bucket grid
+  (:class:`~mxnet_tpu.serve.bucketing.BucketSpec`) and dispatches ONE
+  device call per group;
+* the device program comes from an
+  :class:`~mxnet_tpu.serve.cache.ExecutableCache` keyed by bucket
+  shape, so steady-state traffic never retraces (``warmup()``
+  precompiles the whole grid);
+* each request's rows are sliced back out of the batch and delivered
+  through its own ``concurrent.futures.Future`` — a poisoned request
+  fails its own future, never the batch loop (failed batches are
+  retried per-request to isolate the poison).
+
+Batch padding is value-preserving: in predict mode no op mixes batch
+rows, so a request computed inside a padded batch is numerically
+identical to the same request alone (asserted by
+``tests/test_serve.py``).  Sequence-bucket padding additionally
+requires the model to mask padded positions — the standard transformer
+contract; outputs are trimmed back to each request's true length.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as onp
+
+from .bucketing import BucketSpec, pick_bucket
+from .cache import ExecutableCache
+from .metrics import EndpointMetrics
+
+__all__ = ["Endpoint", "QueueFullError", "RequestTimeout", "EndpointClosed"]
+
+
+class QueueFullError(RuntimeError):
+    """submit() on a full queue under full_policy='raise'."""
+
+
+class RequestTimeout(RuntimeError):
+    """The request's deadline passed before it was dispatched."""
+
+
+class EndpointClosed(RuntimeError):
+    """submit() after shutdown(), or pending at a non-draining shutdown."""
+
+
+_counter = itertools.count()
+
+
+class _Request:
+    __slots__ = ("arrays", "rows", "seq_len", "future", "t_enqueue",
+                 "deadline", "signature")
+
+    def __init__(self, arrays, signature, seq_len, timeout_s):
+        self.arrays = arrays
+        self.signature = signature
+        self.rows = arrays[0].shape[0]
+        self.seq_len = seq_len
+        self.future = Future()
+        self.t_enqueue = time.perf_counter()
+        self.deadline = (self.t_enqueue + timeout_s) if timeout_s else None
+
+
+class _HookHandle:
+    def __init__(self, collection, hook):
+        self._collection = collection
+        self._hook = hook
+
+    def detach(self):
+        if self._hook in self._collection:
+            self._collection.remove(self._hook)
+
+
+class Endpoint:
+    """Wraps a Gluon block (or any jit-able ``fn(*jax_arrays)``) behind
+    a batched ``submit``/``predict`` interface.
+
+    Parameters
+    ----------
+    model : gluon.Block or callable
+        A Block runs in predict mode on its current parameters; a bare
+        callable must be jax-traceable over its array arguments.
+    max_batch_size : int
+        Row budget per dispatched batch (also the largest batch bucket).
+    max_latency_ms : float
+        How long the batcher holds the oldest request open for
+        batch-mates before dispatching a partial batch.
+    batch_buckets, seq_buckets, seq_axis
+        The shape grid — see :class:`BucketSpec`.
+    max_queue : int
+        Bound on queued requests (backpressure depth).
+    full_policy : 'raise' | 'block'
+        submit() behavior on a full queue.
+    timeout_ms : float or None
+        Default per-request deadline (None = no deadline).
+    donate : bool
+        Donate input buffers to the executable (steady-state serving
+        never reuses them; saves one batch-sized buffer per call).
+    """
+
+    def __init__(self, model, name=None, max_batch_size=8,
+                 max_latency_ms=5.0, batch_buckets=None, seq_buckets=None,
+                 seq_axis=1, max_queue=256, full_policy="raise",
+                 timeout_ms=None, donate=False, start=True):
+        if full_policy not in ("raise", "block"):
+            raise ValueError("full_policy must be 'raise' or 'block'")
+        self.model = model
+        self.name = name or f"{type(model).__name__}_{next(_counter)}"
+        self.spec = BucketSpec(max_batch_size, batch_buckets=batch_buckets,
+                               seq_buckets=seq_buckets, seq_axis=seq_axis)
+        self.max_latency_s = max_latency_ms / 1e3
+        self.full_policy = full_policy
+        self.timeout_s = timeout_ms / 1e3 if timeout_ms else None
+        self.donate = donate
+        self.metrics = EndpointMetrics(self.name)
+        self._queue = _queue.Queue(maxsize=max_queue)
+        self._cache = None            # built lazily (needs input shapes)
+        self._model_lock = threading.Lock()
+        self._batch_hooks = []
+        self._closed = False
+        self._draining = False
+        self._holdover = None     # request that would overflow its batch
+        self._worker = None
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._closed = False
+            self._worker = threading.Thread(
+                target=self._run, name=f"serve:{self.name}", daemon=True)
+            self._worker.start()
+        return self
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop the batcher.  ``drain=True`` serves everything already
+        queued first; ``drain=False`` fails queued requests with
+        :class:`EndpointClosed`."""
+        if self._closed:
+            return
+        self._draining = drain
+        alive = self._worker is not None and self._worker.is_alive()
+        if not alive and drain and not self._queue.empty():
+            self.start()              # serve the backlog before closing
+            alive = True
+        self._closed = True
+        self._queue.put(None)         # wake + terminate the worker
+        if alive:
+            self._worker.join(timeout=timeout)
+        else:
+            self._fail_pending()      # no worker: refuse synchronously
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+
+    # -- request intake ----------------------------------------------------
+    def _to_numpy(self, x):
+        if hasattr(x, "asnumpy"):          # NDArray
+            return x.asnumpy()
+        return onp.asarray(x)
+
+    def submit(self, *inputs, timeout_ms=None):
+        """Enqueue one request; axis 0 of every input is its batch axis.
+        Returns a ``concurrent.futures.Future`` resolving to the model
+        output with exactly the submitted rows (padding sliced away)."""
+        if self._closed:
+            raise EndpointClosed(f"endpoint {self.name} is shut down")
+        if not inputs:
+            raise ValueError("submit() needs at least one input array")
+        arrays = [self._to_numpy(x) for x in inputs]
+        rows = arrays[0].shape[0] if arrays[0].ndim else 0
+        if rows < 1:
+            raise ValueError("inputs must have a leading batch axis >= 1")
+        if rows > self.spec.max_batch_size:
+            raise ValueError(
+                f"request rows {rows} > max_batch_size "
+                f"{self.spec.max_batch_size}; split the request")
+        for a in arrays:
+            if a.ndim < 1 or a.shape[0] != rows:
+                raise ValueError("all inputs must share the batch axis size")
+        signature = self.spec.signature(arrays)   # raises off-grid seq len
+        seq_len = None
+        if self.spec.seq_buckets:
+            for a in arrays:
+                if a.ndim > self.spec.seq_axis:
+                    seq_len = a.shape[self.spec.seq_axis]
+                    break
+        timeout_s = (timeout_ms / 1e3) if timeout_ms is not None \
+            else self.timeout_s
+        req = _Request(arrays, signature, seq_len, timeout_s)
+        try:
+            self._queue.put(req, block=self.full_policy == "block")
+        except _queue.Full:
+            self.metrics.incr("rejected_full")
+            raise QueueFullError(
+                f"endpoint {self.name}: queue full "
+                f"({self._queue.maxsize} pending)") from None
+        self.metrics.incr("submitted")
+        self.metrics.set_queue_depth(self._queue.qsize())
+        return req.future
+
+    def predict(self, *inputs, timeout_ms=None):
+        """Blocking submit: returns the model output for this request."""
+        fut = self.submit(*inputs, timeout_ms=timeout_ms)
+        # future timeout is a backstop over the serving deadline
+        t = (timeout_ms / 1e3 if timeout_ms is not None else self.timeout_s)
+        return fut.result(timeout=t + 60 if t else None)
+
+    def register_batch_hook(self, hook):
+        """``hook(endpoint, real_rows, bucket_rows, latency_s)`` after
+        every dispatched batch (monitor integration)."""
+        self._batch_hooks.append(hook)
+        return _HookHandle(self._batch_hooks, hook)
+
+    # -- model -> pure fn --------------------------------------------------
+    def _ensure_executable(self, arrays):
+        """Build the pure jax function + cache on first use (parameter
+        shapes may be deferred until the first concrete input)."""
+        if self._cache is not None:
+            return
+        with self._model_lock:
+            if self._cache is not None:
+                return
+            import jax
+            from ..gluon.block import Block, _scoped_forward
+            from ..ndarray.ndarray import NDArray
+
+            if isinstance(self.model, Block):
+                nds = [NDArray(onp.asarray(a)) for a in arrays]
+                if hasattr(self.model, "_ensure_shapes"):
+                    self.model._ensure_shapes(*nds)
+                else:
+                    self.model(*nds)   # finish any deferred init
+                params = self.model.collect_params()
+                names = sorted(k for k in params
+                               if params[k]._data is not None)
+                plist = [params[k] for k in names]
+                param_datas = tuple(p.data()._data for p in plist)
+                treedef = jax.tree_util.tree_structure(
+                    tuple(range(len(arrays))))
+                block = self.model
+
+                def fn(param_datas_, *input_datas):
+                    # serving graph: predict mode, fixed key (dropout off)
+                    out, _aux = _scoped_forward(
+                        block, plist, param_datas_, jax.random.key(0),
+                        list(input_datas), treedef, training=False)
+                    return out
+
+                self._cache = ExecutableCache(
+                    fn, metrics=self.metrics, static_args=(param_datas,))
+            else:
+                self._cache = ExecutableCache(
+                    self.model, metrics=self.metrics)
+
+    def warmup(self, *example_inputs):
+        """Precompile the full bucket grid for this input signature:
+        every batch bucket x every sequence bucket.  ``example_inputs``
+        fix the per-input trailing shapes and dtypes (their batch/seq
+        extents are ignored).  Returns the number of executables
+        compiled."""
+        arrays = [self._to_numpy(x) for x in example_inputs]
+        self._ensure_executable(arrays)
+        compiled = 0
+        seq_grid = self.spec.seq_buckets or [None]
+        for b in self.spec.batch_buckets:
+            for s in seq_grid:
+                shapes = []
+                for a in arrays:
+                    shape = [b] + list(a.shape[1:])
+                    if s is not None and a.ndim > self.spec.seq_axis:
+                        shape[self.spec.seq_axis] = s
+                    shapes.append((tuple(shape), a.dtype))
+                compiled += bool(self._cache.warm(shapes,
+                                                  donate=self.donate))
+        return compiled
+
+    def stats(self):
+        out = self.metrics.stats()
+        out["queue_depth"] = self._queue.qsize()
+        out["executables"] = len(self._cache) if self._cache else 0
+        return out
+
+    # -- the batcher loop --------------------------------------------------
+    def _run(self):
+        saw_sentinel = False
+        while not saw_sentinel:
+            if self._holdover is not None:
+                item, self._holdover = self._holdover, None
+            else:
+                try:
+                    item = self._queue.get(timeout=0.1)
+                except _queue.Empty:
+                    continue
+            if item is None:          # shutdown sentinel
+                saw_sentinel = True
+            else:
+                saw_sentinel = self._accumulate(item)
+        if self._draining:
+            self._drain_rest()
+        else:
+            self._fail_pending()
+
+    def _accumulate(self, first):
+        """Hold the oldest request open for up to max_latency_ms while
+        batch-mates arrive, then dispatch.  Returns True when the
+        shutdown sentinel arrived mid-wait (the caller stops after)."""
+        batch = [first]
+        rows = first.rows
+        deadline = first.t_enqueue + self.max_latency_s
+        saw_sentinel = False
+        while rows < self.spec.max_batch_size:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._queue.get(timeout=remaining)
+            except _queue.Empty:
+                break
+            if nxt is None:
+                saw_sentinel = True
+                break
+            if rows + nxt.rows > self.spec.max_batch_size:
+                self._holdover = nxt   # next batch leads with it
+                break
+            batch.append(nxt)
+            rows += nxt.rows
+        self.metrics.set_queue_depth(self._queue.qsize())
+        self._dispatch(batch)
+        return saw_sentinel
+
+    def _drain_rest(self):
+        """Serve everything still queued (shutdown(drain=True)),
+        batching up to max_batch_size rows per dispatch."""
+        batch, rows = [], 0
+        if self._holdover is not None:
+            batch, rows = [self._holdover], self._holdover.rows
+            self._holdover = None
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except _queue.Empty:
+                break
+            if req is None:
+                continue
+            if batch and rows + req.rows > self.spec.max_batch_size:
+                self._dispatch(batch)
+                batch, rows = [], 0
+            batch.append(req)
+            rows += req.rows
+        if batch:
+            self._dispatch(batch)
+
+    def _fail_pending(self):
+        while True:
+            if self._holdover is not None:
+                req, self._holdover = self._holdover, None
+            else:
+                try:
+                    req = self._queue.get_nowait()
+                except _queue.Empty:
+                    return
+            if req is not None and not req.future.done():
+                req.future.set_exception(
+                    EndpointClosed(f"endpoint {self.name} shut down "
+                                   "without draining"))
+                self.metrics.incr("failed")
+
+    def _dispatch(self, batch):
+        """Group compatible requests, run one device call per group,
+        deliver each request's slice to its future."""
+        now = time.perf_counter()
+        live = []
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                if not req.future.done():
+                    req.future.set_exception(RequestTimeout(
+                        f"request waited past its deadline "
+                        f"({(now - req.t_enqueue) * 1e3:.1f} ms)"))
+                self.metrics.incr("timeouts")
+            else:
+                live.append(req)
+        groups = {}
+        for req in live:
+            groups.setdefault(req.signature, []).append(req)
+        for group in groups.values():
+            try:
+                self._execute(group)
+            except Exception as exc:                 # noqa: BLE001
+                if len(group) == 1:
+                    if not group[0].future.done():
+                        group[0].future.set_exception(exc)
+                    self.metrics.incr("failed")
+                else:
+                    # isolate the poison: rerun each request alone so
+                    # only the bad one fails
+                    for req in group:
+                        self._dispatch([req])
+
+    def _execute(self, group):
+        import jax
+        import jax.numpy as jnp
+        from ..ndarray.ndarray import NDArray
+
+        self._ensure_executable(group[0].arrays)
+        rows = sum(r.rows for r in group)
+        bucket = pick_bucket(rows, self.spec.batch_buckets)
+        n_inputs = len(group[0].arrays)
+        padded = [jnp.asarray(self.spec.pad_concat(
+            [r.arrays[i] for r in group], bucket))
+            for i in range(n_inputs)]
+        padded_seq = padded[0].shape[self.spec.seq_axis] \
+            if (self.spec.seq_buckets
+                and padded[0].ndim > self.spec.seq_axis) else None
+
+        t0 = time.perf_counter()
+        out = self._cache(padded, donate=self.donate)
+        out = jax.block_until_ready(out)
+        latency = time.perf_counter() - t0
+
+        self.metrics.observe_batch(rows, bucket)
+        for hook in list(self._batch_hooks):
+            hook(self, rows, bucket, latency)
+
+        row = 0
+        for req in group:
+            sl = slice(row, row + req.rows)
+            row += req.rows
+
+            def take(leaf, _sl=sl, _req=req):
+                piece = leaf[_sl]
+                # trim sequence padding back off row-aligned outputs
+                if (padded_seq is not None and _req.seq_len is not None
+                        and piece.ndim > self.spec.seq_axis
+                        and piece.shape[self.spec.seq_axis] == padded_seq):
+                    idx = [slice(None)] * piece.ndim
+                    idx[self.spec.seq_axis] = slice(0, _req.seq_len)
+                    piece = piece[tuple(idx)]
+                return NDArray(piece)
+
+            result = jax.tree_util.tree_map(take, out)
+            if not req.future.done():
+                req.future.set_result(result)
+            self.metrics.observe_latency(time.perf_counter() - req.t_enqueue)

@@ -1,0 +1,89 @@
+"""Per-endpoint serving metrics.
+
+Counters ride the existing :mod:`mxnet_tpu.profiler` Domain/Counter
+machinery — while the profiler is running, every update lands in the
+chrome://tracing dump next to operator events, so a serving trace shows
+queue depth and batch occupancy on the same timeline as device compute.
+``stats()`` additionally works with the profiler stopped: the Counter
+objects always hold their latest value.
+
+Latency percentiles come from a fixed-size reservoir of the most
+recent completions (default 2048) — O(1) memory under unbounded
+traffic, exact over the recent window, which is what a serving
+dashboard wants anyway.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as onp
+
+from .. import profiler
+
+__all__ = ["EndpointMetrics"]
+
+_LATENCY_WINDOW = 2048
+
+
+class EndpointMetrics:
+    def __init__(self, name):
+        self.name = name
+        self._domain = profiler.Domain(f"serve/{name}")
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        names = ("submitted", "completed", "failed", "timeouts",
+                 "rejected_full", "batches", "cache_hits", "cache_misses",
+                 "queue_depth")
+        self._counters = {n: self._domain.new_counter(n, 0) for n in names}
+        self._latencies_ms = onp.zeros(_LATENCY_WINDOW, dtype=onp.float64)
+        self._lat_n = 0          # total completions recorded
+        self._occ_rows = 0       # real rows dispatched
+        self._occ_slots = 0      # bucket slots dispatched
+
+    def incr(self, name, delta=1):
+        with self._lock:
+            self._counters[name].increment(delta)
+
+    def set_queue_depth(self, depth):
+        with self._lock:
+            self._counters["queue_depth"].set_value(depth)
+
+    def observe_batch(self, real_rows, bucket_rows):
+        with self._lock:
+            self._counters["batches"].increment()
+            self._occ_rows += real_rows
+            self._occ_slots += bucket_rows
+
+    def observe_latency(self, seconds):
+        with self._lock:
+            self._counters["completed"].increment()
+            self._latencies_ms[self._lat_n % _LATENCY_WINDOW] = seconds * 1e3
+            self._lat_n += 1
+
+    def _value(self, name):
+        return self._counters[name].value
+
+    def stats(self):
+        """One flat dict: counters, QPS over the endpoint's lifetime,
+        latency percentiles over the recent window, mean batch occupancy,
+        executable-cache hit rate."""
+        with self._lock:
+            elapsed = max(time.perf_counter() - self._t0, 1e-9)
+            n = min(self._lat_n, _LATENCY_WINDOW)
+            lat = onp.sort(self._latencies_ms[:n]) if n else None
+            hits, misses = self._value("cache_hits"), \
+                self._value("cache_misses")
+            out = {name: self._value(name) for name in self._counters}
+            out.update({
+                "qps": self._value("completed") / elapsed,
+                "mean_batch_occupancy": (
+                    self._occ_rows / self._occ_slots
+                    if self._occ_slots else 0.0),
+                "cache_hit_rate": hits / (hits + misses)
+                if hits + misses else 0.0,
+                "latency_ms_p50": float(onp.percentile(lat, 50)) if n else None,
+                "latency_ms_p95": float(onp.percentile(lat, 95)) if n else None,
+                "latency_ms_p99": float(onp.percentile(lat, 99)) if n else None,
+            })
+            return out

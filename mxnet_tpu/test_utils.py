@@ -120,7 +120,7 @@ def check_numeric_gradient(f, inputs, eps=1e-3, rtol=1e-2, atol=1e-3,
     are NDArrays.  Compares d(sum(f))/dx computed by the tape against central
     differences.
     """
-    from jax import enable_x64
+    from ._compat import enable_x64
 
     inputs = list(inputs)
     for x in inputs:

@@ -1,0 +1,337 @@
+"""mxnet_tpu.serve — the batched inference-serving subsystem.
+
+Covers the ISSUE-1 acceptance grid: batched == unbatched numerics,
+bucket selection/padding, executable-cache hit accounting, deadline
+partial batches, backpressure, per-request error isolation, deadline
+timeouts, drain/no-drain shutdown, and a threaded multi-client smoke.
+"""
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serve import (BucketSpec, Endpoint, EndpointClosed,
+                             QueueFullError, RequestTimeout, pick_bucket,
+                             pow2_buckets)
+
+
+def _mlp(out_units=4, in_units=8):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(out_units))
+    net.initialize()
+    # finish deferred shape inference
+    net(mx.np.zeros((1, in_units)))
+    return net
+
+
+# -- bucket grid --------------------------------------------------------------
+
+def test_pow2_bucket_grid():
+    assert pow2_buckets(8) == [1, 2, 4, 8]
+    assert pow2_buckets(12) == [1, 2, 4, 8, 12]  # max always a bucket
+    assert pick_bucket(3, [1, 2, 4, 8]) == 4
+    assert pick_bucket(8, [1, 2, 4, 8]) == 8
+    with pytest.raises(ValueError):
+        pick_bucket(9, [1, 2, 4, 8])
+
+
+def test_bucketspec_signature_and_padding(rng):
+    spec = BucketSpec(8, seq_buckets=[4, 8], seq_axis=1)
+    a = rng.standard_normal((2, 3, 5)).astype(onp.float32)
+    b = rng.standard_normal((1, 7, 5)).astype(onp.float32)
+    # seq 3 and 7 snap to buckets 4 and 8 -> different signatures
+    assert spec.signature([a]) != spec.signature([b])
+    c = rng.standard_normal((3, 2, 5)).astype(onp.float32)
+    assert spec.signature([a]) == spec.signature([c])  # both snap to 4
+
+    out = spec.pad_concat([a, c], 8)
+    assert out.shape == (8, 4, 5)
+    onp.testing.assert_array_equal(out[:2, :3], a)
+    onp.testing.assert_array_equal(out[2:5, :2], c)
+    assert (out[5:] == 0).all() and (out[:2, 3:] == 0).all()
+
+
+# -- numerics: batched == unbatched ------------------------------------------
+
+def test_batched_results_match_unbatched_forward(rng):
+    net = _mlp()
+    xs = [mx.np.array(rng.standard_normal((n, 8)).astype(onp.float32))
+          for n in (1, 2, 3)]
+    refs = [net(x).asnumpy() for x in xs]
+
+    with Endpoint(net, max_batch_size=8, max_latency_ms=20) as ep:
+        ep.warmup(xs[0])
+        futs = [ep.submit(x) for x in xs]
+        outs = [f.result(timeout=60) for f in futs]
+    for out, ref in zip(outs, refs):
+        assert out.shape == ref.shape          # padding sliced back off
+        onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5,
+                                    atol=1e-6)
+
+
+def test_seq_bucketed_requests_trim_back(rng):
+    """Requests of different sequence lengths share a bucket; outputs
+    come back trimmed to each request's true length.  The model is
+    per-position (Dense on the last axis), so zero-padding is inert."""
+    net = nn.Dense(6, flatten=False)
+    net.initialize()
+    net(mx.np.zeros((1, 4, 8)))
+
+    a = rng.standard_normal((2, 3, 8)).astype(onp.float32)
+    b = rng.standard_normal((1, 4, 8)).astype(onp.float32)
+    ref_a = net(mx.np.array(a)).asnumpy()
+    ref_b = net(mx.np.array(b)).asnumpy()
+
+    with Endpoint(net, max_batch_size=4, max_latency_ms=50,
+                  seq_buckets=[4, 8]) as ep:
+        fa, fb = ep.submit(a), ep.submit(b)
+        out_a = fa.result(timeout=60)
+        out_b = fb.result(timeout=60)
+    assert out_a.shape == (2, 3, 6) and out_b.shape == (1, 4, 6)
+    onp.testing.assert_allclose(out_a.asnumpy(), ref_a, rtol=1e-5,
+                                atol=1e-6)
+    onp.testing.assert_allclose(out_b.asnumpy(), ref_b, rtol=1e-5,
+                                atol=1e-6)
+    # both requests padded onto the seq-4 bucket -> one executable
+    assert ep.stats()["executables"] == 1
+
+
+# -- executable cache ---------------------------------------------------------
+
+def test_cache_hits_across_repeated_shapes(rng):
+    net = _mlp()
+    x = mx.np.array(rng.standard_normal((2, 8)).astype(onp.float32))
+    with Endpoint(net, max_batch_size=8, max_latency_ms=1) as ep:
+        compiled = ep.warmup(x)
+        assert compiled == 4                   # buckets 1, 2, 4, 8
+        assert ep.warmup(x) == 0               # idempotent
+        for _ in range(40):
+            ep.predict(x)
+        s = ep.stats()
+    assert s["cache_misses"] == 0              # grid fully precompiled
+    assert s["cache_hits"] >= 40
+    assert s["cache_hit_rate"] >= 0.95         # acceptance threshold
+    assert s["executables"] == 4
+
+
+def test_unwarmed_shape_counts_a_miss(rng):
+    net = _mlp()
+    x = mx.np.array(rng.standard_normal((3, 8)).astype(onp.float32))
+    with Endpoint(net, max_batch_size=8, max_latency_ms=1) as ep:
+        ep.predict(x)                          # bucket 4: compile on miss
+        ep.predict(x)                          # now a hit
+        s = ep.stats()
+    assert s["cache_misses"] == 1 and s["cache_hits"] == 1
+
+
+# -- batching behavior --------------------------------------------------------
+
+def test_deadline_triggers_partial_batch(rng):
+    """One lone request must dispatch after ~max_latency_ms even though
+    the batch is nowhere near full."""
+    net = _mlp()
+    x = mx.np.array(rng.standard_normal((1, 8)).astype(onp.float32))
+    with Endpoint(net, max_batch_size=8, max_latency_ms=30) as ep:
+        ep.warmup(x)
+        t0 = time.perf_counter()
+        out = ep.submit(x).result(timeout=60)
+        elapsed = time.perf_counter() - t0
+        s = ep.stats()
+    assert out.shape == (1, 4)
+    assert elapsed < 5.0                       # did not hang for a full batch
+    assert s["batches"] == 1
+    assert s["mean_batch_occupancy"] == 1.0    # 1 row in the 1-bucket
+
+
+def test_batcher_coalesces_concurrent_requests(rng):
+    """Many single-row requests arriving inside one latency window share
+    device calls: fewer batches than requests, occupancy > 1 row."""
+    net = _mlp()
+    xs = [mx.np.array(rng.standard_normal((1, 8)).astype(onp.float32))
+          for _ in range(16)]
+    with Endpoint(net, max_batch_size=8, max_latency_ms=200) as ep:
+        ep.warmup(xs[0])
+        futs = [ep.submit(x) for x in xs]
+        for f in futs:
+            f.result(timeout=60)
+        s = ep.stats()
+    assert s["completed"] == 16
+    assert s["batches"] < 16                   # real coalescing happened
+
+
+# -- robustness ---------------------------------------------------------------
+
+def test_backpressure_queue_full(rng):
+    net = _mlp()
+    x = onp.zeros((1, 8), onp.float32)
+    # worker not started: the queue can only fill
+    ep = Endpoint(net, max_batch_size=8, max_queue=4, start=False)
+    for _ in range(4):
+        ep.submit(x)
+    with pytest.raises(QueueFullError):
+        ep.submit(x)
+    assert ep.stats()["rejected_full"] == 1
+    assert ep.stats()["queue_depth"] == 4
+    # drain-shutdown serves the backlog rather than dropping it
+    ep.start()
+    ep.shutdown(drain=True, timeout=120)
+    assert ep.stats()["completed"] == 4
+
+
+def test_submit_validation_rejects_bad_requests(rng):
+    net = _mlp()
+    ep = Endpoint(net, max_batch_size=4, start=False)
+    with pytest.raises(ValueError):
+        ep.submit()                            # no inputs
+    with pytest.raises(ValueError):
+        ep.submit(onp.zeros((6, 8), onp.float32))   # rows > max_batch_size
+    with pytest.raises(ValueError):
+        ep.submit(onp.zeros((2, 8), onp.float32),
+                  onp.zeros((3, 8), onp.float32))   # mismatched batch axes
+
+
+def test_poisoned_request_fails_alone(rng):
+    """A request whose shape breaks the model fails its own future; the
+    worker and its batch-mates survive."""
+    net = _mlp()
+    good = mx.np.array(rng.standard_normal((1, 8)).astype(onp.float32))
+    ref = net(good).asnumpy()
+    poison = onp.zeros((1, 5), onp.float32)    # wrong feature width
+    with Endpoint(net, max_batch_size=8, max_latency_ms=100) as ep:
+        ep.warmup(good)
+        f_good1 = ep.submit(good)
+        f_bad = ep.submit(poison)
+        f_good2 = ep.submit(good)
+        out1 = f_good1.result(timeout=60)
+        out2 = f_good2.result(timeout=60)
+        with pytest.raises(Exception):
+            f_bad.result(timeout=60)
+        # worker still alive and serving
+        out3 = ep.predict(good)
+        s = ep.stats()
+    for out in (out1, out2, out3):
+        onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5,
+                                    atol=1e-6)
+    assert s["failed"] == 1 and s["completed"] == 3
+
+
+def test_request_timeout(rng):
+    net = _mlp()
+    x = onp.zeros((1, 8), onp.float32)
+    ep = Endpoint(net, max_batch_size=8, timeout_ms=20, start=False)
+    fut = ep.submit(x)
+    time.sleep(0.1)                            # deadline passes while queued
+    ep.start()
+    with pytest.raises(RequestTimeout):
+        fut.result(timeout=60)
+    ep.shutdown(drain=True, timeout=60)
+    assert ep.stats()["timeouts"] == 1
+
+
+def test_shutdown_without_drain_fails_pending(rng):
+    net = _mlp()
+    x = onp.zeros((1, 8), onp.float32)
+    ep = Endpoint(net, max_batch_size=8, start=False)
+    futs = [ep.submit(x) for _ in range(3)]
+    ep.shutdown(drain=False, timeout=60)
+    for f in futs:
+        with pytest.raises(EndpointClosed):
+            f.result(timeout=60)
+    with pytest.raises(EndpointClosed):
+        ep.submit(x)
+    assert ep.stats()["failed"] == 3
+
+
+# -- integration --------------------------------------------------------------
+
+def test_block_as_endpoint_hook(rng):
+    net = _mlp()
+    x = mx.np.array(rng.standard_normal((2, 8)).astype(onp.float32))
+    ref = net(x).asnumpy()
+    with net.as_endpoint(max_batch_size=4, max_latency_ms=5) as ep:
+        out = ep.predict(x)
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_endpoint_wraps_bare_callable(rng):
+    import jax.numpy as jnp
+
+    with Endpoint(lambda a: jnp.tanh(a) * 2.0, max_batch_size=4,
+                  max_latency_ms=5) as ep:
+        x = rng.standard_normal((2, 3)).astype(onp.float32)
+        out = ep.predict(x)
+    onp.testing.assert_allclose(out.asnumpy(), onp.tanh(x) * 2.0,
+                                rtol=1e-6)
+
+
+def test_monitor_install_endpoint(rng):
+    net = _mlp()
+    x = mx.np.array(rng.standard_normal((2, 8)).astype(onp.float32))
+    mon = mx.monitor.Monitor(interval=1)
+    with Endpoint(net, max_batch_size=4, max_latency_ms=5) as ep:
+        mon.install_endpoint(ep)
+        mon.tic()
+        ep.predict(x)
+        rows = mon.toc()
+    keys = {k for _s, k, _v in rows}
+    assert any(k.endswith("_batch_occupancy") for k in keys)
+    assert any(k.endswith("_batch_latency_ms") for k in keys)
+
+
+def test_stats_surface(rng):
+    net = _mlp()
+    x = mx.np.array(rng.standard_normal((2, 8)).astype(onp.float32))
+    with Endpoint(net, max_batch_size=8, max_latency_ms=1) as ep:
+        ep.warmup(x)
+        for _ in range(5):
+            ep.predict(x)
+        s = ep.stats()
+    for key in ("qps", "latency_ms_p50", "latency_ms_p95", "latency_ms_p99",
+                "mean_batch_occupancy", "queue_depth", "cache_hit_rate",
+                "submitted", "completed", "batches"):
+        assert key in s, key
+    assert s["qps"] > 0 and s["latency_ms_p50"] > 0
+    assert s["submitted"] == s["completed"] == 5
+
+
+def test_multi_client_threaded_smoke(rng):
+    """8 client threads x 12 requests of mixed batch sizes: everything
+    completes, every result matches the unbatched forward, cache stays
+    hot after warmup."""
+    net = _mlp()
+    sizes = [1, 2, 3]
+    inputs = {n: rng.standard_normal((n, 8)).astype(onp.float32)
+              for n in sizes}
+    refs = {n: net(mx.np.array(a)).asnumpy() for n, a in inputs.items()}
+    errors = []
+
+    with Endpoint(net, max_batch_size=8, max_latency_ms=5,
+                  max_queue=512) as ep:
+        ep.warmup(mx.np.array(inputs[1]))
+
+        def client(idx):
+            try:
+                for i in range(12):
+                    n = sizes[(idx + i) % len(sizes)]
+                    out = ep.predict(inputs[n])
+                    onp.testing.assert_allclose(
+                        out.asnumpy(), refs[n], rtol=1e-5, atol=1e-6)
+            except Exception as exc:           # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        s = ep.stats()
+
+    assert not errors, errors[:3]
+    assert s["completed"] == 8 * 12
+    assert s["cache_hit_rate"] >= 0.95
+    assert s["failed"] == 0 and s["timeouts"] == 0

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI entry (reference: ci/build.py + runtime_functions.sh stages).
-# Stages: smoke | test | perf | dryrun | all (default).
+# Stages: import | smoke | test | perf | dryrun | all (default).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -9,6 +9,19 @@ export JAX_PLATFORMS=cpu
 export PALLAS_AXON_POOL_IPS=
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 
+run_import() {
+  # hard gate (ISSUE 1): bare import + zero collection errors, so an
+  # import-time crash can never land again
+  python -c "import mxnet_tpu; print('ci: import ok')"
+  out=$(python -m pytest tests/ -q --collect-only -p no:cacheprovider \
+        2>&1 | tail -3)
+  if echo "$out" | grep -qE "[0-9]+ errors?"; then
+    echo "ci: FAIL — collection errors:" >&2
+    echo "$out" >&2
+    exit 1
+  fi
+  echo "ci: collect-only 0 errors"
+}
 run_smoke()  { bash tools/smoke.sh; }
 run_test()   { python -m pytest tests/ -q -x; }
 run_perf()   { python benchmark/opperf/opperf.py --smoke; }
@@ -22,10 +35,11 @@ run_dryrun() {
 }
 
 case "$stage" in
+  import) run_import ;;
   smoke)  run_smoke ;;
   test)   run_test ;;
   perf)   run_perf ;;
   dryrun) run_dryrun ;;
-  all)    run_smoke; run_test; run_perf; run_dryrun ;;
+  all)    run_import; run_smoke; run_test; run_perf; run_dryrun ;;
   *) echo "unknown stage $stage" >&2; exit 2 ;;
 esac

@@ -8,6 +8,27 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 
+# 0. import gate (ISSUE 1): a bare import must succeed and the test tree
+# must collect with ZERO errors — an import-time crash (like the jax
+# shard_map move that broke the seed) can never land again.
+python -c "import mxnet_tpu; print('smoke: import ok')"
+collect_log=$(mktemp)
+if ! python -m pytest tests/ -q --collect-only -p no:cacheprovider \
+    > "$collect_log" 2>&1; then
+  echo "smoke: FAIL — test collection errored:" >&2
+  grep -E "ERROR|error" "$collect_log" | head -20 >&2
+  rm -f "$collect_log"
+  exit 1
+fi
+if grep -qE "[0-9]+ errors?" "$collect_log"; then
+  echo "smoke: FAIL — collection reported errors:" >&2
+  tail -5 "$collect_log" >&2
+  rm -f "$collect_log"
+  exit 1
+fi
+rm -f "$collect_log"
+echo "smoke: collect-only 0 errors"
+
 python - <<'EOF'
 import mxnet_tpu as mx
 import numpy as onp
@@ -24,7 +45,15 @@ trainer.step(2)
 assert onp.isfinite(loss.asnumpy()).all()
 print("smoke: train step ok")
 
-# 2. bench.py must at least import (its main guard must not run)
+# 2. the serving subsystem answers one request end to end
+ep = mx.serve.Endpoint(net, max_batch_size=4, max_latency_ms=2)
+out = ep.predict(x)
+assert out.shape == (2, 4)
+assert ep.stats()["completed"] == 1
+ep.shutdown(drain=True)
+print("smoke: serve round-trip ok")
+
+# 3. bench.py must at least import (its main guard must not run)
 import importlib.util as _u
 spec = _u.spec_from_file_location("bench", "bench.py")
 m = _u.module_from_spec(spec)
@@ -32,7 +61,7 @@ spec.loader.exec_module(m)
 print("smoke: bench import ok")
 EOF
 
-# 3. the driver entry points compile on the virtual mesh
+# 4. the driver entry points compile on the virtual mesh
 python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
