@@ -56,6 +56,7 @@ from . import models
 from . import parallel
 from . import amp
 from . import profiler
+from . import telemetry
 from . import serve
 from .runtime import Features, feature_list
 from . import callback
@@ -90,6 +91,6 @@ __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "NDArray", "nd", "np",
     "npx", "autograd", "random", "gluon", "models", "optimizer", "kvstore", "kv",
     "initializer", "init", "lr_scheduler", "parallel", "amp", "profiler",
-    "serve",
+    "serve", "telemetry",
     "waitall", "current_context", "num_gpus", "num_tpus", "test_utils",
 ]

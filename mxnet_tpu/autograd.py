@@ -55,9 +55,25 @@ class _RecordingStateScope:
             _iv.set_training(self._prev_train)
 
 
+class _RecordScope(_RecordingStateScope):
+    """`record()` with step-phase telemetry: the recorded region is the
+    forward of a training step, so it times the "fwd" phase (chrome-trace
+    span while profiling + the trainer phase histogram)."""
+
+    def __enter__(self):
+        from . import telemetry as _tm
+        self._phase = _tm.step_phase("fwd")
+        self._phase.__enter__()
+        return super().__enter__()
+
+    def __exit__(self, *exc):
+        super().__exit__(*exc)
+        self._phase.__exit__(*exc)
+
+
 def record(train_mode=True):
     """Scope enabling tape recording (and train mode by default)."""
-    return _RecordingStateScope(True, train_mode)
+    return _RecordScope(True, train_mode)
 
 
 def pause(train_mode=False):

@@ -99,6 +99,12 @@ class Block:
     def params(self):
         return dict(self._reg_params)
 
+    @property
+    def children(self):
+        """Name -> direct child Block mapping (public iteration surface;
+        tooling like Monitor walks this instead of `_children`)."""
+        return dict(self._children)
+
     # -- lifecycle ---------------------------------------------------------
     def initialize(self, init=None, ctx=None, verbose=False,
                    force_reinit=False):
@@ -375,6 +381,12 @@ class HybridBlock(Block):
         out, aux_vals = invoke(
             jit_fn, (param_nds, key, flat, treedef_id),
             name=f"{type(self).__name__}.hybrid_forward")
+        # retrace watchdog: a steady-state recompile of the hybridized
+        # program (shape drift past warmup) is the bug class serving
+        # buckets exist to prevent — count it and warn
+        from .. import telemetry as _telemetry
+        _telemetry.watchdog().observe(
+            jit_fn, name=f"{type(self).__name__}.hybrid_forward")
         # write deferred aux updates (BatchNorm moving stats) back
         for p, v in zip(self._aux_param_holder, aux_vals):
             if p is not None:

@@ -132,12 +132,25 @@ class DataLoader:
         return self._pool
 
     def __iter__(self):
+        from ... import telemetry as _telemetry
+
         if self._prefetch_to_device:
-            yield from _lookahead_device(self._host_batches(),
-                                         self._prefetch_to_device)
+            inner = _lookahead_device(self._host_batches(),
+                                      self._prefetch_to_device)
         else:
-            for b in self._host_batches():
-                yield _as_device_batch(b)
+            inner = (_as_device_batch(b) for b in self._host_batches())
+        # time each batch production as the "data-wait" step phase: with
+        # enough workers/prefetch it collapses toward zero; a fat span
+        # here means the input pipeline, not the chip, bounds step time
+        while True:
+            phase = _telemetry.step_phase("data-wait")
+            phase.__enter__()
+            try:
+                batch = next(inner)
+            except StopIteration:
+                return        # exhausted probe: not a batch wait, discard
+            phase.__exit__(None, None, None)
+            yield batch
 
     def _host_batches(self):
         if self._num_workers == 0:

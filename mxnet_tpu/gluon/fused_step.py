@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as onp
 
 from .. import random as _rng
+from .. import telemetry as _telemetry
 from ..ndarray.ndarray import NDArray
 from .block import _TREEDEFS, _intern_treedef, _is_nd, _scoped_forward
 
@@ -258,9 +259,13 @@ class FusedTrainStep:
         else:
             scal = jnp.asarray(scal)
 
-        outs, auxs, new_ws, new_states = self._jit(
-            train_ws, const_pd, states, root, flat, scal,
-            optimizer.clip_gradient, treedef_id)
+        _telemetry.mark_step()
+        with _telemetry.step_phase("fused-step"):
+            outs, auxs, new_ws, new_states = self._jit(
+                train_ws, const_pd, states, root, flat, scal,
+                optimizer.clip_gradient, treedef_id)
+        _telemetry.watchdog().observe(
+            self._jit, name=f"FusedTrainStep[{type(self._block).__name__}]")
 
         for j, k in enumerate(self._train_idx):
             plist[k].data()._rebind(new_ws[j])

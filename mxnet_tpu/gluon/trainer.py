@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import optimizer as opt
+from .. import telemetry as _telemetry
 from ..kvstore import base as kvstore_base
 from .parameter import Parameter
 
@@ -147,15 +148,21 @@ class Trainer:
     # -- step -------------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + optimizer update; ``batch_size`` normalizes gradients
-        (reference trainer.py:334)."""
+        (reference trainer.py:334).  Both phases publish into the
+        telemetry step-phase histogram and, while profiling, emit
+        step-trace spans."""
         self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        _telemetry.mark_step()
+        with _telemetry.step_phase("allreduce"):
+            self._allreduce_grads()
+        with _telemetry.step_phase("optimizer"):
+            self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
         self._init_kvstore()
-        self._allreduce_grads()
+        with _telemetry.step_phase("allreduce"):
+            self._allreduce_grads()
 
     def _allreduce_grads(self):
         if self._kvstore is None:
@@ -170,7 +177,8 @@ class Trainer:
     def update(self, batch_size, ignore_stale_grad=False):
         self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._update(ignore_stale_grad)
+        with _telemetry.step_phase("optimizer"):
+            self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
         if self._update_on_kvstore:

@@ -33,9 +33,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from ..telemetry import collective_span as _collective_span
 from .base import KVStoreBase
 
 __all__ = ["TPUICIStore"]
+
+
+def _payload_bytes(vals):
+    """Approximate collective payload: value bytes across copies (plus
+    indices for row-sparse).  Feeds the per-collective bytes counter."""
+    total = 0
+    for v in vals:
+        data = v._data if isinstance(v, NDArray) else getattr(v, "data", None)
+        for d in (data, getattr(v, "indices", None)):
+            nb = getattr(d, "nbytes", None)
+            if nb:
+                total += int(nb)
+    return total
 
 
 def _value_devices(vals):
@@ -241,11 +255,13 @@ class TPUICIStore(KVStoreBase):
             for o in outs:
                 src.copyto(o)
             return
-        mesh = Mesh(onp.asarray(uniq), ("dev",))
-        rep = jax.device_put(src._data, NamedSharding(mesh, P()))
-        by_dev = {s.device: s.data for s in rep.addressable_shards}
-        for o, d in zip(outs, out_devs):
-            NDArray(by_dev[d], ctx=o.ctx).copyto(o)
+        with _collective_span("broadcast",
+                              _payload_bytes([src]) * len(uniq)):
+            mesh = Mesh(onp.asarray(uniq), ("dev",))
+            rep = jax.device_put(src._data, NamedSharding(mesh, P()))
+            by_dev = {s.device: s.data for s in rep.addressable_shards}
+            for o, d in zip(outs, out_devs):
+                NDArray(by_dev[d], ctx=o.ctx).copyto(o)
 
     def set_gradient_compression(self, compression_params):
         """Enable 2-bit gradient compression with error feedback (reference
@@ -274,15 +290,19 @@ class TPUICIStore(KVStoreBase):
 
         vals = value if isinstance(value, (list, tuple)) else [value]
         if isinstance(vals[0], RowSparseNDArray):
-            return self._pushpull_row_sparse(key, vals, out)
+            with _collective_span("rowsparse_pushpull", _payload_bytes(vals)):
+                return self._pushpull_row_sparse(key, vals, out)
         if len(vals) == 1:
             # SPMD path: a single (possibly sharded) array — XLA already
             # reduced over the data axis inside the jitted step.
             reduced = vals[0]
         elif self._compression is not None:
-            reduced = self._reduce_compressed(key, vals)
+            # the wire payload is the int8 levels, 1/4 of the f32 bytes
+            with _collective_span("allreduce_2bit", _payload_bytes(vals) // 4):
+                reduced = self._reduce_compressed(key, vals)
         else:
-            reduced = self._reduce_copies(vals)
+            with _collective_span("allreduce", _payload_bytes(vals)):
+                reduced = self._reduce_copies(vals)
         # out=None means update the pushed arrays in place (Trainer path)
         targets = vals if out is None else \
             (out if isinstance(out, (list, tuple)) else [out])

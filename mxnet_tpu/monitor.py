@@ -57,7 +57,12 @@ class Monitor:
         def walk(b, name):
             self._handles.append(b.register_forward_hook(
                 lambda blk, ins, out, _n=name: hook(blk, ins, out, _n)))
-            for cname, child in b._children.items():
+            # prefer the public iteration surface; fall back to _children
+            # for block-likes that predate the property
+            kids = getattr(b, "children", None)
+            items = kids.items() if isinstance(kids, dict) \
+                else b._children.items()
+            for cname, child in items:
                 walk(child, f"{name}.{cname}" if name else cname)
         walk(block, root_name or type(block).__name__)
         return self
@@ -102,8 +107,14 @@ class Monitor:
         return res
 
     def toc_print(self):
+        """Log collected stats at fixed precision (6 decimal places), so
+        runs diff cleanly; non-numeric stats fall back to ``str``."""
         for step, name, stat in self.toc():
-            logging.info("Batch: %7d %30s %s", step, name, stat)
+            try:
+                rendered = f"{float(stat):.6f}"
+            except (TypeError, ValueError):
+                rendered = str(stat)
+            logging.info("Batch: %7d %30s %s", step, name, rendered)
 
     def uninstall(self):
         for h in self._handles:

@@ -169,16 +169,30 @@ def _attached(arr):
 
 
 def _profiler_hook():
-    """(clock, record_op) while the profiler runs, else None — per-op host
+    """(clock, record) while the profiler runs, else None — per-op host
     dispatch spans (the engine's ProfileOperator analogue; device-side
     kernel timing comes from the XLA trace via
-    `profiler.set_config(xla_trace_dir=...)`)."""
+    `profiler.set_config(xla_trace_dir=...)`).
+
+    The clock is the profiler's own epoch (`_now_us`), so operator events
+    land on the same chrome-trace timeline as step-phase / collective /
+    serve spans.  Each recorded op also bumps the telemetry registry's
+    dispatch counter — per-op Python work happens ONLY while profiling."""
     from .. import profiler as _p
 
     if not _p._running:
         return None
-    import time as _t
-    return (lambda: _t.perf_counter() * 1e6, _p.record_op)
+    from .. import telemetry as _tm
+    ops_total = _tm.counter(
+        "mxtpu_ops_dispatched_total",
+        "Imperative op dispatches recorded while profiling",
+        labelnames=("op",))
+
+    def _record(name, ts, dur):
+        _p.record_op(name, ts, dur)
+        ops_total.labels(op=name).inc()
+
+    return (_p._now_us, _record)
 
 
 class _CaptureScope:
@@ -380,9 +394,12 @@ def backward(heads, head_grads=None, retain_graph=False, create_graph=False):
     overwrites, ``'add'`` accumulates across backward calls; multiple
     contributions within one backward always sum.
     """
-    _accumulate_and_write(
-        heads, head_grads, retain_graph, create_graph, variables=None
-    )
+    from .. import telemetry as _tm
+
+    with _tm.step_phase("bwd"):
+        _accumulate_and_write(
+            heads, head_grads, retain_graph, create_graph, variables=None
+        )
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,

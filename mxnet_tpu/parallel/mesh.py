@@ -105,15 +105,39 @@ def match_partition_rules(rules, names_to_shapes):
     return out
 
 
+def _transfer_metrics():
+    from .. import telemetry as _tm
+
+    return (
+        _tm.counter("mxtpu_mesh_transfer_total",
+                    "Host->mesh placements via parallel.global_put",
+                    labelnames=("kind",)),
+        _tm.counter("mxtpu_mesh_transfer_bytes_total",
+                    "Bytes placed onto the mesh via parallel.global_put",
+                    labelnames=("kind",)),
+    )
+
+
 def global_put(value, sharding):
     """Place host/single-device data under a (possibly multi-process)
     sharding.  For a fully-addressable mesh this is ``jax.device_put``;
     across processes each process supplies its addressable shards from
     the (identical-everywhere) full value — the SPMD data contract of
-    `jax.make_array_from_callback`."""
+    `jax.make_array_from_callback`.
+
+    Publishes count/bytes into the telemetry registry — per-step input
+    placement dominates DCN traffic on multi-host meshes, so it is the
+    first series to read when a pod step slows down."""
+    total, bytes_ = _transfer_metrics()
+    nbytes = getattr(value, "nbytes", 0)
     if sharding.is_fully_addressable:
+        total.labels(kind="device_put").inc()
+        if nbytes:
+            bytes_.labels(kind="device_put").inc(int(nbytes))
         return jax.device_put(value, sharding)
     host = onp.asarray(value)
+    total.labels(kind="callback").inc()
+    bytes_.labels(kind="callback").inc(int(host.nbytes))
     return jax.make_array_from_callback(
         host.shape, sharding, lambda idx: host[idx])
 

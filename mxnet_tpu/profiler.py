@@ -112,9 +112,18 @@ def dumps(reset=False, format="table"):
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write the chrome://tracing JSON to the configured filename."""
+    """Write the chrome://tracing JSON to the configured filename.
+
+    ``finished=True`` (default) also stops the profiler *before* the event
+    snapshot and resets the buffer with it — one atomic
+    ``dumps(reset=True)``, so no event recorded mid-dump can be dropped
+    unrecorded and the next run starts clean.  ``finished=False`` leaves
+    the profiler running and the buffer intact (periodic flushing)."""
+    if finished and _running:
+        set_state("stop")
+    payload = dumps(format="json", reset=finished)
     with open(_config["filename"], "w") as f:
-        f.write(dumps(format="json"))
+        f.write(payload)
 
 
 class Domain:
@@ -180,6 +189,9 @@ class Counter:
     def __init__(self, domain, name, value=None):
         self.domain = domain
         self.name = name
+        # increments are read-modify-write and arrive from concurrent
+        # serve threads — unprotected they lose updates
+        self._vlock = threading.Lock()
         self._value = 0
         if value is not None:
             self.set_value(value)
@@ -191,16 +203,23 @@ class Counter:
         return self._value
 
     def set_value(self, value):
-        self._value = value
+        with self._vlock:
+            self._value = value
+        self._sample(value)
+
+    def increment(self, delta=1):
+        with self._vlock:
+            self._value += delta
+            value = self._value
+        self._sample(value)
+
+    def decrement(self, delta=1):
+        self.increment(-delta)
+
+    def _sample(self, value):
         if _running:
             _emit(self.name, "counter", "C", _now_us(),
                   args={self.name: value})
-
-    def increment(self, delta=1):
-        self.set_value(self._value + delta)
-
-    def decrement(self, delta=1):
-        self.set_value(self._value - delta)
 
     def __iadd__(self, v):
         self.increment(v)
@@ -231,14 +250,22 @@ class scope:
         self._jax_ctx = None
 
     def __enter__(self):
+        # enter the jax annotation BEFORE starting the host span: if the
+        # TraceAnnotation constructor/enter raises, no host state has
+        # changed yet, so nothing dangles
+        jax_ctx = jax.profiler.TraceAnnotation(self.name)
+        jax_ctx.__enter__()
+        self._jax_ctx = jax_ctx
         self._span.start()
-        self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
-        self._jax_ctx.__enter__()
         return self
 
     def __exit__(self, *exc):
-        self._jax_ctx.__exit__(*exc)
+        # stop the span first (mirror of enter order), then close the jax
+        # annotation exactly once; tolerate exit-after-failed-enter
         self._span.stop()
+        jax_ctx, self._jax_ctx = self._jax_ctx, None
+        if jax_ctx is not None:
+            jax_ctx.__exit__(*exc)
 
 
 def dump_memory_profile(path="memory.pprof"):

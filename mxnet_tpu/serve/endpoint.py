@@ -429,9 +429,16 @@ class Endpoint:
             if (self.spec.seq_buckets
                 and padded[0].ndim > self.spec.seq_axis) else None
 
+        from .. import telemetry as _telemetry
+
         t0 = time.perf_counter()
-        out = self._cache(padded, donate=self.donate)
-        out = jax.block_until_ready(out)
+        # step-trace span: a profiling dump shows each batch dispatch on
+        # the same timeline as op events / step phases / collectives
+        with _telemetry.span(f"serve/{self.name}/batch", cat="serve",
+                             args={"rows": rows, "bucket": bucket,
+                                   "requests": len(group)}):
+            out = self._cache(padded, donate=self.donate)
+            out = jax.block_until_ready(out)
         latency = time.perf_counter() - t0
 
         self.metrics.observe_batch(rows, bucket)

@@ -7,6 +7,15 @@ queue depth and batch occupancy on the same timeline as device compute.
 ``stats()`` additionally works with the profiler stopped: the Counter
 objects always hold their latest value.
 
+Every update is also published into the :mod:`mxnet_tpu.telemetry`
+default registry under an ``endpoint`` label
+(``mxtpu_serve_requests_total`` / ``_batches_total`` /
+``_batch_rows_total`` / ``_cache_total`` / ``_queue_depth`` /
+``_latency_seconds``), so one ``telemetry.export_prometheus()`` scrape
+covers every live endpoint next to the trainer and kvstore series.
+Registry children are resolved once at construction — the per-event cost
+is a locked add.
+
 Latency percentiles come from a fixed-size reservoir of the most
 recent completions (default 2048) — O(1) memory under unbounded
 traffic, exact over the recent window, which is what a serving
@@ -20,10 +29,13 @@ import time
 import numpy as onp
 
 from .. import profiler
+from .. import telemetry
 
 __all__ = ["EndpointMetrics"]
 
 _LATENCY_WINDOW = 2048
+
+_EVENTS = ("submitted", "completed", "failed", "timeouts", "rejected_full")
 
 
 class EndpointMetrics:
@@ -41,25 +53,64 @@ class EndpointMetrics:
         self._occ_rows = 0       # real rows dispatched
         self._occ_slots = 0      # bucket slots dispatched
 
+        reg = telemetry.default_registry()
+        req = reg.counter(
+            "mxtpu_serve_requests_total",
+            "Serving requests by lifecycle event", ("endpoint", "event"))
+        cache = reg.counter(
+            "mxtpu_serve_cache_total",
+            "Executable-cache lookups under traffic (a steady-state miss "
+            "is a compile stall — check the bucket grid)",
+            ("endpoint", "kind"))
+        rows = reg.counter(
+            "mxtpu_serve_batch_rows_total",
+            "Dispatched batch rows: real request rows vs padded bucket "
+            "slots (ratio = occupancy)", ("endpoint", "kind"))
+        self._reg = {
+            n: req.labels(endpoint=name, event=n) for n in _EVENTS}
+        self._reg["cache_hits"] = cache.labels(endpoint=name, kind="hit")
+        self._reg["cache_misses"] = cache.labels(endpoint=name, kind="miss")
+        self._reg_batches = reg.counter(
+            "mxtpu_serve_batches_total", "Batches dispatched to the device",
+            ("endpoint",)).labels(endpoint=name)
+        self._reg_rows_real = rows.labels(endpoint=name, kind="real")
+        self._reg_rows_slots = rows.labels(endpoint=name, kind="slots")
+        self._reg_queue = reg.gauge(
+            "mxtpu_serve_queue_depth", "Requests waiting in the endpoint "
+            "queue", ("endpoint",)).labels(endpoint=name)
+        self._reg_latency = reg.histogram(
+            "mxtpu_serve_latency_seconds",
+            "End-to-end request latency (enqueue to result delivery)",
+            ("endpoint",)).labels(endpoint=name)
+
     def incr(self, name, delta=1):
         with self._lock:
             self._counters[name].increment(delta)
+        child = self._reg.get(name)
+        if child is not None:
+            child.inc(delta)
 
     def set_queue_depth(self, depth):
         with self._lock:
             self._counters["queue_depth"].set_value(depth)
+        self._reg_queue.set(depth)
 
     def observe_batch(self, real_rows, bucket_rows):
         with self._lock:
             self._counters["batches"].increment()
             self._occ_rows += real_rows
             self._occ_slots += bucket_rows
+        self._reg_batches.inc()
+        self._reg_rows_real.inc(real_rows)
+        self._reg_rows_slots.inc(bucket_rows)
 
     def observe_latency(self, seconds):
         with self._lock:
             self._counters["completed"].increment()
             self._latencies_ms[self._lat_n % _LATENCY_WINDOW] = seconds * 1e3
             self._lat_n += 1
+        self._reg["completed"].inc()
+        self._reg_latency.observe(seconds)
 
     def _value(self, name):
         return self._counters[name].value

@@ -1,0 +1,39 @@
+"""Unified telemetry: metrics registry, step-trace spans, retrace watchdog.
+
+The cross-cutting observability layer (docs/OBSERVABILITY.md):
+
+* :mod:`.registry` — thread-safe counters/gauges/histograms with labels,
+  Prometheus-text and JSON exposition.  ``serve`` endpoints, the kvstore
+  collectives, the Gluon ``Trainer`` step phases, and (while profiling)
+  ``ops.invoke`` all publish into the default registry;
+* :mod:`.spans` — structured chrome-trace spans over the
+  :mod:`mxnet_tpu.profiler` emitter, so one ``profiler.dump()``
+  interleaves step phases, op events, collective timings, and serve batch
+  dispatches on a single timeline;
+* :mod:`.watchdog` — XLA compile counters via ``jax.monitoring`` plus
+  per-jitted-function retrace detection with steady-state warnings.
+
+Everything is off the hot path by default: the chrome-trace side is gated
+on the profiler running (no per-op Python work otherwise), and registry
+publications happen per step / collective / serve batch, never per op.
+"""
+from .registry import (
+    MetricsRegistry, Counter, Gauge, Histogram, DEFAULT_BUCKETS,
+    default_registry, counter, gauge, histogram,
+    export_prometheus, export_json,
+)
+from .spans import span, step_phase, collective_span, mark_step
+from .watchdog import (
+    RetraceWatchdog, watchdog, watch_jit, install_compile_listener,
+)
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "DEFAULT_BUCKETS",
+    "default_registry", "counter", "gauge", "histogram",
+    "export_prometheus", "export_json",
+    "span", "step_phase", "collective_span", "mark_step",
+    "RetraceWatchdog", "watchdog", "watch_jit", "install_compile_listener",
+]
+
+# the listener only fires on compiles — safe to wire unconditionally
+install_compile_listener()

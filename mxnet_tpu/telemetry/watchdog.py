@@ -1,0 +1,196 @@
+"""Retrace/compile watchdog.
+
+On TPU the dominant "why is this step 1000x slower" bug class is
+shape-driven retracing: a jitted function silently recompiles because an
+input shape, dtype, or static argument changed (the serve bucket grid
+exists exactly to prevent it).  The reference engine made recompiles
+visible through the profiler; here they are first-class metrics:
+
+* a process-wide ``jax.monitoring`` listener counts every XLA compile
+  stage (trace / lower / backend-compile) with durations —
+  ``mxtpu_xla_compile_total{stage}`` / ``mxtpu_xla_compile_seconds``;
+* per-function attribution rides the jit trace-cache size:
+  ``RetraceWatchdog.observe(fn, name)`` (called by ``HybridBlock`` and
+  ``FusedTrainStep`` after each dispatch, or via the ``watch_jit``
+  wrapper for user functions) bumps ``mxtpu_jit_retrace_total{fn}``
+  whenever the cache grew beyond the first compile, and logs a WARNING
+  when the growth happens after the configurable steady-state call count
+  (`steady_after`, env ``MXNET_TELEMETRY_STEADY_STEPS``) — by then every
+  legitimate signature should have been seen.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import weakref
+
+from . import registry as _registry
+
+__all__ = ["RetraceWatchdog", "watchdog", "watch_jit",
+           "install_compile_listener"]
+
+_log = logging.getLogger("mxnet_tpu.telemetry")
+
+# jax.monitoring event names (jax._src.dispatch) -> exposition stage label
+_EVENT_STAGES = {
+    "/jax/core/compile/jaxpr_trace_duration": "trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lower",
+    "/jax/core/compile/backend_compile_duration": "compile",
+}
+
+# compiles are seconds-scale events; default sub-ms buckets would be noise
+_COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0)
+
+_listener_lock = threading.Lock()
+_listener_installed = False
+
+
+def install_compile_listener(registry=None):
+    """Register the process-wide ``jax.monitoring`` duration listener that
+    feeds the XLA compile counters.  Idempotent; installed automatically
+    on ``mxnet_tpu.telemetry`` import.  Returns True on first install."""
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return False
+        _listener_installed = True
+    reg = registry or _registry.default_registry()
+    total = reg.counter(
+        "mxtpu_xla_compile_total",
+        "XLA compilations by stage (trace=abstract eval, lower=StableHLO "
+        "emission, compile=backend codegen)", labelnames=("stage",))
+    seconds = reg.histogram(
+        "mxtpu_xla_compile_seconds", "Time spent in each XLA compile stage",
+        labelnames=("stage",), buckets=_COMPILE_BUCKETS)
+
+    def _on_duration(event, duration, **_kw):
+        stage = _EVENT_STAGES.get(event)
+        if stage is not None:
+            total.labels(stage=stage).inc()
+            seconds.labels(stage=stage).observe(duration)
+
+    import jax.monitoring as _jm
+    _jm.register_event_duration_secs_listener(_on_duration)
+    return True
+
+
+class _Tracked:
+    __slots__ = ("calls", "cache_size", "ref")
+
+    def __init__(self):
+        self.calls = 0
+        self.cache_size = None
+        self.ref = None
+
+
+class RetraceWatchdog:
+    """Per-function recompile tracking over jit trace-cache sizes.
+
+    Parameters
+    ----------
+    steady_after : int
+        Calls after which a function is considered steady-state: a cache
+        miss (new trace) past this count logs a WARNING naming the
+        function.  Default from ``MXNET_TELEMETRY_STEADY_STEPS``, else 2
+        (call 1 legitimately compiles; warmup variants get one more).
+    registry : MetricsRegistry
+        Where ``mxtpu_jit_retrace_total{fn}`` lives (default registry).
+    """
+
+    def __init__(self, steady_after=None, registry=None, logger=None):
+        if steady_after is None:
+            steady_after = int(
+                os.environ.get("MXNET_TELEMETRY_STEADY_STEPS", "2"))
+        self.steady_after = int(steady_after)
+        reg = registry or _registry.default_registry()
+        self._retraces = reg.counter(
+            "mxtpu_jit_retrace_total",
+            "Trace-cache growth of watched jitted functions beyond their "
+            "first compile (nonzero in steady state = shape-driven "
+            "retracing)", labelnames=("fn",))
+        self._lock = threading.Lock()
+        self._tracked = {}
+
+    def retrace_count(self, name):
+        return self._retraces.labels(fn=name).value
+
+    def observe(self, fn, name, detail=None):
+        """Record one completed call of ``fn`` (a ``jax.jit`` callable).
+        Compares the trace-cache size against the last call; growth beyond
+        the first compile counts as a retrace, and growth after
+        ``steady_after`` calls additionally warns."""
+        try:
+            size = fn._cache_size()
+        except Exception:       # not a PjitFunction (mocks, AOT wrappers)
+            return
+        with self._lock:
+            ent = self._tracked.get(id(fn))
+            if ent is None:
+                ent = self._tracked[id(fn)] = _Tracked()
+                key = id(fn)
+                try:
+                    # drop the entry when fn dies so a recycled id() can't
+                    # inherit stale call counts (and we never pin the
+                    # compiled program or its captured params)
+                    ent.ref = weakref.ref(
+                        fn, lambda _r, _k=key: self._tracked.pop(_k, None))
+                except TypeError:
+                    ent.ref = None
+            ent.calls += 1
+            calls, prev = ent.calls, ent.cache_size
+            ent.cache_size = size
+        if prev is None or size <= prev:
+            return
+        self._retraces.labels(fn=name).inc(size - prev)
+        if calls > self.steady_after:
+            _log.warning(
+                "retrace watchdog: %s recompiled at call %d (trace cache "
+                "%d -> %d)%s — a steady-state recompile usually means an "
+                "input shape/dtype or static argument is drifting "
+                "(unbucketed batch dim?); each one stalls the step for the "
+                "full XLA compile", name, calls, prev, size,
+                f" [{detail}]" if detail else "")
+
+    def watch(self, fn, name=None):
+        """Wrap a jitted callable so every call is observed.  Note: the
+        wrapper is not a ``jax.stages.Wrapped``, so pass the *unwrapped*
+        function anywhere that special-cases jit objects (e.g. the tape's
+        deferred-vjp fast path) and call ``observe`` yourself instead."""
+        return _WatchedJit(self, fn,
+                           name or getattr(fn, "__name__", "jit_fn"))
+
+
+class _WatchedJit:
+    def __init__(self, wd, fn, name):
+        self._wd = wd
+        self._fn = fn
+        self._name = name
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        self._wd.observe(self._fn, self._name)
+        return out
+
+    def __getattr__(self, attr):
+        return getattr(self._fn, attr)
+
+
+_default_watchdog = None
+_default_watchdog_lock = threading.Lock()
+
+
+def watchdog():
+    """The process-wide watchdog instance (shared by HybridBlock,
+    FusedTrainStep, and ``watch_jit``)."""
+    global _default_watchdog
+    if _default_watchdog is None:
+        with _default_watchdog_lock:
+            if _default_watchdog is None:
+                _default_watchdog = RetraceWatchdog()
+    return _default_watchdog
+
+
+def watch_jit(fn, name=None):
+    """Wrap ``fn`` (jitted) so the default watchdog sees every call."""
+    return watchdog().watch(fn, name)

@@ -1,0 +1,474 @@
+"""Telemetry layer tests: metrics registry (labels/buckets/exposition),
+step-trace spans, retrace watchdog, and the publisher integrations
+(trainer, kvstore tpu_ici, serve) — ISSUE 2."""
+import json
+import logging
+import re
+import threading
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler, telemetry
+from mxnet_tpu.gluon import nn
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_labels():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("req_total", "requests", ("endpoint", "event"))
+    c.labels(endpoint="a", event="ok").inc()
+    c.labels(endpoint="a", event="ok").inc(2)
+    c.labels("a", "err").inc()
+    assert c.labels(endpoint="a", event="ok").value == 3
+    assert reg.get_sample_value(
+        "req_total", {"endpoint": "a", "event": "err"}) == 1
+    # unknown combination reads as absent
+    assert reg.get_sample_value(
+        "req_total", {"endpoint": "b", "event": "ok"}) is None
+    with pytest.raises(ValueError):
+        c.inc()          # labeled family needs .labels()
+    with pytest.raises(ValueError):
+        c.labels(endpoint="a").inc()   # missing label
+    with pytest.raises(ValueError):
+        c.labels(endpoint="a", event="ok").inc(-1)  # counters go up
+
+
+def test_registry_gauge_and_reregistration():
+    reg = telemetry.MetricsRegistry()
+    g = reg.gauge("depth", "queue depth")
+    g.set(5)
+    g.dec(2)
+    assert g.value == 3
+    # get-or-create returns the same family; kind mismatch raises
+    assert reg.gauge("depth") is g
+    with pytest.raises(ValueError):
+        reg.counter("depth")
+
+
+def test_registry_histogram_buckets():
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    # cumulative bucket semantics: le is inclusive
+    assert reg.get_sample_value("lat_seconds_bucket", {"le": "0.01"}) == 1
+    assert reg.get_sample_value("lat_seconds_bucket", {"le": "0.1"}) == 2
+    assert reg.get_sample_value("lat_seconds_bucket", {"le": "1"}) == 3
+    assert reg.get_sample_value("lat_seconds_bucket", {"le": "+Inf"}) == 4
+    assert reg.get_sample_value("lat_seconds_count", {}) == 4
+    assert reg.get_sample_value("lat_seconds_sum", {}) == \
+        pytest.approx(5.555)
+    # an observation exactly on a bound lands in that bucket
+    h.observe(0.1)
+    assert reg.get_sample_value("lat_seconds_bucket", {"le": "0.1"}) == 3
+
+
+_PROM_LINE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s([-+0-9.eE]+|[+-]Inf)$')
+
+
+def _parse_prometheus(text):
+    """{(sample_name, frozenset(label items)): value}"""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labels, value = m.groups()
+        items = frozenset(
+            tuple(kv.split("=", 1)) for kv in labels.split(",")) \
+            if labels else frozenset()
+        items = frozenset((k, v.strip('"')) for k, v in items)
+        out[(name, items)] = float(value)
+    return out
+
+
+def test_exposition_roundtrip():
+    """Prometheus text and JSON exposition carry the same samples."""
+    reg = telemetry.MetricsRegistry()
+    reg.counter("a_total", 'with "quotes" and \\slash', ("k",)) \
+        .labels(k='va"l').inc(7)
+    reg.gauge("b").set(-2.5)
+    h = reg.histogram("c_seconds", "h", ("p",), buckets=(0.5,))
+    h.labels(p="x").observe(0.25)
+    h.labels(p="x").observe(2.0)
+
+    prom = _parse_prometheus(reg.export_prometheus())
+    doc = json.loads(reg.export_json())
+    json_samples = {}
+    for fam in doc["metrics"]:
+        for s in fam["samples"]:
+            key = (s["name"], frozenset(
+                (k, str(v)) for k, v in s["labels"].items()))
+            json_samples[key] = float(s["value"])
+    # every prom sample appears in json with the same value (label
+    # escaping differs textually, so compare the unescaped json side by
+    # count + spot values)
+    assert len(prom) == len(json_samples)
+    assert json_samples[("b", frozenset())] == -2.5
+    assert json_samples[("c_seconds_bucket",
+                         frozenset({("p", "x"), ("le", "0.5")}))] == 1
+    assert json_samples[("c_seconds_count", frozenset({("p", "x")}))] == 2
+    assert prom[("b", frozenset())] == -2.5
+
+
+def test_registry_thread_safety():
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("n_total")
+    h = reg.histogram("n_seconds", buckets=(0.5,))
+
+    def work():
+        for _ in range(20000):
+            c.inc()
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 80000
+    assert reg.get_sample_value("n_seconds_count", {}) == 80000
+
+
+# ---------------------------------------------------------------------------
+# retrace / compile watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_flags_forced_rejit(caplog):
+    import jax
+    import jax.numpy as jnp
+
+    reg = telemetry.MetricsRegistry()
+    wd = telemetry.RetraceWatchdog(steady_after=1, registry=reg)
+    f = wd.watch(jax.jit(lambda x: x * 2), name="double")
+    f(jnp.ones((3,)))          # first compile: expected, not a retrace
+    f(jnp.ones((3,)))          # cached
+    assert wd.retrace_count("double") == 0
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.telemetry"):
+        f(jnp.ones((4,)))      # shape drift past steady state -> re-jit
+    assert wd.retrace_count("double") == 1
+    assert reg.get_sample_value(
+        "mxtpu_jit_retrace_total", {"fn": "double"}) == 1
+    warnings = [r for r in caplog.records if "double" in r.getMessage()]
+    assert warnings and "recompile" in warnings[0].getMessage()
+
+
+def test_watchdog_quiet_before_steady_state(caplog):
+    import jax
+    import jax.numpy as jnp
+
+    reg = telemetry.MetricsRegistry()
+    wd = telemetry.RetraceWatchdog(steady_after=5, registry=reg)
+    f = wd.watch(jax.jit(lambda x: x + 1), name="warming")
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.telemetry"):
+        for n in (2, 3, 4):    # warmup sweep: counted, never warned
+            f(jnp.ones((n,)))
+    assert wd.retrace_count("warming") == 2
+    assert not [r for r in caplog.records if "warming" in r.getMessage()]
+
+
+def test_compile_listener_counts_xla_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    reg = telemetry.default_registry()
+
+    def count():
+        return reg.get_sample_value(
+            "mxtpu_xla_compile_total", {"stage": "compile"}) or 0
+
+    before = count()
+    jax.jit(lambda x: x * 3.5 + 1)(jnp.ones((5,)))   # fresh fn: must compile
+    assert count() >= before + 1
+    assert (reg.get_sample_value(
+        "mxtpu_xla_compile_seconds_count", {"stage": "compile"}) or 0) > 0
+
+
+def test_hybrid_block_observed_by_default_watchdog():
+    net = nn.Dense(3)
+    net.initialize()
+    net.hybridize()
+    name = "Dense.hybrid_forward"
+    before = telemetry.default_registry().get_sample_value(
+        "mxtpu_jit_retrace_total", {"fn": name}) or 0
+    net(mx.np.ones((2, 4)))
+    net(mx.np.ones((2, 4)))     # steady
+    net(mx.np.ones((6, 4)))     # batch-shape drift forces a re-trace
+    after = telemetry.default_registry().get_sample_value(
+        "mxtpu_jit_retrace_total", {"fn": name}) or 0
+    assert after >= before + 1
+
+
+# ---------------------------------------------------------------------------
+# step-trace spans + trainer phases
+# ---------------------------------------------------------------------------
+
+def _train_3_steps(hybridize=True):
+    net = nn.Dense(4)
+    net.initialize()
+    if hybridize:
+        net.hybridize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    x = mx.np.array(onp.random.randn(2, 3).astype(onp.float32))
+    for _ in range(3):
+        with mx.autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        trainer.step(2)
+    return net, x
+
+
+def test_trainer_step_phases_in_trace():
+    profiler.dumps(reset=True)
+    profiler.set_state("run")
+    _train_3_steps(hybridize=True)
+    profiler.set_state("stop")
+    events = json.loads(profiler.dumps(format="json", reset=True))[
+        "traceEvents"]
+    phases = {e["name"] for e in events if e.get("cat") == "step_phase"}
+    assert {"step/fwd", "step/bwd", "step/allreduce",
+            "step/optimizer"} <= phases
+    # op events share the same timeline (the hybrid forward dispatch)
+    assert any(e.get("cat") == "operator" for e in events)
+    # 3 steps -> at least 3 spans per phase
+    fwd = [e for e in events if e.get("name") == "step/fwd"]
+    assert len(fwd) >= 3 and all(e.get("dur", 0) >= 0 for e in fwd)
+    # while profiling, op dispatches also publish into the registry
+    assert "mxtpu_ops_dispatched_total{" in telemetry.export_prometheus()
+
+
+def test_step_phase_histogram_published():
+    before = telemetry.default_registry().get_sample_value(
+        "mxtpu_trainer_step_phase_seconds_count", {"phase": "optimizer"}) or 0
+    _train_3_steps(hybridize=False)
+    after = telemetry.default_registry().get_sample_value(
+        "mxtpu_trainer_step_phase_seconds_count", {"phase": "optimizer"})
+    assert after == before + 3
+    text = telemetry.export_prometheus()
+    assert 'mxtpu_trainer_step_phase_seconds_bucket{phase="optimizer"' \
+        in text
+
+
+def test_dataloader_data_wait_phase():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    ds = ArrayDataset(onp.arange(32, dtype=onp.float32).reshape(8, 4))
+    loader = DataLoader(ds, batch_size=4)
+    before = telemetry.default_registry().get_sample_value(
+        "mxtpu_trainer_step_phase_seconds_count", {"phase": "data-wait"}) or 0
+    assert len(list(loader)) == 2
+    after = telemetry.default_registry().get_sample_value(
+        "mxtpu_trainer_step_phase_seconds_count", {"phase": "data-wait"})
+    assert after == before + 2
+
+
+# ---------------------------------------------------------------------------
+# kvstore collectives
+# ---------------------------------------------------------------------------
+
+def test_tpu_ici_collective_counters():
+    kv = mx.kv.create("tpu_ici")
+    reg = telemetry.default_registry()
+    n_before = reg.get_sample_value(
+        "mxtpu_kvstore_collective_total", {"op": "allreduce"}) or 0
+    b_before = reg.get_sample_value(
+        "mxtpu_kvstore_collective_bytes_total", {"op": "allreduce"}) or 0
+    vals = [mx.np.ones((4, 4), ctx=mx.cpu(i)) for i in range(4)]
+    kv.pushpull(0, vals)
+    assert reg.get_sample_value(
+        "mxtpu_kvstore_collective_total", {"op": "allreduce"}) == n_before + 1
+    # 4 copies x 16 f32 = 256 payload bytes
+    assert reg.get_sample_value(
+        "mxtpu_kvstore_collective_bytes_total",
+        {"op": "allreduce"}) == b_before + 256
+    assert (reg.get_sample_value(
+        "mxtpu_kvstore_collective_seconds_count", {"op": "allreduce"}) or 0) \
+        >= n_before + 1
+
+
+def test_tpu_ici_collective_span_in_trace():
+    kv = mx.kv.create("tpu_ici")
+    profiler.dumps(reset=True)
+    profiler.set_state("run")
+    vals = [mx.np.ones((2, 2), ctx=mx.cpu(i)) for i in range(2)]
+    kv.pushpull(1, vals)
+    profiler.set_state("stop")
+    events = json.loads(profiler.dumps(format="json", reset=True))[
+        "traceEvents"]
+    spans = [e for e in events if e.get("cat") == "collective"]
+    assert spans and spans[0]["name"] == "collective/allreduce"
+    assert spans[0]["args"]["bytes"] == 2 * 2 * 2 * 4
+
+
+# ---------------------------------------------------------------------------
+# serve integration
+# ---------------------------------------------------------------------------
+
+def test_serve_series_in_registry():
+    net = nn.Dense(4)
+    net.initialize()
+    ep = net.as_endpoint(max_batch_size=4, max_latency_ms=2)
+    try:
+        out = ep.predict(mx.np.ones((2, 3)))
+        assert out.shape == (2, 4)
+    finally:
+        ep.shutdown(drain=True)
+    reg = telemetry.default_registry()
+    labels = {"endpoint": ep.name, "event": "completed"}
+    assert reg.get_sample_value("mxtpu_serve_requests_total", labels) == 1
+    assert reg.get_sample_value(
+        "mxtpu_serve_latency_seconds_count", {"endpoint": ep.name}) == 1
+    assert reg.get_sample_value(
+        "mxtpu_serve_batch_rows_total",
+        {"endpoint": ep.name, "kind": "real"}) == 2
+    text = telemetry.export_prometheus()
+    assert f'mxtpu_serve_batches_total{{endpoint="{ep.name}"}}' in text
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: ONE dump interleaves every source
+# ---------------------------------------------------------------------------
+
+def test_unified_trace_one_dump(tmp_path):
+    profiler.dumps(reset=True)
+    f = str(tmp_path / "unified.json")
+    profiler.set_config(filename=f)
+    profiler.set_state("run")
+
+    net, x = _train_3_steps(hybridize=True)           # step phases + ops
+    kv = mx.kv.create("tpu_ici")
+    kv.pushpull(0, [mx.np.ones((4,), ctx=mx.cpu(i)) for i in range(2)])
+    ep = net.as_endpoint(max_batch_size=4, max_latency_ms=2)
+    try:
+        ep.predict(x)                                  # serve dispatch
+    finally:
+        ep.shutdown(drain=True)
+
+    profiler.dump()            # finished=True: stops + writes + resets
+    assert profiler.state() == "stop"
+    events = json.load(open(f))["traceEvents"]
+    cats = {e.get("cat") for e in events}
+    assert {"step_phase", "operator", "collective", "serve"} <= cats
+    serve_spans = [e for e in events if e.get("cat") == "serve"]
+    assert serve_spans[0]["args"]["rows"] == 2
+    # the dump reset the shared buffer: a fresh dumps() is empty
+    assert json.loads(profiler.dumps(format="json"))["traceEvents"] == []
+    # registry covers trainer + kvstore + serve series in one scrape
+    text = telemetry.export_prometheus()
+    for series in ("mxtpu_trainer_step_phase_seconds",
+                   "mxtpu_kvstore_collective_total",
+                   "mxtpu_serve_requests_total",
+                   "mxtpu_xla_compile_total"):
+        assert series in text, series
+
+
+# ---------------------------------------------------------------------------
+# profiler satellites
+# ---------------------------------------------------------------------------
+
+def test_profiler_counter_concurrent_increments():
+    """increment/decrement are read-modify-write: without the lock,
+    concurrent serve threads lose updates."""
+    c = profiler.Domain("unit").new_counter("hits", 0)
+
+    def work():
+        for _ in range(30000):
+            c.increment()
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 120000
+    c.decrement(120000)
+    assert c.value == 0
+
+
+def test_profiler_scope_enter_failure_leaves_no_dangling_span(monkeypatch):
+    class Boom:
+        def __init__(self, name):
+            pass
+
+        def __enter__(self):
+            raise RuntimeError("annotation unavailable")
+
+        def __exit__(self, *exc):
+            return False
+
+    import jax
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation", Boom)
+    profiler.dumps(reset=True)
+    profiler.set_state("run")
+    sc = profiler.scope("doomed")
+    with pytest.raises(RuntimeError):
+        sc.__enter__()
+    sc.__exit__(None, None, None)     # must not raise nor emit
+    profiler.set_state("stop")
+    events = json.loads(profiler.dumps(format="json", reset=True))[
+        "traceEvents"]
+    assert not any(e.get("name") == "doomed" for e in events)
+
+
+def test_profiler_dump_not_finished_keeps_state(tmp_path):
+    profiler.dumps(reset=True)
+    profiler.set_config(filename=str(tmp_path / "flush.json"))
+    profiler.set_state("run")
+    with profiler.scope("keep-me"):
+        pass
+    profiler.dump(finished=False)     # periodic flush: stays running
+    assert profiler.state() == "run"
+    with profiler.scope("second"):
+        pass
+    profiler.set_state("stop")
+    events = json.loads(profiler.dumps(format="json", reset=True))[
+        "traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"keep-me", "second"} <= names   # buffer was not reset
+
+
+# ---------------------------------------------------------------------------
+# monitor satellites
+# ---------------------------------------------------------------------------
+
+def test_monitor_toc_print_fixed_precision(caplog):
+    from mxnet_tpu.monitor import Monitor
+
+    net = nn.Dense(2)
+    net.initialize()
+    mon = Monitor(interval=1).install(net)
+    mon.tic()
+    net(mx.np.ones((1, 3)))
+    with caplog.at_level(logging.INFO):
+        mon.toc_print()
+    stats = [r.getMessage() for r in caplog.records
+             if r.getMessage().startswith("Batch:")]
+    assert stats
+    for line in stats:
+        assert re.search(r"\d+\.\d{6}$", line), line
+    mon.uninstall()
+
+
+def test_block_children_public_iteration():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    kids = net.children
+    assert isinstance(kids, dict) and len(kids) == 2
+    assert all(isinstance(c, mx.gluon.Block) for c in kids.values())
+    # Monitor.install walks through the public surface
+    from mxnet_tpu.monitor import Monitor
+    net.initialize()
+    mon = Monitor(interval=1).install(net)
+    mon.tic()
+    net(mx.np.ones((1, 3)))
+    names = {n for _s, n, _v in mon.toc()}
+    assert any(".0_output" in n for n in names)
+    mon.uninstall()

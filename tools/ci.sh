@@ -23,7 +23,12 @@ run_import() {
   echo "ci: collect-only 0 errors"
 }
 run_smoke()  { bash tools/smoke.sh; }
-run_test()   { python -m pytest tests/ -q -x; }
+run_test()   {
+  # telemetry first: the observability layer every later perf PR reads
+  # its numbers from fails fast and loud (ISSUE 2)
+  python -m pytest tests/test_telemetry.py -q
+  python -m pytest tests/ -q -x
+}
 run_perf()   { python benchmark/opperf/opperf.py --smoke; }
 run_dryrun() {
   # pytest already runs the 4-process launcher test; skip it inside the

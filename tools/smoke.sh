@@ -53,6 +53,24 @@ assert ep.stats()["completed"] == 1
 ep.shutdown(drain=True)
 print("smoke: serve round-trip ok")
 
+# 2b. telemetry gate (ISSUE 2): the Prometheus exposition must parse and
+# reflect the traffic just served — a broken exporter or a silently
+# non-publishing endpoint can never land
+import re as _re
+from mxnet_tpu import telemetry
+text = telemetry.export_prometheus()
+line_re = _re.compile(
+    r'^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ?.*'
+    r'|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [-+0-9.eE]+)$')
+for line in text.splitlines():
+    if line:
+        assert line_re.match(line), f"unparseable exposition line: {line!r}"
+completed = telemetry.default_registry().get_sample_value(
+    "mxtpu_serve_requests_total", {"endpoint": ep.name, "event": "completed"})
+assert completed and completed >= 1, f"serve counter not published: {completed}"
+assert "mxtpu_trainer_step_phase_seconds" in text  # trainer series present
+print("smoke: telemetry export ok")
+
 # 3. bench.py must at least import (its main guard must not run)
 import importlib.util as _u
 spec = _u.spec_from_file_location("bench", "bench.py")
