@@ -497,27 +497,45 @@ def main():
         except Exception as e:
             result["ab_error"] = str(e)[:200]
     # transformer rider (r3 verdict #2): BERT-base pretraining tokens/s +
-    # MFU in the same artifact line.  Subprocess-isolated like the other
-    # riders; BENCH_BERT_TIMEOUT=0 skips it.
+    # MFU in the same artifact line.  Since round 6 the rider trains the
+    # RECIPE-REALISTIC configuration — padded variable-length batches
+    # with the padding mask threaded through attention, plus attention
+    # dropout 0.1 — and a second long-T point (B=4, T=2048) where the
+    # auto policy puts that configuration on the in-kernel flash path.
+    # Subprocess-isolated like the other riders; BENCH_BERT_TIMEOUT=0
+    # skips both.
     bert_timeout = float(os.environ.get("BENCH_BERT_TIMEOUT", "600"))
+
+    def bert_rider(extra_args):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmark", "bert_pretrain_bench.py"),
+             *extra_args],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            timeout=bert_timeout)
+        rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
+                if l.startswith("{")]
+        if proc.returncode != 0 or not rows:
+            raise RuntimeError(
+                f"bert rider rc={proc.returncode}: "
+                f"{proc.stderr.strip()[-160:]}")
+        return rows[0]
+
     if bert_timeout > 0:
         try:
-            proc = subprocess.run(
-                [sys.executable,
-                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "benchmark", "bert_pretrain_bench.py")],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-                timeout=bert_timeout)
-            rows = [json.loads(l) for l in proc.stdout.strip().splitlines()
-                    if l.startswith("{")]
-            if proc.returncode != 0 or not rows:
-                raise RuntimeError(
-                    f"bert rider rc={proc.returncode}: "
-                    f"{proc.stderr.strip()[-160:]}")
-            result["bert_tokens_per_s"] = rows[0]["value"]
-            result["bert_mfu_vs_197tf_bf16"] = rows[0]["mfu_vs_197tf_bf16"]
+            row = bert_rider([])
+            result["bert_tokens_per_s"] = row["value"]
+            result["bert_mfu_vs_197tf_bf16"] = row["mfu_vs_197tf_bf16"]
+            result["bert_masked_dropout"] = row.get("masked", False)
         except Exception as e:
             result["bert_error"] = str(e)[:200]
+        try:
+            row = bert_rider(["--batch", "4", "--seq", "2048"])
+            result["bert_flash_t2048_tokens_per_s"] = row["value"]
+            result["bert_flash_t2048_mfu"] = row["mfu_vs_197tf_bf16"]
+        except Exception as e:
+            result["bert_flash_error"] = str(e)[:200]
     print(json.dumps(result))
 
 

@@ -158,8 +158,9 @@ def main():
 
     if args.blocks:
         def prod(bq, bk):
-            return lambda q, k, v: pk._flash(q, k, v, False, None,
-                                             bq, bk, None)
+            return lambda q, k, v: pk.flash_attention(q, k, v,
+                                                      block_q=bq,
+                                                      block_k=bk)
         variants = {
             "bq512_bk512": prod(512, 512),
             "bq512_bk1024": prod(512, 1024),
@@ -170,8 +171,7 @@ def main():
         }
     else:
         variants = {
-            "full": lambda q, k, v: pk._flash(q, k, v, False, None, None,
-                                              None, None),
+            "full": lambda q, k, v: pk.flash_attention(q, k, v),
             "probe_ref": _variant_kernel("ref"),
             "noexp": _variant_kernel("noexp"),
             "nosoftmax": _variant_kernel("nosoftmax"),

@@ -139,22 +139,24 @@ class FusedTrainStep:
         n_opt = len(self._opt_index)
 
         def fused(train_ws, const_pd, states, root_key, flat_inputs, scal,
-                  clip, treedef_id):
+                  counter, clip, treedef_id):
             if root_key.dtype == jnp.uint32:  # multi-process: raw key data
                 root_key = jax.random.wrap_key_data(root_key)
             # per-step scalars arrive as ONE bundled f32 array (one H2D
             # put instead of 4-6 tiny ones, each ~0.3-1 ms through the
-            # tunnel): [lrs(n), wds(n), ts(n), rescale, counter_bits].
-            # The PRNG key folds IN-PROGRAM from the stream counter
-            # (bitcast-exact int32 in the f32 bundle) — identical key to
-            # the old host-side new_key(), minus its ~2 ms dispatch.
+            # tunnel): [lrs(n), wds(n), ts(n), rescale].  The PRNG
+            # stream counter ships as its OWN 1-element int32 array
+            # (ADVICE r5): the old int32-bits-viewed-as-f32 trick put
+            # counters >= 0x7F800000 on inf/NaN bitpatterns, which any
+            # canonicalizing transfer/compiler pass may silently rewrite
+            # — a float bundle is not a lossless int channel.  The key
+            # still folds IN-PROGRAM, so the per-step dispatch saving
+            # stands, and the key is identical to host-side new_key().
             lrs = scal[:n_opt]
             wds = scal[n_opt:2 * n_opt]
             ts = scal[2 * n_opt:3 * n_opt]
             rescale = scal[3 * n_opt]
-            counter = jax.lax.bitcast_convert_type(
-                scal[3 * n_opt + 1], jnp.int32)
-            key = jax.random.fold_in(root_key, counter)
+            key = jax.random.fold_in(root_key, counter[0])
 
             def loss_fn(tws):
                 full = list(const_pd)
@@ -188,7 +190,7 @@ class FusedTrainStep:
             return outs, auxs, tuple(new_ws), tuple(new_states)
 
         return jax.jit(fused, donate_argnums=(0, 2),
-                       static_argnums=(6, 7))
+                       static_argnums=(7, 8))
 
     def __call__(self, *args, batch_size=1):
         return self.step(*args, batch_size=batch_size)
@@ -232,7 +234,7 @@ class FusedTrainStep:
             for i in self._opt_index)
 
         n_opt = len(self._opt_index)
-        scal = onp.empty(3 * n_opt + 2, onp.float32)
+        scal = onp.empty(3 * n_opt + 1, onp.float32)
         for j, i in enumerate(self._opt_index):
             optimizer._update_count(i)
             scal[j] = optimizer._get_lr(i)
@@ -240,14 +242,16 @@ class FusedTrainStep:
             scal[2 * n_opt + j] = optimizer._index_update_count[i]
         scal[3 * n_opt] = optimizer.rescale_grad
         root, counter = _rng.root_and_counter()
-        scal[3 * n_opt + 1] = onp.array(counter, onp.int32).view(
-            onp.float32)[()]
+        # separate int32 channel — never routed through float bits (the
+        # NaN-canonicalization hazard; see _build)
+        cnt = onp.asarray([counter], onp.int32)
         if self._mesh is not None and not self._rep.is_fully_addressable:
             # multi-process mesh: every per-step input must be a global
             # array (identical on all processes — deterministic streams).
             # The root key transfers once per seed, not per step.
             gp = self._global_put
             scal = gp(scal, self._rep)
+            cnt = gp(cnt, self._rep)
             # cache keyed by a STRONG reference to the root object: an
             # id()-only check could spuriously hit after a reseed if the
             # old key object's address were reused
@@ -258,11 +262,12 @@ class FusedTrainStep:
             root = self._root_global
         else:
             scal = jnp.asarray(scal)
+            cnt = jnp.asarray(cnt)
 
         _telemetry.mark_step()
         with _telemetry.step_phase("fused-step"):
             outs, auxs, new_ws, new_states = self._jit(
-                train_ws, const_pd, states, root, flat, scal,
+                train_ws, const_pd, states, root, flat, scal, cnt,
                 optimizer.clip_gradient, treedef_id)
         _telemetry.watchdog().observe(
             self._jit, name=f"FusedTrainStep[{type(self._block).__name__}]")

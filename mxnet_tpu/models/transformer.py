@@ -83,25 +83,22 @@ class MultiHeadAttention(HybridBlock):
         assert units % num_heads == 0, "num_heads must divide units"
         # Pallas flash kernel for sequences where the (T, T) score matrix
         # is the memory wall; XLA's fused dense attention is faster at
-        # moderate T (see ops/pallas_kernels.py).  The kernel computes
-        # unmasked softmax over dense blocks, so it excludes attention
-        # masks and attention-dropout, and T must be <=128 or a multiple
-        # of 128.  The default "auto" picks flash per call once T reaches
-        # the measured crossover (FLASH_AUTO_MIN_T, from
+        # moderate T (see ops/pallas_kernels.py).  The kernel runs
+        # key-padding (B, T) masks AND attention dropout in-kernel (fwd
+        # and bwd — the recipe-realistic BERT configuration stays on the
+        # fast path); only full (B, T, S) attention masks still require
+        # the dense path, and T must be <=128 or a multiple of 128.  The
+        # default "auto" picks flash per call once T reaches the measured
+        # crossover (FLASH_AUTO_MIN_T, from
         # benchmark/results/attention_tpu_v5e.json) and every constraint
         # holds; True forces it (and raises on violations), False forces
         # dense.
-        # identity checks: `1 in (True, ...)` is True by equality, and a
-        # truthy non-True value would skip the dropout guard below
+        # identity checks: `1 in (True, ...)` is True by equality
         if not (use_flash is True or use_flash is False or
                 use_flash == "auto"):
             raise ValueError(
                 f"use_flash must be True, False, or 'auto'; got "
                 f"{use_flash!r}")
-        if use_flash is True and dropout > 0:
-            raise ValueError(
-                "use_flash does not support attention dropout; set "
-                "dropout=0 (residual/FFN dropout is unaffected)")
         self._units = units
         self._num_heads = num_heads
         self._head_dim = units // num_heads
@@ -129,7 +126,11 @@ class MultiHeadAttention(HybridBlock):
         Pallas kernel (lse-merged).  Composes with ``use_flash`` and the
         encoder-level ``remat`` boundary — the three long-context levers
         stack (benchmark/ATTENTION_ANALYSIS.md, recipe section).
-        Attention dropout and masks are excluded, like the flash kernel."""
+        Key-padding (B, T) masks thread through the ring (each ring step
+        applies the resident K block's mask; the lse merge is
+        mask-agnostic).  Attention dropout stays excluded here: per-step
+        in-kernel dropout would need per-device seed offsets to
+        decorrelate shards — the documented upgrade path."""
         if self._attn_dropout_rate > 0:
             raise ValueError("sequence parallelism excludes attention "
                              "dropout; set dropout=0")
@@ -157,8 +158,11 @@ class MultiHeadAttention(HybridBlock):
             from ..ops.invoke import is_backward_expected
             min_t = (FLASH_AUTO_MIN_T_TRAINING if is_backward_expected()
                      else FLASH_AUTO_MIN_T)
-            return (_on_tpu() and mask is None and
-                    self._attn_dropout_rate == 0 and
+            # key-padding (B, S) masks and attention dropout both run
+            # in-kernel (round 6); only a full (B, T, S) attention mask
+            # forces the dense path
+            mask_ok = mask is None or getattr(mask, "ndim", None) == 2
+            return (_on_tpu() and mask_ok and
                     t >= min_t and _flash_shape_ok(t))
         return bool(self._use_flash)
 
@@ -169,9 +173,10 @@ class MultiHeadAttention(HybridBlock):
         k = self.key(x).reshape(b, t, h, d)
         v = self.value(x).reshape(b, t, h, d)
         if self._sp_mesh is not None:
-            if mask is not None:
-                raise ValueError("sequence-parallel attention cannot "
-                                 "apply masks (ring kernel contract)")
+            if mask is not None and getattr(mask, "ndim", None) != 2:
+                raise ValueError(
+                    "sequence-parallel attention takes key-padding (B, T) "
+                    "masks only (the mask shards and rotates with K/V)")
             from ..parallel.ring_attention import ring_attention
             # flash inside the ring: forced True honors it (and raises on
             # kernel-contract violations, same as single-chip); auto
@@ -186,19 +191,24 @@ class MultiHeadAttention(HybridBlock):
                 q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
                 mesh=self._sp_mesh, axis_name=self._sp_axis,
                 causal=False, batch_axis=self._sp_batch_axis,
-                use_flash=flash)
+                use_flash=flash, mask=mask)
             out = out.swapaxes(1, 2).reshape(b, t, h * d)
             return self.proj(out)
         if self._flash_now(t, mask):
-            if mask is not None:
+            if mask is not None and mask.ndim != 2:
                 raise ValueError(
-                    "use_flash=True cannot apply attention masks (the "
-                    "kernel softmaxes dense blocks); drop the mask or pad "
-                    "to full length upstream")
+                    "use_flash runs key-padding (batch, seq) masks "
+                    "in-kernel; full (b, t, s) attention masks take the "
+                    "dense path (use_flash=False)")
             # length validation lives in the kernel (single source of
-            # truth: _flash_forward's divisibility check)
+            # truth: _flash_forward's divisibility check).  Attention
+            # dropout runs in-kernel, gated on train mode exactly like
+            # the dense path's nn.Dropout
+            from ..ops.invoke import is_training
+            drop = self._attn_dropout_rate if is_training() else 0.0
             out = npx.flash_attention(q.swapaxes(1, 2), k.swapaxes(1, 2),
-                                      v.swapaxes(1, 2))
+                                      v.swapaxes(1, 2), mask=mask,
+                                      dropout=drop)
             out = out.swapaxes(1, 2).reshape(b, t, h * d)
             return self.proj(out)
         scores = np.einsum("bthd,bshd->bhts", q, k) / math.sqrt(d)
@@ -238,8 +248,9 @@ class TransformerEncoderLayer(HybridBlock):
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
                  layer_norm_eps=1e-12, dtype="float32", use_flash="auto"):
         super().__init__()
-        # dropout propagates unchanged: with use_flash MHA raises its
-        # explicit attention-dropout error rather than silently diverging
+        # dropout propagates unchanged: the flash tier applies attention
+        # dropout in-kernel, so use_flash + dropout>0 is a supported
+        # (recipe-realistic) combination
         self.attention = MultiHeadAttention(units, num_heads,
                                             dropout=dropout, dtype=dtype,
                                             use_flash=use_flash)
@@ -303,11 +314,14 @@ class BertModel(HybridBlock):
     (sequence output, pooled output).
 
     ``use_flash="auto"`` (default) picks the Pallas flash kernel at the
-    measured crossovers; note the auto policy reads "is a backward
-    expected" from the tape, so forward-only passes that run in *train
-    mode* (e.g. MC-dropout inference) at 1024 <= T < 2048 get the
-    training tier where dense forward is ~2x faster — pass
-    ``use_flash=False`` explicitly for that usage pattern."""
+    measured crossovers — including with a ``valid_mask`` and with
+    attention dropout, which both run in-kernel (padded variable-length
+    batches never silently fall back to the dense O(T^2) path).  Note
+    the auto policy reads "is a backward expected" from the tape, so
+    forward-only passes that run in *train mode* (e.g. MC-dropout
+    inference) at 1024 <= T < 2048 get the training tier where dense
+    forward is ~2x faster — pass ``use_flash=False`` explicitly for
+    that usage pattern."""
 
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
@@ -338,8 +352,10 @@ class BertModel(HybridBlock):
         """The long-context recipe, one call: attention rides the sp ring
         (flash per ring step where eligible), composing with
         ``use_flash`` and ``remat`` — construct with
-        ``BertModel(use_flash=..., remat=True)`` then bind.  Requires
-        dropout=0 (ring/flash kernel contract)."""
+        ``BertModel(use_flash=..., remat=True)`` then bind.  A (B, T)
+        ``valid_mask`` threads through the ring; attention dropout is
+        the one exclusion (requires dropout=0 — per-device seed offsets
+        are the documented upgrade path)."""
         self.encoder.bind_sp_mesh(mesh, axis_name, batch_axis)
         return self
 

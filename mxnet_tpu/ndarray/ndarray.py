@@ -44,9 +44,17 @@ __all__ = ["NDArray", "array", "empty", "from_jax", "waitall"]
 # accumulation for boundary-crossing reductions.
 _INT64_INDEX_MSG = (
     "index position beyond 2^31-1 is not supported (32-bit index mode); "
-    "whole-array ops and below-boundary slice starts on >2^31-element "
-    "arrays ARE supported — see tests/test_large_tensor.py for the "
-    "boundary contract")
+    "whole-array ops, below-boundary slice starts, and contiguous-slice "
+    "ASSIGNMENT (lowered to static slice+concat, no scatter) on "
+    ">2^31-element arrays ARE supported — see tests/test_large_tensor.py "
+    "for the boundary contract")
+
+# Element-count ceiling above which __setitem__ refuses jax's scatter
+# lowering (32-bit scatter indices silently drop the write there) and
+# instead requires the scatter-free slice+concat plan.  Module constant
+# so tests can shrink it and exercise the big-array path on small
+# arrays.
+_SETITEM_SCATTER_LIMIT = 2 ** 31 - 1
 
 
 class NDArray:
@@ -333,6 +341,19 @@ class NDArray:
             return key._data
         return key
 
+    @staticmethod
+    def _bool_mask_ndim(k):
+        """A multi-dimensional boolean mask consumes ``k.ndim`` input
+        axes under numpy advanced indexing (everything else consumes
+        one); 0 for non-boolean keys."""
+        dt = getattr(k, "dtype", None)
+        try:
+            if dt is not None and onp.dtype(dt) == onp.bool_:
+                return int(getattr(k, "ndim", 0))
+        except TypeError:
+            pass  # extension dtypes (PRNG keys, ...) are not bool masks
+        return 0
+
     def _check_index_bounds(self, key):
         """Positional access that RESOLVES past 2^31-1 must fail loudly:
         jax's 32-bit index mode would otherwise OverflowError deep in
@@ -346,8 +367,11 @@ class NDArray:
 
         keys = key if isinstance(key, tuple) else (key,)
         # map key elements to axes the way numpy does: None (newaxis)
-        # consumes no input axis, Ellipsis consumes the unmatched middle
-        n_explicit = sum(1 for k in keys
+        # consumes no input axis, Ellipsis consumes the unmatched middle,
+        # and an n-dim BOOLEAN mask consumes n axes (ADVICE r5: counting
+        # it as one made later negative ints resolve against the wrong
+        # dim)
+        n_explicit = sum(NDArray._bool_mask_ndim(k) or 1 for k in keys
                          if k is not None and k is not Ellipsis)
         axis = 0
         dims = []
@@ -358,9 +382,16 @@ class NDArray:
                 dims.append(None)
                 axis += max(len(self.shape) - n_explicit, 0)
             else:
-                dims.append(self.shape[axis]
-                            if axis < len(self.shape) else None)
-                axis += 1
+                bn = NDArray._bool_mask_ndim(k)
+                if bn:
+                    # mask positions are within-bounds by construction;
+                    # the cursor just advances past the axes it consumes
+                    dims.append(None)
+                    axis += bn
+                else:
+                    dims.append(self.shape[axis]
+                                if axis < len(self.shape) else None)
+                    axis += 1
         for k, dim in zip(keys, dims):
             if k is None or k is Ellipsis:
                 continue
@@ -382,12 +413,102 @@ class NDArray:
         except OverflowError:
             raise IndexError(_INT64_INDEX_MSG) from None
 
+    @staticmethod
+    def _plan_slice_update(shape, key):
+        """Classify ``key`` as a write expressible WITHOUT a scatter —
+        ints and step-1 slices only — returning ``(starts, blk_shape,
+        idx_shape)`` for a scatter-free slice+concat lowering
+        (``blk_shape`` keeps int axes as size-1; ``idx_shape`` drops
+        them, numpy's value-broadcast shape), or None when the key needs
+        gather/scatter position operands (arrays, bool masks, strides,
+        newaxis) or an offset past 2^31-1.  Lets full-slice / contiguous
+        assignments work on >2^31-element arrays, where jax's 32-bit
+        scatter indices silently drop the write (ADVICE r5)."""
+        lim = 2 ** 31 - 1
+        keys = list(key) if isinstance(key, tuple) else [key]
+        if any(k is Ellipsis for k in keys):
+            if sum(1 for k in keys if k is Ellipsis) > 1:
+                return None
+            i = keys.index(Ellipsis)
+            keys[i:i + 1] = [slice(None)] * (len(shape) - (len(keys) - 1))
+        if len(keys) > len(shape):
+            return None
+        keys += [slice(None)] * (len(shape) - len(keys))
+        starts, blk, idx = [], [], []
+        for k, dim in zip(keys, shape):
+            if isinstance(k, bool):
+                return None
+            if isinstance(k, (int, onp.integer)):
+                v = int(k) + (dim if k < 0 else 0)
+                if not 0 <= v < dim or v > lim:
+                    return None
+                starts.append(v)
+                blk.append(1)
+            elif isinstance(k, slice):
+                if k.step not in (None, 1):
+                    return None
+                try:
+                    lo, hi, _ = k.indices(dim)
+                except TypeError:
+                    return None
+                if lo > lim:
+                    return None
+                starts.append(lo)
+                n = max(hi - lo, 0)
+                blk.append(n)
+                idx.append(n)
+            else:
+                return None  # arrays / masks / newaxis: real scatter
+        return tuple(starts), tuple(blk), tuple(idx)
+
     def __setitem__(self, key, value):
         # scatter on a >2^31-element array silently NO-OPS in 32-bit
         # index mode (jax truncates the index dtype and the write is
         # dropped, at any position — probed in tests/test_large_tensor.py)
-        if self.size > 2 ** 31 - 1:
-            raise IndexError(_INT64_INDEX_MSG)
+        # ... but full-slice / contiguous-slice assignments don't need a
+        # scatter at all: they lower to broadcast + static-slice/concat
+        # embedding (64-bit-safe static bounds, sub-2^31 starts).  Only
+        # writes that genuinely carry gather/scatter position operands
+        # keep the fence.
+        if self.size > _SETITEM_SCATTER_LIMIT:
+            plan = self._plan_slice_update(self.shape, key)
+            if plan is None:
+                raise IndexError(_INT64_INDEX_MSG)
+            starts, blk_shape, idx_shape = plan
+
+            def embed(x, u, sts, blk):
+                # STATIC slice + concat along each partial axis, value
+                # broadcast at the leaf: every op here is probed safe on
+                # >2^31-element operands, whereas dynamic_update_slice
+                # (the obvious lowering) segfaults on them on this
+                # toolchain (jax 0.4.37 CPU) — hence this shape
+                for ax, (lo, n) in enumerate(zip(sts, blk)):
+                    if lo == 0 and n == x.shape[ax]:
+                        continue
+                    pre = jax.lax.slice_in_dim(x, 0, lo, axis=ax)
+                    mid = jax.lax.slice_in_dim(x, lo, lo + n, axis=ax)
+                    post = jax.lax.slice_in_dim(x, lo + n, x.shape[ax],
+                                                axis=ax)
+                    mid = embed(mid, u, sts[:ax] + (0,) + sts[ax + 1:],
+                                blk)
+                    return jnp.concatenate([pre, mid, post], axis=ax)
+                return jnp.broadcast_to(u, x.shape).astype(x.dtype)
+
+            def place(x, v):
+                v = v.astype(x.dtype)
+                try:
+                    u = jnp.broadcast_to(v, idx_shape).reshape(blk_shape)
+                except (ValueError, TypeError):
+                    u = jnp.broadcast_to(v, blk_shape)
+                return embed(x, u, starts, blk_shape)
+
+            if isinstance(value, NDArray):
+                self._rebind(invoke(place, (self, value), name="setitem"))
+            else:
+                self._rebind(invoke(
+                    lambda x: place(x, jnp.asarray(value)), (self,),
+                    name="setitem"))
+            return
         self._check_index_bounds(key)
         k = self._index_data(key)
         try:

@@ -134,8 +134,15 @@ col2im = _op(_spatial.col2im, "col2im")
 def flash_attention(*args, **kwargs):
     """Blockwise (flash) attention Pallas kernel — lazy import so the core
     namespace does not pay the jax.experimental.pallas import cost (see
-    `ops/pallas_kernels.py`)."""
+    `ops/pallas_kernels.py`).  Accepts ``mask`` (key-padding (B, T)),
+    ``bias`` (additive scores, constant — no gradient), and in-kernel
+    ``dropout``; when dropout is requested without an explicit ``key``
+    one is drawn from the `mx.random` stream (so hybridize /
+    FusedTrainStep traces get fresh masks every step, and
+    `mx.random.seed` makes them reproducible)."""
     from ..ops.pallas_kernels import flash_attention as _fa
+    if kwargs.get("dropout") and kwargs.get("key") is None:
+        kwargs["key"] = _rng.new_key()
     return _fa(*args, **kwargs)
 
 

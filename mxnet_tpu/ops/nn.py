@@ -12,10 +12,22 @@ handled by `ops/invoke.py`).  Layouts follow the reference's defaults
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 import numpy as onp
+
+# Dropout RNG implementation, read ONCE at import (ADVICE r5): the value
+# is consulted inside traced dropout code, so a later env change could
+# never reach already-jitted executables — reading it per-call only made
+# that failure silent.  Set MXNET_DROPOUT_RNG before importing mxnet_tpu
+# (tests/benchmarks that must pin the stream do exactly that); the
+# programmatic escape hatch is `_dropout_key(key, impl=...)`.  See
+# docs/DESIGN.md ("Dropout RNG streams") for the threefry<->rbg
+# bitstream-change note.
+_DROPOUT_RNG_IMPL = os.environ.get("MXNET_DROPOUT_RNG", "rbg")
 
 
 def _tuplize(v, n):
@@ -488,10 +500,13 @@ def _dropout_key(key, impl=None):
     threefry stream — set MXNET_DROPOUT_RNG=threefry for the old bits
     (``impl`` overrides the env var; benchmarks pin it).  Reference
     analogue: dropout uses the cuDNN/GPU hardware RNG, not the CPU one
-    (`src/operator/nn/dropout-inl.h`)."""
-    import os
+    (`src/operator/nn/dropout-inl.h`).  The env var is read once at
+    module import (`_DROPOUT_RNG_IMPL`): dropout sites run inside traced
+    programs, so a post-import change could never affect cached
+    executables anyway — pin it before importing mxnet_tpu, or pass
+    ``impl`` explicitly."""
     if impl is None:
-        impl = os.environ.get("MXNET_DROPOUT_RNG", "rbg")
+        impl = _DROPOUT_RNG_IMPL
     if impl != "rbg":
         return key
     kd = jax.random.key_data(key).ravel()

@@ -31,6 +31,21 @@ Design notes (benchmark/ATTENTION_ANALYSIS.md has the measurements):
 - **The backward is two Pallas kernels** (dq; dk+dv) using the saved
   output and the log-sum-exp from the forward — the flash recompute
   strategy, memory O(T * block) in both directions.
+- **Masks and attention dropout run in-kernel** (round 6), fwd and bwd,
+  so recipe-realistic training (padded batches + attention dropout)
+  never leaves this tier.  A key-padding mask streams as (B, T) blocks
+  and a scalar-prefetched per-batch `kend` (1 + last valid key) drives
+  the same fetch-clamp machinery the causal skip uses, so fully-masked
+  padded tails move no HBM traffic and run no dots.  Dropout bits come
+  from a stateless threefry2x32 hash of (key, batch*head, q_pos, k_pos)
+  computed inside each kernel: the backward regenerates the exact
+  forward mask from the same seed with no (B, H, T, T) materialization
+  — the functional-RNG recompute contract (`numpy_extension.remat`).
+  The hardware PRNG (`pltpu.prng_seed`/`prng_random_bits`) was rejected
+  for this: its bits depend on draw *order*, so the k-major dkv kernel
+  could not regenerate the q-major forward mask without an in-kernel
+  transpose, and it has no interpret-mode lowering on this toolchain,
+  which would have left the whole dropout path untestable on CPU CI.
 
 Kernels run in interpret mode off-TPU, so they are testable on the CPU
 mesh against dense oracles.
@@ -41,14 +56,20 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as onp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .invoke import invoke
 
-__all__ = ["flash_attention", "flash_attention_with_lse"]
+__all__ = ["flash_attention", "flash_attention_with_lse",
+           "attn_dropout_mask"]
 
 _NEG_INF = -1e30
+# Rows whose running max / lse sits below this saw no valid key in any
+# block: the fully-masked-row sentinel.  Real scores are O(+-1e2); the
+# only way past the threshold is the _NEG_INF fill.
+_MASKED_ROW = -1e29
 # Default block targets, measured (benchmark/results/
 # flash_roofline_tpu_v5e.json block sweep): K blocks of 1024 beat 512 by
 # 1.68x fwd / 1.36x fwd+bwd at T=4096-8192 — the ablations attribute the
@@ -60,6 +81,10 @@ _NEG_INF = -1e30
 # and costs 2x the VMEM for the f32 score block — 1024 is the default.
 _BLOCK_TARGET_Q = 512
 _BLOCK_TARGET_K = 1024
+# Odd golden-ratio constant folding the batch*head index into the
+# threefry key (bijective in uint32, so distinct heads get distinct
+# keys).
+_BH_FOLD = 0x9E3779B9
 
 
 def _prec(dt):
@@ -94,20 +119,166 @@ def _causal_mask(s, qi, ki, block_q, block_k, transposed=False):
     return jnp.where(q_pos >= k_pos, s, _NEG_INF)
 
 
-def _ki_clamp(block_q, block_k):
-    """Fetch-index clamp for causal q-major grids: K blocks past the last
-    valid one re-fetch the last valid block (copy elided by Mosaic)."""
-    def clamp(qi, ki):
-        return jnp.minimum(ki, ((qi + 1) * block_q - 1) // block_k)
-    return clamp
+# ---------------------------------------------------------------------------
+# stateless in-kernel PRNG for attention dropout
+# ---------------------------------------------------------------------------
+def _rotl32(x, r):
+    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
 
 
-def _qi_clamp(block_q, block_k):
-    """Fetch-index clamp for causal k-major grids: Q blocks before the
-    first valid one re-fetch the first valid block."""
-    def clamp(ki, qi):
-        return jnp.maximum(qi, (ki * block_k) // block_q)
-    return clamp
+def _threefry2x32(k0, k1, c0, c1):
+    """Threefry-2x32 (20 rounds, Random123/JAX spec), first output word.
+
+    Pure elementwise uint32 arithmetic, so it lowers identically under
+    Mosaic and interpret mode and is position-stateless: the same
+    (key, counter) pair yields the same bits in ANY kernel, any block
+    shape, any traversal order — what lets the q-major forward and the
+    k-major dkv backward regenerate one dropout mask.  Verified
+    bit-identical to `jax._src.prng.threefry_2x32` in tests."""
+    ks2 = jnp.uint32(0x1BD11BDA) ^ k0 ^ k1
+    x0 = c0 + k0
+    x1 = c1 + k1
+    rot = ((13, 15, 26, 6), (17, 29, 16, 24))
+    inj = ((k1, ks2), (ks2, k0), (k0, k1), (k1, ks2), (ks2, k0))
+    for i, (a, b) in enumerate(inj):
+        for r in rot[i % 2]:
+            x0 = x0 + x1
+            x1 = _rotl32(x1, r)
+            x1 = x1 ^ x0
+        x0 = x0 + a
+        x1 = x1 + b + jnp.uint32(i + 1)
+    return x0
+
+
+def _keep_threshold(keep):
+    """uint32 threshold with P(bits < threshold) = keep."""
+    return min(int(round(keep * 4294967296.0)), 4294967295)
+
+
+def _seed_words(key):
+    """(2,) uint32 seed from a jax PRNG key (or raw uint32 words)."""
+    if hasattr(key, "dtype") and jnp.issubdtype(key.dtype, jnp.integer):
+        kd = jnp.ravel(key)
+    else:
+        kd = jax.random.key_data(key).ravel()
+    return jnp.concatenate([kd, kd])[:2].astype(jnp.uint32)
+
+
+def _keep_scale(seed_ref, bh, qi, ki, block_q, block_k, shape, thr,
+                inv_keep, transposed=False):
+    """Dropout keep/rescale factor block: inv_keep where the element's
+    threefry draw keeps it, else 0.  Seeded per (key, batch*head) with
+    global (q_pos, k_pos) counters, so every kernel regenerates the
+    identical mask regardless of block orientation."""
+    q_ax, k_ax = (1, 0) if transposed else (0, 1)
+    q_pos = (qi * block_q +
+             jax.lax.broadcasted_iota(jnp.int32, shape, q_ax)).astype(
+        jnp.uint32)
+    k_pos = (ki * block_k +
+             jax.lax.broadcasted_iota(jnp.int32, shape, k_ax)).astype(
+        jnp.uint32)
+    k0 = seed_ref[0] ^ (bh.astype(jnp.uint32) * jnp.uint32(_BH_FOLD))
+    bits = _threefry2x32(k0, seed_ref[1], q_pos, k_pos)
+    return jnp.where(bits < jnp.uint32(thr), inv_keep, 0.0).astype(
+        jnp.float32)
+
+
+def attn_dropout_mask(key, b, h, t_q, t_k, dropout):
+    """The exact keep/rescale mask the kernels regenerate fwd AND bwd:
+    (B, H, T_q, T_k) f32 of {0, 1/keep}.  Dense-oracle helper — tests
+    multiply it into a reference softmax to prove kernel parity; never
+    materialized on the production path."""
+    keep = 1.0 - float(dropout)
+    seed = _seed_words(key)
+    thr = jnp.uint32(_keep_threshold(keep))
+    bh = jnp.arange(b * h, dtype=jnp.uint32).reshape(b * h, 1, 1)
+    qp = jnp.arange(t_q, dtype=jnp.uint32).reshape(1, t_q, 1)
+    kp = jnp.arange(t_k, dtype=jnp.uint32).reshape(1, 1, t_k)
+    k0 = seed[0] ^ (bh * jnp.uint32(_BH_FOLD))
+    bits = _threefry2x32(jnp.broadcast_to(k0, (b * h, t_q, t_k)),
+                         seed[1], qp, kp)
+    mask = jnp.where(bits < thr, 1.0 / keep, 0.0).astype(jnp.float32)
+    return mask.reshape(b, h, t_q, t_k)
+
+
+# ---------------------------------------------------------------------------
+# mask plumbing
+# ---------------------------------------------------------------------------
+def _norm_mask(mask):
+    """Key-padding mask (B, T_k), any dtype -> int32 0/1."""
+    if mask.ndim != 2:
+        raise ValueError(
+            f"flash_attention mask must be a (batch, key_len) key-padding "
+            f"mask; got ndim={mask.ndim} (full (b, t, s) attention masks "
+            "take the dense path)")
+    return (mask != 0).astype(jnp.int32)
+
+
+def _kend(mi):
+    """(B,) int32: 1 + index of the last valid key (0 when none).  The
+    scalar-prefetched skip bound: K blocks at or past it are fully
+    masked, so the grid skips their compute and clamps their fetch —
+    padded tails cost neither dots nor HBM traffic."""
+    t = mi.shape[1]
+    first_from_end = jnp.argmax(mi[:, ::-1], axis=1)
+    has = jnp.any(mi != 0, axis=1)
+    return jnp.where(has, t - first_from_end, 0).astype(jnp.int32)
+
+
+def _bias_4d(bias, b, h, t):
+    """Normalize an additive attention bias to (B|1, H|1, T, T)."""
+    if bias.ndim == 2:
+        bias = bias.reshape(1, 1, *bias.shape)
+    elif bias.ndim == 3:
+        bias = bias.reshape(1, *bias.shape)
+    bb, hb, tq, tk = bias.shape
+    if tq != t or tk != t or bb not in (1, b) or hb not in (1, h):
+        raise ValueError(
+            f"bias shape {bias.shape} must broadcast to ({b}, {h}, {t}, {t})")
+    return bias
+
+
+def _bias_bh(bb, hb, h):
+    """Grid-index map for a (bb*hb, T, T) bias along the b*h grid dim."""
+    if bb == 1 and hb == 1:
+        return lambda bh: 0
+    if bb == 1:
+        return lambda bh: bh % h
+    if hb == 1:
+        return lambda bh: bh // h
+    return lambda bh: bh
+
+
+def _ck_factory(block_q, block_k, causal, masked, nh):
+    """Fetch-index clamp for q-major grids.  Causal: K blocks past the
+    diagonal re-fetch the last valid block (copy elided by Mosaic).
+    Masked: blocks past the batch row's `kend` (scalar-prefetched)
+    clamp the same way, so padded tails move no HBM traffic."""
+    def ck(bh, qi, ki, refs):
+        j = ki
+        if causal:
+            j = jnp.minimum(j, ((qi + 1) * block_q - 1) // block_k)
+        if masked:
+            kend = refs[0][bh // nh]
+            j = jnp.minimum(j, jnp.maximum(kend - 1, 0) // block_k)
+        return j
+    return ck
+
+
+def _cq_factory(block_q, block_k, causal, masked, nh, nq):
+    """Fetch-index clamp for k-major grids.  Causal: Q blocks before the
+    diagonal re-fetch the first valid block.  Masked: K rows entirely
+    past `kend` freeze the fetch at the final q block (the index the
+    previous live row ended on), so dead rows move no HBM traffic."""
+    def cq(bh, ki, qi, refs):
+        j = qi
+        if causal:
+            j = jnp.maximum(j, (ki * block_k) // block_q)
+        if masked:
+            alive = ki * block_k < refs[0][bh // nh]
+            j = jnp.where(alive, j, nq - 1)
+        return j
+    return cq
 
 
 def _sds(shape, dtype, like):
@@ -138,18 +309,64 @@ def _resolve(t, d, block_q, block_k, scale, interpret):
     return bq, bk, sc, interp
 
 
+def _alive(causal_cond, masked_cond, body):
+    conds = [c for c in (causal_cond, masked_cond) if c is not None]
+    if not conds:
+        return body()
+    pred = conds[0] if len(conds) == 1 else conds[0] & conds[1]
+    return pl.when(pred)(body)
+
+
+def _pallas(kernel, grid, in_specs, out_specs, out_shape, scratch,
+            interp, masked, operands, kend):
+    """One entry for both regimes: a plain grid, or (masked) a
+    PrefetchScalarGridSpec shipping `kend` ahead of the operands so the
+    BlockSpec index maps can clamp fetches on it."""
+    if masked:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
+            out_specs=out_specs, scratch_shapes=scratch)
+        return pl.pallas_call(kernel, grid_spec=grid_spec,
+                              out_shape=out_shape,
+                              interpret=interp)(kend, *operands)
+    return pl.pallas_call(kernel, grid=grid, in_specs=in_specs,
+                          out_specs=out_specs, out_shape=out_shape,
+                          scratch_shapes=scratch,
+                          interpret=interp)(*operands)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                *, scale, causal, block_q, block_k, nk):
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, nk, nh, masked,
+                has_bias, thr, inv_keep):
+    i = 1 if masked else 0
+    kend_ref = refs[0] if masked else None
+    q_ref, kt_ref, v_ref = refs[i:i + 3]
+    i += 3
+    mask_ref = bias_ref = seed_ref = None
+    if masked:
+        mask_ref = refs[i]
+        i += 1
+    if has_bias:
+        bias_ref = refs[i]
+        i += 1
+    if thr is not None:
+        seed_ref = refs[i]
+        i += 1
+    o_ref, lse_ref, m_ref, l_ref, acc_ref = refs[i:i + 5]
+
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     # Causal: K blocks entirely above the diagonal contribute nothing —
     # the last useful block for q block qi covers position (qi+1)*bq - 1.
     # Compute is skipped past it (and the BlockSpec index maps clamp the
     # fetch, so no HBM traffic moves either); the finish epilogue fires
-    # at the last VALID block, not nk-1.
+    # at the last VALID block, not nk-1.  Masked: the same skip applies
+    # past the batch row's kend (scalar-prefetched) — scratch state
+    # persists across skipped steps, so the epilogue condition is
+    # unchanged.
     last_ki = ((qi + 1) * block_q - 1) // block_k if causal else nk - 1
 
     @pl.when(ki == 0)
@@ -166,24 +383,40 @@ def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32,
                                 precision=_prec(q.dtype)) * scale
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if masked:
+            s = jnp.where(mask_ref[0] != 0, s, _NEG_INF)   # (1, bk) bcast
 
         m_prev = m_ref[...]                    # (block_q, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                 # (block_q, block_k) f32
+        if masked:
+            # fully-masked-so-far rows: exp(s - m) would be exp(0)=1 with
+            # both at _NEG_INF; anchoring the exponent at 0 keeps p = 0
+            m_exp = jnp.where(m_new > _MASKED_ROW, m_new, 0.0)
+        else:
+            m_exp = m_new
+        p = jnp.exp(s - m_exp)                 # (block_q, block_k) f32
         alpha = jnp.exp(m_prev - m_new)        # rescale of old mass
+        # l accumulates the UNdropped mass (softmax normalizes before
+        # dropout); only the value accumulation sees the dropped p
         l_ref[...] = l_ref[...] * alpha + p.sum(axis=1, keepdims=True)
+        if thr is not None:
+            p_acc = p * _keep_scale(seed_ref, bh, qi, ki, block_q, block_k,
+                                    p.shape, thr, inv_keep)
+        else:
+            p_acc = p
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p_acc.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=_prec(v.dtype))
         m_ref[...] = m_new
 
-    if causal:
-        pl.when(ki <= last_ki)(_compute)
-    else:
-        _compute()
+    _alive(ki <= last_ki if causal else None,
+           ki * block_k < kend_ref[bh // nh] if masked else None,
+           _compute)
 
     @pl.when(ki == last_ki)
     def _finish():
@@ -192,61 +425,108 @@ def _fwd_kernel(q_ref, kt_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         lse_ref[0] = m_ref[...] + jnp.log(l)     # (block_q, 1)
 
 
-def _flash_forward(qd, kd, vd, causal, scale, block_q, block_k, interpret):
+def _flash_forward(qd, kd, vd, mask, bias, seed, causal, scale, dropout,
+                   block_q, block_k, interpret):
     b, h, t, d = qd.shape
     bq, bk, sc, interp = _resolve(t, d, block_q, block_k, scale, interpret)
     nk = t // bk
+    masked = mask is not None
+    has_bias = bias is not None
+    drop = float(dropout or 0.0)
 
     qr = qd.reshape(b * h, t, d)
     ktr = kd.reshape(b * h, t, d).swapaxes(1, 2)   # (bh, D, T)
     vr = vd.reshape(b * h, t, d)
     kernel = functools.partial(
-        _fwd_kernel, scale=sc, causal=causal, block_q=bq, block_k=bk, nk=nk)
-    # Causal: clamp the K/V fetch index for skipped (fully-masked) blocks
-    # to the last valid one — an unchanged block index means Mosaic elides
-    # the copy, so skipped grid steps move no HBM traffic.
-    ck = _ki_clamp(bq, bk) if causal else (lambda qi, ki: ki)
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=(b * h, t // bq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, 0, ck(qi, ki))),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ck(qi, ki), 0)),
-        ],
+        _fwd_kernel, scale=sc, causal=causal, block_q=bq, block_k=bk,
+        nk=nk, nh=h, masked=masked, has_bias=has_bias,
+        thr=_keep_threshold(1.0 - drop) if drop else None,
+        inv_keep=1.0 / (1.0 - drop) if drop else 1.0)
+    # Causal/masked: clamp the K/V fetch index for skipped (fully-masked)
+    # blocks to the last valid one — an unchanged block index means Mosaic
+    # elides the copy, so skipped grid steps move no HBM traffic.
+    ck = _ck_factory(bq, bk, causal, masked, h)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki, *r: (bh, qi, 0)),
+        pl.BlockSpec((1, d, bk),
+                     lambda bh, qi, ki, *r: (bh, 0, ck(bh, qi, ki, r))),
+        pl.BlockSpec((1, bk, d),
+                     lambda bh, qi, ki, *r: (bh, ck(bh, qi, ki, r), 0)),
+    ]
+    operands = [qr, ktr, vr]
+    kend = None
+    if masked:
+        kend = _kend(mask)
+        operands.append(mask.reshape(b, 1, t))
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bk),
+            lambda bh, qi, ki, *r: (bh // h, 0, ck(bh, qi, ki, r))))
+    if has_bias:
+        bb, hb = bias.shape[0], bias.shape[1]
+        bmap = _bias_bh(bb, hb, h)
+        operands.append(bias.reshape(bb * hb, t, t))
+        in_specs.append(pl.BlockSpec(
+            (1, bq, bk),
+            lambda bh, qi, ki, *r: (bmap(bh), qi, ck(bh, qi, ki, r))))
+    if drop:
+        operands.append(seed)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    out, lse = _pallas(
+        kernel, (b * h, t // bq, nk), in_specs,
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki, *r: (bh, qi, 0)),
             # (bh, t, 1) layout: Mosaic requires the last two block dims
             # be (multiple-of-8, multiple-of-128) or span the array, so a
             # 2-D (1, bq) lse block is unlowereable; a trailing unit lane
             # dim satisfies it (padded to one lane tile in VMEM)
-            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki, *r: (bh, qi, 0)),
         ],
         out_shape=[
             _sds((b * h, t, d), qd.dtype, qr),
             _sds((b * h, t, 1), jnp.float32, qr),
         ],
-        scratch_shapes=[
+        scratch=[
             pltpu.VMEM((bq, 1), jnp.float32),   # running max
             pltpu.VMEM((bq, 1), jnp.float32),   # running sum
             pltpu.VMEM((bq, d), jnp.float32),   # output accumulator
         ],
-        interpret=interp,
-    )(qr, ktr, vr)
+        interp=interp, masked=masked, operands=operands, kend=kend)
     return out.reshape(b, h, t, d), lse.reshape(b, h, t)
 
 
 # ---------------------------------------------------------------------------
 # backward.  Standard flash backward:
-#   p  = exp(s*scale - lse);  dv = p^T do;  dp = do v^T
-#   ds = p * (dp - delta) * scale   with delta = rowsum(do * o)
-#   dq = ds k;  dk = ds^T q
+#   p  = exp(s*scale - lse);  dv = p~^T do;  dp = do v^T
+#   ds = p~ * dp - p * delta, all * scale   with delta = rowsum(do * o)
+# where p~ is p with the dropout keep/rescale mask applied (p~ = p when
+# dropout is off, collapsing to the classic ds = p * (dp - delta)).
 # The dq kernel streams K/V blocks past each q block; the dkv kernel
 # streams q/do blocks past each k block working in transposed (k-major)
-# score space so every dot stays standard-form.
+# score space so every dot stays standard-form.  Dropout masks are
+# REGENERATED from the same threefry seed (never stored); the padding
+# mask re-applies to the recomputed scores, and lse values below the
+# fully-masked-row sentinel anchor at 0 so dead rows produce exact-zero
+# gradients instead of exp(+huge) garbage.
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, kt_ref, k_ref, vt_ref, do_ref, lse_ref, dl_ref,
-                   dq_ref, acc_ref, *, scale, causal, block_q, block_k, nk):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, nk, nh, masked,
+                   has_bias, thr, inv_keep):
+    i = 1 if masked else 0
+    kend_ref = refs[0] if masked else None
+    q_ref, kt_ref, k_ref, vt_ref, do_ref, lse_ref, dl_ref = refs[i:i + 7]
+    i += 7
+    mask_ref = bias_ref = seed_ref = None
+    if masked:
+        mask_ref = refs[i]
+        i += 1
+    if has_bias:
+        bias_ref = refs[i]
+        i += 1
+    if thr is not None:
+        seed_ref = refs[i]
+        i += 1
+    dq_ref, acc_ref = refs[i:i + 2]
+
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     last_ki = ((qi + 1) * block_q - 1) // block_k if causal else nk - 1
@@ -267,31 +547,55 @@ def _bwd_dq_kernel(q_ref, kt_ref, k_ref, vt_ref, do_ref, lse_ref, dl_ref,
         s = jax.lax.dot_general(q, kt, (((1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32,
                                 precision=_prec(q.dtype)) * scale
+        if has_bias:
+            s = s + bias_ref[0].astype(jnp.float32)
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k)
+        if masked:
+            s = jnp.where(mask_ref[0] != 0, s, _NEG_INF)
+            lse = jnp.where(lse > _MASKED_ROW, lse, 0.0)
         p = jnp.exp(s - lse)                   # (block_q, block_k) f32
         dp = jax.lax.dot_general(do, vt, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32,
                                  precision=_prec(do.dtype))
+        if thr is not None:
+            dp = dp * _keep_scale(seed_ref, bh, qi, ki, block_q, block_k,
+                                  p.shape, thr, inv_keep)
         ds = p * (dp - delta) * scale
         acc_ref[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=_prec(k.dtype))
 
-    if causal:
-        pl.when(ki <= last_ki)(_compute)
-    else:
-        _compute()
+    _alive(ki <= last_ki if causal else None,
+           ki * block_k < kend_ref[bh // nh] if masked else None,
+           _compute)
 
     @pl.when(ki == last_ki)
     def _finish():
         dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(qt_ref, q_ref, k_ref, v_ref, dot_ref, do_ref, lse_ref,
-                    dl_ref, dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, block_q, block_k, nq):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, nq, nh, masked,
+                    has_bias, thr, inv_keep):
+    i = 1 if masked else 0
+    kend_ref = refs[0] if masked else None
+    (qt_ref, q_ref, k_ref, v_ref, dot_ref, do_ref, lse_ref,
+     dl_ref) = refs[i:i + 8]
+    i += 8
+    mask_ref = bias_ref = seed_ref = None
+    if masked:
+        mask_ref = refs[i]
+        i += 1
+    if has_bias:
+        bias_ref = refs[i]
+        i += 1
+    if thr is not None:
+        seed_ref = refs[i]
+        i += 1
+    dk_ref, dv_ref, dk_acc, dv_acc = refs[i:i + 4]
+
+    bh = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     # Causal, k-major: Q blocks strictly before the diagonal see nothing
@@ -317,26 +621,39 @@ def _bwd_dkv_kernel(qt_ref, q_ref, k_ref, v_ref, dot_ref, do_ref, lse_ref,
         st = jax.lax.dot_general(k, qt, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32,
                                  precision=_prec(k.dtype)) * scale
+        if has_bias:
+            st = st + bias_ref[0].astype(jnp.float32)
         if causal:
             st = _causal_mask(st, qi, ki, block_q, block_k, transposed=True)
+        if masked:
+            st = jnp.where(mask_ref[0] != 0, st, _NEG_INF)  # (bk, 1) bcast
+            lse = jnp.where(lse > _MASKED_ROW, lse, 0.0)
         pt = jnp.exp(st - lse)                 # (block_k, block_q)
+        if thr is not None:
+            ks = _keep_scale(seed_ref, bh, qi, ki, block_q, block_k,
+                             pt.shape, thr, inv_keep, transposed=True)
+            ptd = pt * ks                      # dropped+rescaled p~^T
+        else:
+            ks = None
+            ptd = pt
         dv_acc[...] += jax.lax.dot_general(
-            pt.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            ptd.astype(do.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=_prec(do.dtype))
         dpt = jax.lax.dot_general(v, dot_, (((1,), (0,)), ((), ())),
                                   preferred_element_type=jnp.float32,
                                   precision=_prec(v.dtype))
+        if ks is not None:
+            dpt = dpt * ks
         dst = pt * (dpt - delta) * scale
         dk_acc[...] += jax.lax.dot_general(
             dst.astype(q.dtype), q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=_prec(q.dtype))
 
-    if causal:
-        pl.when(qi >= first_qi)(_compute)
-    else:
-        _compute()
+    _alive(qi >= first_qi if causal else None,
+           ki * block_k < kend_ref[bh // nh] if masked else None,
+           _compute)
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -344,11 +661,16 @@ def _bwd_dkv_kernel(qt_ref, q_ref, k_ref, v_ref, dot_ref, do_ref, lse_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(qd, kd, vd, out, lse, ct, causal, scale, block_q,
-                    block_k, interpret, dlse=None):
+def _flash_backward(qd, kd, vd, mask, bias, seed, out, lse, ct, causal,
+                    scale, dropout, block_q, block_k, interpret, dlse=None):
     b, h, t, d = qd.shape
     bq, bk, sc, interp = _resolve(t, d, block_q, block_k, scale, interpret)
     nq, nk = t // bq, t // bk
+    masked = mask is not None
+    has_bias = bias is not None
+    drop = float(dropout or 0.0)
+    thr = _keep_threshold(1.0 - drop) if drop else None
+    inv_keep = 1.0 / (1.0 - drop) if drop else 1.0
 
     # delta = rowsum(dO * O): cheap elementwise, XLA fuses it.  A
     # cotangent on the log-sum-exp output folds in here: d s_ij picks up
@@ -370,117 +692,219 @@ def _flash_backward(qd, kd, vd, out, lse, ct, causal, scale, block_q,
     lse_row = lse.reshape(b * h, 1, t)         # k-major kernels broadcast
     dlt_row = delta.reshape(b * h, 1, t)       # over score ROWS
 
-    ck = _ki_clamp(bq, bk) if causal else (lambda qi, ki: ki)
-    cq = _qi_clamp(bq, bk) if causal else (lambda ki, qi: qi)
+    ck = _ck_factory(bq, bk, causal, masked, h)
+    cq = _cq_factory(bq, bk, causal, masked, h, nq)
+    kend = _kend(mask) if masked else None
+    if has_bias:
+        bb, hb = bias.shape[0], bias.shape[1]
+        bmap = _bias_bh(bb, hb, h)
+        br = bias.reshape(bb * hb, t, t)
+        btr = br.swapaxes(1, 2)                # k-major kernel reads s^T
 
-    dq = pl.pallas_call(
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki, *r: (bh, qi, 0)),
+        pl.BlockSpec((1, d, bk),
+                     lambda bh, qi, ki, *r: (bh, 0, ck(bh, qi, ki, r))),
+        pl.BlockSpec((1, bk, d),
+                     lambda bh, qi, ki, *r: (bh, ck(bh, qi, ki, r), 0)),
+        pl.BlockSpec((1, d, bk),
+                     lambda bh, qi, ki, *r: (bh, 0, ck(bh, qi, ki, r))),
+        pl.BlockSpec((1, bq, d), lambda bh, qi, ki, *r: (bh, qi, 0)),
+        pl.BlockSpec((1, bq, 1), lambda bh, qi, ki, *r: (bh, qi, 0)),
+        pl.BlockSpec((1, bq, 1), lambda bh, qi, ki, *r: (bh, qi, 0)),
+    ]
+    operands = [qr, ktr, kr, vtr, dor, lser, dltr]
+    if masked:
+        operands.append(mask.reshape(b, 1, t))
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bk),
+            lambda bh, qi, ki, *r: (bh // h, 0, ck(bh, qi, ki, r))))
+    if has_bias:
+        operands.append(br)
+        in_specs.append(pl.BlockSpec(
+            (1, bq, bk),
+            lambda bh, qi, ki, *r: (bmap(bh), qi, ck(bh, qi, ki, r))))
+    if drop:
+        operands.append(seed)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    dq = _pallas(
         functools.partial(_bwd_dq_kernel, scale=sc, causal=causal,
-                          block_q=bq, block_k=bk, nk=nk),
-        grid=(b * h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, 0, ck(qi, ki))),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ck(qi, ki), 0)),
-            pl.BlockSpec((1, d, bk), lambda bh, qi, ki: (bh, 0, ck(qi, ki))),
-            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+                          block_q=bq, block_k=bk, nk=nk, nh=h,
+                          masked=masked, has_bias=has_bias, thr=thr,
+                          inv_keep=inv_keep),
+        (b * h, nq, nk), in_specs,
+        out_specs=pl.BlockSpec((1, bq, d),
+                               lambda bh, qi, ki, *r: (bh, qi, 0)),
         out_shape=_sds((b * h, t, d), qd.dtype, qr),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        interpret=interp,
-    )(qr, ktr, kr, vtr, dor, lser, dltr)
+        scratch=[pltpu.VMEM((bq, d), jnp.float32)],
+        interp=interp, masked=masked, operands=operands, kend=kend)
 
-    dk, dv = pl.pallas_call(
+    in_specs = [
+        pl.BlockSpec((1, d, bq),
+                     lambda bh, ki, qi, *r: (bh, 0, cq(bh, ki, qi, r))),
+        pl.BlockSpec((1, bq, d),
+                     lambda bh, ki, qi, *r: (bh, cq(bh, ki, qi, r), 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, ki, qi, *r: (bh, ki, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, ki, qi, *r: (bh, ki, 0)),
+        pl.BlockSpec((1, d, bq),
+                     lambda bh, ki, qi, *r: (bh, 0, cq(bh, ki, qi, r))),
+        pl.BlockSpec((1, bq, d),
+                     lambda bh, ki, qi, *r: (bh, cq(bh, ki, qi, r), 0)),
+        pl.BlockSpec((1, 1, bq),
+                     lambda bh, ki, qi, *r: (bh, 0, cq(bh, ki, qi, r))),
+        pl.BlockSpec((1, 1, bq),
+                     lambda bh, ki, qi, *r: (bh, 0, cq(bh, ki, qi, r))),
+    ]
+    operands = [qtr, qr, kr, vr, dotr, dor, lse_row, dlt_row]
+    if masked:
+        # k-major: the mask selects score ROWS — column layout (B, T, 1)
+        operands.append(mask.reshape(b, t, 1))
+        in_specs.append(pl.BlockSpec(
+            (1, bk, 1), lambda bh, ki, qi, *r: (bh // h, ki, 0)))
+    if has_bias:
+        operands.append(btr)
+        in_specs.append(pl.BlockSpec(
+            (1, bk, bq),
+            lambda bh, ki, qi, *r: (bmap(bh), ki, cq(bh, ki, qi, r))))
+    if drop:
+        operands.append(seed)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    dk, dv = _pallas(
         functools.partial(_bwd_dkv_kernel, scale=sc, causal=causal,
-                          block_q=bq, block_k=bk, nq=nq),
-        grid=(b * h, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, d, bq), lambda bh, ki, qi: (bh, 0, cq(ki, qi))),
-            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, cq(ki, qi), 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, d, bq), lambda bh, ki, qi: (bh, 0, cq(ki, qi))),
-            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, cq(ki, qi), 0)),
-            pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, cq(ki, qi))),
-            pl.BlockSpec((1, 1, bq), lambda bh, ki, qi: (bh, 0, cq(ki, qi))),
-        ],
+                          block_q=bq, block_k=bk, nq=nq, nh=h,
+                          masked=masked, has_bias=has_bias, thr=thr,
+                          inv_keep=inv_keep),
+        (b * h, nk, nq), in_specs,
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi, *r: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ki, qi, *r: (bh, ki, 0)),
         ],
         out_shape=[
             _sds((b * h, t, d), kd.dtype, qr),
             _sds((b * h, t, d), vd.dtype, qr),
         ],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
-        interpret=interp,
-    )(qtr, qr, kr, vr, dotr, dor, lse_row, dlt_row)
+        scratch=[pltpu.VMEM((bk, d), jnp.float32),
+                 pltpu.VMEM((bk, d), jnp.float32)],
+        interp=interp, masked=masked, operands=operands, kend=kend)
 
     return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
             dv.reshape(b, h, t, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(qd, kd, vd, causal, scale, block_q, block_k, interpret):
-    out, _lse = _flash_forward(qd, kd, vd, causal, scale, block_q, block_k,
-                               interpret)
+def _zero_cts(mask, bias, seed):
+    """Cotangents for the non-q/k/v inputs: float0 for the integer mask
+    and seed; zeros for the (float) bias — the bias is treated as a
+    CONSTANT (ALiBi-style, non-learned); see flash_attention's doc."""
+    dmask = None if mask is None else onp.zeros(mask.shape,
+                                                jax.dtypes.float0)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    dseed = None if seed is None else onp.zeros(seed.shape,
+                                                jax.dtypes.float0)
+    return dmask, dbias, dseed
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash(qd, kd, vd, mask, bias, seed, causal, scale, dropout, block_q,
+           block_k, interpret):
+    out, _lse = _flash_forward(qd, kd, vd, mask, bias, seed, causal, scale,
+                               dropout, block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd(qd, kd, vd, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash_forward(qd, kd, vd, causal, scale, block_q, block_k,
-                              interpret)
-    return out, (qd, kd, vd, out, lse)
+def _flash_fwd(qd, kd, vd, mask, bias, seed, causal, scale, dropout,
+               block_q, block_k, interpret):
+    out, lse = _flash_forward(qd, kd, vd, mask, bias, seed, causal, scale,
+                              dropout, block_q, block_k, interpret)
+    return out, (qd, kd, vd, mask, bias, seed, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, ct):
-    qd, kd, vd, out, lse = res
-    return _flash_backward(qd, kd, vd, out, lse, ct, causal, scale,
-                           block_q, block_k, interpret)
+def _flash_bwd(causal, scale, dropout, block_q, block_k, interpret, res,
+               ct):
+    qd, kd, vd, mask, bias, seed, out, lse = res
+    dq, dk, dv = _flash_backward(qd, kd, vd, mask, bias, seed, out, lse,
+                                 ct, causal, scale, dropout, block_q,
+                                 block_k, interpret)
+    return (dq, dk, dv) + _zero_cts(mask, bias, seed)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse(qd, kd, vd, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash_lse(qd, kd, vd, mask, bias, seed, causal, scale, dropout,
+               block_q, block_k, interpret):
     """Flash attention returning (out, lse) — the log-sum-exp output is
     what lets independently-computed attention partials merge exactly
     (ring attention's per-ring-step building block)."""
-    return _flash_forward(qd, kd, vd, causal, scale, block_q, block_k,
-                          interpret)
+    return _flash_forward(qd, kd, vd, mask, bias, seed, causal, scale,
+                          dropout, block_q, block_k, interpret)
 
 
-def _flash_lse_fwd(qd, kd, vd, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash_forward(qd, kd, vd, causal, scale, block_q, block_k,
-                              interpret)
-    return (out, lse), (qd, kd, vd, out, lse)
+def _flash_lse_fwd(qd, kd, vd, mask, bias, seed, causal, scale, dropout,
+                   block_q, block_k, interpret):
+    out, lse = _flash_forward(qd, kd, vd, mask, bias, seed, causal, scale,
+                              dropout, block_q, block_k, interpret)
+    return (out, lse), (qd, kd, vd, mask, bias, seed, out, lse)
 
 
-def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, cts):
-    qd, kd, vd, out, lse = res
+def _flash_lse_bwd(causal, scale, dropout, block_q, block_k, interpret,
+                   res, cts):
+    qd, kd, vd, mask, bias, seed, out, lse = res
     ct, dlse = cts
-    return _flash_backward(qd, kd, vd, out, lse, ct, causal, scale,
-                           block_q, block_k, interpret, dlse=dlse)
+    dq, dk, dv = _flash_backward(qd, kd, vd, mask, bias, seed, out, lse,
+                                 ct, causal, scale, dropout, block_q,
+                                 block_k, interpret, dlse=dlse)
+    return (dq, dk, dv) + _zero_cts(mask, bias, seed)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+def _entry(fn, q, k, v, causal, scale, block_q, block_k, interpret, mask,
+           bias, dropout, key, name):
+    from ..ndarray.ndarray import NDArray
+
+    drop = float(dropout or 0.0)
+    if not 0.0 <= drop < 1.0:
+        raise ValueError(f"dropout must be in [0, 1); got {dropout}")
+    if drop and key is None:
+        raise ValueError(
+            "flash_attention with dropout>0 needs an explicit PRNG `key` "
+            "(npx.flash_attention draws one from the mx.random stream)")
+    seed = _seed_words(key) if drop else None
+    b, h, t = q.shape[0], q.shape[1], q.shape[2]
+
+    def f(qd, kd, vd, maskd=None, biasd=None):
+        mi = None if maskd is None else _norm_mask(maskd)
+        bi = None if biasd is None else _bias_4d(biasd, b, h, t)
+        return fn(qd, kd, vd, mi, bi, seed, causal, scale, drop, block_q,
+                  block_k, interpret)
+
+    args = (q, k, v, mask, bias)
+    if any(isinstance(a, NDArray) for a in args):
+        return invoke(f, args, name=name)
+    return f(*args)
+
+
 def flash_attention_with_lse(q, k, v, causal=False, scale=None,
-                             block_q=None, block_k=None, interpret=None):
+                             block_q=None, block_k=None, interpret=None,
+                             mask=None, bias=None, dropout=0.0, key=None):
     """`flash_attention` that also returns the per-query log-sum-exp
     (B, H, T) in f32.  Partials over disjoint K/V shards merge exactly:
     ``lse = logaddexp(lse_a, lse_b); out = out_a*exp(lse_a-lse) +
-    out_b*exp(lse_b-lse)`` — see `parallel/ring_attention.py`."""
-    return _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret)
+    out_b*exp(lse_b-lse)`` — see `parallel/ring_attention.py`.  The lse
+    is that of the UNdropped softmax (dropout rescales values only), so
+    the ring merge is mask- and dropout-agnostic; rows with no valid key
+    report lse below the `_MASKED_ROW` sentinel and weigh zero in any
+    merge."""
+    return _entry(_flash_lse, q, k, v, causal, scale, block_q, block_k,
+                  interpret, mask, bias, dropout, key,
+                  "flash_attention_with_lse")
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
-                    block_k=None, interpret=None):
+                    block_k=None, interpret=None, mask=None, bias=None,
+                    dropout=0.0, key=None):
     """Blockwise (flash) attention: q/k/v (B, H, T, D) -> (B, H, T, D).
 
     Exact attention; the full score matrix is never materialized, in
@@ -491,6 +915,27 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     by the blocks (pad and mask upstream otherwise — same contract as
     the reference's fused kernels).
 
+    ``mask``: key-padding mask (B, T), truthy = valid key.  Applied
+    inside every kernel; K blocks wholly past a batch row's last valid
+    key are skipped (compute AND fetch — the padded tail is free).
+    Rows with NO valid key output exact 0 with zero gradients (the dense
+    softmax path degenerates to uniform weights there instead; compare
+    only valid rows).  ``bias``: additive score bias broadcastable to
+    (B, H, T, T) — e.g. ALiBi (T, T) or per-head (H, T, T) — streamed
+    blockwise, added before masking.  The bias is treated as a constant:
+    no gradient flows to it (a dbias output would re-materialize the
+    (B, H, T, T) score space the kernel exists to avoid).
+
+    ``dropout``/``key``: in-kernel attention dropout — softmax weights
+    are zeroed at rate ``dropout`` and survivors rescaled by 1/keep,
+    with bits drawn from a stateless threefry2x32 hash of
+    (key, batch*head, q_pos, k_pos).  The backward kernels regenerate
+    the identical mask from the same seed: nothing is stored, and the
+    fwd/bwd masks are bit-identical by construction (tested).  The
+    bitstream is backend-stable (same mask on TPU and in interpret
+    mode) and is NOT the `MXNET_DROPOUT_RNG` stream — it is the
+    kernel's own documented stream.
+
     Validated exact on real TPU (vs XLA dense).  When the (T, T) score
     matrix FITS in HBM comfortably, plain XLA attention is still faster
     — use this kernel at the measured crossovers
@@ -498,12 +943,5 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     benchmark/ATTENTION_ANALYSIS.md) and `parallel.ring_attention` when
     the sequence is sharded across chips.
     """
-    from ..ndarray.ndarray import NDArray
-
-    def f(qd, kd, vd):
-        return _flash(qd, kd, vd, causal, scale, block_q, block_k,
-                      interpret)
-
-    if any(isinstance(a, NDArray) for a in (q, k, v)):
-        return invoke(f, (q, k, v), name="flash_attention")
-    return f(q, k, v)
+    return _entry(_flash, q, k, v, causal, scale, block_q, block_k,
+                  interpret, mask, bias, dropout, key, "flash_attention")

@@ -70,3 +70,27 @@ def test_describe_lists_honored_vars():
     names = [n for n, _v, _h in table]
     assert "MXNET_SEED" in names and "MXNET_ENGINE_TYPE" in names
     assert all(h for _n, _v, h in table)
+
+
+def test_dropout_rng_env_read_once_at_import(monkeypatch):
+    """ADVICE r5: MXNET_DROPOUT_RNG is consulted inside traced code, so
+    a post-import change could never reach cached executables — it is
+    now read ONCE at module import.  Changing the env afterwards must
+    have no effect (no silent half-applied state); the programmatic
+    `impl=` override still works."""
+    import jax
+    import numpy as onp
+
+    from mxnet_tpu.ops import nn as _nn
+
+    key = jax.random.key(0)
+    before = jax.random.key_data(_nn._dropout_key(key))
+    monkeypatch.setenv("MXNET_DROPOUT_RNG", "threefry")
+    after = jax.random.key_data(_nn._dropout_key(key))
+    # env change post-import: ignored (default rbg re-wrap in both)
+    assert (onp.asarray(before) == onp.asarray(after)).all()
+    assert _nn._DROPOUT_RNG_IMPL == "rbg"  # the baked-in default
+    # explicit impl override bypasses the baked value
+    tf = _nn._dropout_key(key, impl="threefry")
+    assert jax.random.key_data(tf).size == 2       # untouched threefry key
+    assert jax.random.key_data(_nn._dropout_key(key)).size == 4  # rbg wrap

@@ -247,3 +247,44 @@ def test_fused_step_spmd_rank2_data_spec_with_1d_labels():
     fused = FusedTrainStep(mod, tr, mesh=mesh, data_spec=P("dp", "tp"))
     loss = fused(x, y, batch_size=8)
     assert onp.isfinite(loss.asnumpy()).all()
+
+
+def test_fused_step_prng_counter_survives_float_special_zone():
+    """ADVICE r5: the PRNG stream counter now ships as its own int32
+    array instead of int32 bits viewed as float32 — counters in the
+    inf/NaN bitpattern zone (>= 0x7F800000) must reach fold_in exactly.
+    Two adjacent sNaN-zone counters must produce different dropout
+    masks (the old float channel could canonicalize both onto the same
+    quiet-NaN pattern), and the same counter must reproduce bit-exactly."""
+    from mxnet_tpu import random as _rng
+
+    def build(seed):
+        onp.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16))
+        net.add(nn.Dropout(0.5))
+        net.add(nn.Dense(4))
+        net.initialize(init=mx.init.Xavier())
+        return _NetWithLoss(net, gloss.SoftmaxCrossEntropyLoss()), net
+
+    x = mx.np.array(onp.random.RandomState(0).uniform(-1, 1, (8, 6))
+                    .astype(onp.float32))
+    y = mx.np.array(onp.random.RandomState(1).randint(0, 4, (8,)),
+                    dtype="int32")
+
+    def loss_at_counter(counter):
+        mx.random.seed(5)  # identical init draws across builds
+        mod, net = build(3)
+        tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.0})
+        fused = FusedTrainStep(mod, tr)
+        fused(x, y, batch_size=8)  # setup/compile consumes stream draws
+        _rng._state.counter = counter
+        return float(onp.asarray(fused(x, y, batch_size=8).asnumpy()).sum())
+
+    base = 0x7F800000  # first f32-inf bitpattern
+    snan_a = loss_at_counter(base + 1)
+    snan_b = loss_at_counter(base + 2)
+    snan_a2 = loss_at_counter(base + 1)
+    assert snan_a == snan_a2, "same counter must reproduce the same mask"
+    assert snan_a != snan_b, \
+        "adjacent NaN-zone counters collapsed to one dropout mask"

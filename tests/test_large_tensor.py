@@ -84,14 +84,25 @@ def test_index_guard_aligns_axes_through_ellipsis_and_newaxis():
         a[0, -5]                                       # resolves on axis 1
 
 
-def test_setitem_on_large_array_rejected_entirely():
-    """Probed behavior: jax scatter on a >2^31-element operand silently
-    DROPS the write at any index (32-bit index truncation) — so setitem
-    must refuse rather than corrupt."""
+def test_setitem_on_large_array_contiguous_writes_work():
+    """Probed behavior: jax SCATTER on a >2^31-element operand silently
+    DROPS the write at any index (32-bit index truncation).  Writes that
+    don't need a scatter — ints and step-1 slices, lowered to
+    broadcast + dynamic_update_slice with sub-2^31 starts (ADVICE r5) —
+    now work and are verified by readback; everything that genuinely
+    carries scatter position operands still refuses."""
     a = mx.np.ones((N,), dtype="int8")
+    a[5] = 3                                     # int position
+    assert int(a[5].asnumpy()) == 3
+    a[8:12] = 7                                  # contiguous slice
+    assert onp.asarray(a[8:12].asnumpy()).tolist() == [7] * 4
+    a[:] = 2                                     # full broadcast
+    assert int(a[2 ** 31 - 5].asnumpy()) == 2
     for bad_set in (
-        lambda: a.__setitem__(5, 3),             # even low positions
-        lambda: a.__setitem__(2 ** 31 + 5, 7),
+        lambda: a.__setitem__(2 ** 31 + 5, 7),   # start past the boundary
+        lambda: a.__setitem__(-5, 7),            # resolves past it
+        lambda: a.__setitem__(slice(0, 16, 2), 7),      # strided: scatter
+        lambda: a.__setitem__(onp.array([1, 3]), 7),    # fancy: scatter
     ):
         with pytest.raises(IndexError, match="2\\^31"):
             bad_set()

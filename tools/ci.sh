@@ -24,7 +24,10 @@ run_import() {
 }
 run_smoke()  { bash tools/smoke.sh; }
 run_test()   {
-  # telemetry first: the observability layer every later perf PR reads
+  # masked/dropout flash parity first (ISSUE 3): the kernel tier BERT
+  # training rides must fail fast and loud before anything else runs
+  python -m pytest tests/test_flash_attention.py -q
+  # telemetry next: the observability layer every later perf PR reads
   # its numbers from fails fast and loud (ISSUE 2)
   python -m pytest tests/test_telemetry.py -q
   python -m pytest tests/ -q -x
