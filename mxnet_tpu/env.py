@@ -26,6 +26,12 @@ MXNET_ENFORCE_DETERMINISM    forbid nondeterministic op paths: sets XLA's
                              deterministic-ops flag before backend init
 MXNET_HOME                   cache root (model_store, datasets)
 MXNET_HEARTBEAT_INTERVAL     kvstore liveness stamp period (seconds)
+MXNET_KVSTORE_BUCKETING      ``0`` disables bucketed gradient allreduce —
+                             Trainer/kvstore fall back to one collective
+                             per parameter (default: bucketing on)
+MXNET_KVSTORE_BUCKET_BYTES   gradient-bucket payload cap in bytes for the
+                             fused allreduce (default 4194304 = 4 MB;
+                             read when a store's bucketer is created)
 MXNET_GPU_MEM_POOL_RESERVE   accepted, no-op (PjRt owns device memory);
                              use XLA_PYTHON_CLIENT_MEM_FRACTION
 MXNET_STORAGE_FALLBACK_LOG_VERBOSE  accepted, no-op (no storage fallback:
@@ -90,6 +96,7 @@ def describe():
     names = ["MXNET_SEED", "MXNET_ENGINE_TYPE", "MXNET_EXEC_BULK_EXEC_TRAIN",
              "MXNET_CPU_WORKER_NTHREADS", "MXNET_PROFILER_AUTOSTART",
              "MXNET_ENFORCE_DETERMINISM", "MXNET_HOME",
-             "MXNET_HEARTBEAT_INTERVAL", "MXNET_GPU_MEM_POOL_RESERVE",
+             "MXNET_HEARTBEAT_INTERVAL", "MXNET_KVSTORE_BUCKETING",
+             "MXNET_KVSTORE_BUCKET_BYTES", "MXNET_GPU_MEM_POOL_RESERVE",
              "MXNET_STORAGE_FALLBACK_LOG_VERBOSE"]
     return [(n, os.environ.get(n), n in __doc__) for n in names]

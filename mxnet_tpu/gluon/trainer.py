@@ -167,12 +167,25 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                grads = param.list_grad()
-                # priority -i preserved for API parity; XLA's scheduler
-                # handles overlap on the SPMD path
-                self._kvstore.pushpull(i, grads, priority=-i)
+        pairs = [(i, param.list_grad())
+                 for i, param in enumerate(self._params)
+                 if param.grad_req != "null"]
+        if not pairs:
+            return
+        from ..kvstore import bucketing as _bucketing
+        if _bucketing.bucketing_enabled():
+            # priority is load-bearing here: buckets are issued in
+            # REVERSE registration order — backward produces last-layer
+            # gradients first, so under jax's async dispatch the first
+            # buckets ride the wire while the pack/unpack for later
+            # buckets is still being enqueued (dispatch order IS the
+            # overlap mechanism; kvstore/base.py pushpull docstring,
+            # docs/DESIGN.md)
+            self._kvstore.pushpull_list(pairs[::-1])
+            return
+        # MXNET_KVSTORE_BUCKETING=0: classic per-key collectives
+        for i, grads in pairs:
+            self._kvstore.pushpull(i, grads, priority=-i)
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._init_kvstore()
@@ -198,6 +211,8 @@ class Trainer:
             self._eager_param_update(i, param)
 
     def _eager_param_update(self, i, param):
+        from ..ndarray.sparse import RowSparseNDArray
+
         ws, gs = param.list_data(), param.list_grad()
         sts = self._states[i]
         if not isinstance(sts, list):
@@ -208,12 +223,36 @@ class Trainer:
             sts = [self._optimizer.create_state_multi_precision(i, w)
                    for w in ws]
             self._states[i] = sts if len(sts) > 1 else sts[0]
-        for dev_id, (w, g, st) in enumerate(zip(ws, gs, sts)):
-            # per-device update counts (reference
-            # `Optimizer._set_current_context`)
-            self._optimizer._set_current_context(dev_id)
-            self._optimizer.update([i], [w], [g], [st])
-        self._optimizer._set_current_context(0)
+        _eager_updates_counter().inc()
+        optimizer = self._optimizer
+        if type(optimizer).update is not opt.Optimizer.update:
+            # custom update() override: honor it verbatim, per device
+            for dev_id, (w, g, st) in enumerate(zip(ws, gs, sts)):
+                optimizer._set_current_context(dev_id)
+                optimizer.update([i], [w], [g], [st])
+            optimizer._set_current_context(0)
+            return
+        # host scalar work ONCE per param, not once per device copy (the
+        # fused path packs lr/wd/t the same way); update counts stay
+        # per-device (reference `Optimizer._set_current_context`)
+        ts = []
+        for dev_id in range(len(ws)):
+            optimizer._set_current_context(dev_id)
+            optimizer._update_count(i)
+            ts.append(optimizer._index_update_count[i])
+        optimizer._set_current_context(0)
+        lr, wd = optimizer._get_lr(i), optimizer._get_wd(i)
+        for w, g, st, t in zip(ws, gs, sts, ts):
+            if isinstance(g, RowSparseNDArray):
+                optimizer.update_sparse(w, g, st, lr, wd, t)
+                continue
+            gd = optimizer.preprocess_grad(g._data)
+            new_w, new_st = optimizer.update_math(
+                w._data, gd, tuple(s._data for s in _as_tuple(st)),
+                lr, wd, t)
+            w._rebind(new_w)
+            for s_nd, s_new in zip(_as_tuple(st), _as_tuple(new_st)):
+                s_nd._rebind(s_new)
 
     # -- the fused path ----------------------------------------------------
     def _try_fused_update(self):
@@ -307,6 +346,15 @@ class Trainer:
             for entry in entries:  # every device copy gets the loaded state
                 for cur, new in zip(_as_tuple(entry), _as_tuple(st)):
                     cur._rebind(new._data)
+
+
+def _eager_updates_counter():
+    return _telemetry.counter(
+        "mxtpu_trainer_eager_updates_total",
+        "Parameter updates taken on the per-parameter eager fallback "
+        "path instead of the fused one-program update — a steadily "
+        "rising value means the step silently de-fused (multi-device "
+        "copies, row-sparse grads, or an optimizer without update_math)")
 
 
 def _as_tuple(x):

@@ -1,9 +1,10 @@
 """KVStore (reference: `python/mxnet/kvstore/`)."""
 from .base import KVStoreBase, create, TestStore
+from .bucketing import GradBucketer
 from .local import LocalKVStore
 from .tpu_ici import TPUICIStore
 
 KVStore = LocalKVStore  # classic-API store type (reference kvstore.py:54)
 
 __all__ = ["KVStoreBase", "KVStore", "create", "TestStore", "LocalKVStore",
-           "TPUICIStore"]
+           "TPUICIStore", "GradBucketer"]

@@ -47,7 +47,29 @@ class KVStoreBase:
         raise NotImplementedError
 
     def pushpull(self, key, value, out=None, priority=0):
+        """Reduce ``value`` across its device copies; ``out=None`` updates
+        the pushed arrays in place (the Trainer path).
+
+        ``priority`` (reference `kvstore.py pushpull`: higher runs
+        earlier in the engine queue) has no engine queue to land in here —
+        XLA dispatches programs in issue order.  The load-bearing contract
+        is therefore the CALLER'S ISSUE ORDER: ``Trainer._allreduce_grads``
+        walks parameters in REVERSE registration order (backward produces
+        last-layer gradients first), so under jax's async dispatch the
+        first collectives are already riding the wire while later ones
+        are still being enqueued.  Callers reducing many keys should use
+        :meth:`pushpull_list`, which preserves that order and lets stores
+        fuse keys into bucketed collectives."""
         raise NotImplementedError
+
+    def pushpull_list(self, pairs):
+        """Reduce many ``(key, value)`` pairs, IN ORDER — the sequence
+        encodes priority (see :meth:`pushpull`).  Stores may fuse
+        adjacent same-(dtype, device-set) keys into bucketed collectives
+        (`bucketing.GradBucketer`); the base implementation is the plain
+        per-key loop.  In-place only (no ``out``)."""
+        for key, value in pairs:
+            self.pushpull(key, value)
 
     @staticmethod
     def is_capable(capability):

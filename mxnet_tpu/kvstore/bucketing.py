@@ -1,0 +1,393 @@
+"""Bucketed gradient collectives: fused, priority-scheduled allreduce.
+
+Reference seam: the kvstore ``priority`` argument plus the big-array
+bound machinery in `src/kvstore/comm.h` (CommDevice groups small arrays
+before the inter-device reduce).  The eager data-parallel path here used
+to pay one XLA program launch + one ICI message per parameter per step —
+a ResNet-50 step issues ~160 separate collectives, most of them tiny
+(BN gamma/beta and biases are 256 floats: pure launch latency, zero wire
+utilization), and `_allreduce_fn`'s lru_cache compiles one program per
+distinct gradient shape.
+
+:class:`GradBucketer` rebuilds that machinery idiomatically on jax:
+
+* gradients of the same ``(dtype, device-set)`` are grouped into
+  size-capped buckets (default 4 MB, ``MXNET_KVSTORE_BUCKET_BYTES``);
+* each bucket is packed into one flat per-device buffer by a single
+  jitted pack program (one trace per bucket, not per shape);
+* ONE sharded-psum allreduce runs per bucket, reusing the exact
+  `_allreduce_fn` shard_map shape (ring all-reduce over ICI);
+* reduced segments are unpacked back into the per-key grad arrays by a
+  single jitted unpack program per (bucket, copy).
+
+Scheduling: the caller (``Trainer._allreduce_grads``) passes items in
+REVERSE registration order — backward produces last-layer gradients
+first, so under jax's async dispatch the first buckets are already on
+the wire while the pack/unpack work for later buckets is still being
+enqueued.  Dispatch order IS the overlap mechanism here; there is no
+engine priority queue to honor it for us (docs/DESIGN.md).
+
+Bucket capacities are padded up to a quantum (64 KB) so the allreduce
+jit cache is keyed by O(#distinct capacities) across models instead of
+O(#shapes).  The 2-bit compressed path composes per-bucket: the packed
+flat buffer is quantized with one launch and carries one residual per
+(bucket, copy) instead of one per (key, copy).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import telemetry as _telemetry
+from ..ndarray.ndarray import NDArray
+from ..telemetry import collective_span as _collective_span
+
+__all__ = ["GradBucketer", "bucketing_enabled", "bucket_bytes",
+           "split_bucketable", "DEFAULT_BUCKET_BYTES",
+           "DEFAULT_QUANTUM_BYTES"]
+
+DEFAULT_BUCKET_BYTES = 4 << 20    # ~4 MB: a few buckets per ResNet-50
+DEFAULT_QUANTUM_BYTES = 64 << 10  # capacity padding quantum
+
+
+def bucketing_enabled():
+    """``MXNET_KVSTORE_BUCKETING=0`` opts out (default: on)."""
+    return os.environ.get("MXNET_KVSTORE_BUCKETING", "1") != "0"
+
+
+def bucket_bytes():
+    """Bucket payload cap (``MXNET_KVSTORE_BUCKET_BYTES``, default 4 MB)."""
+    return int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",
+                              DEFAULT_BUCKET_BYTES))
+
+
+def split_bucketable(pairs):
+    """Partition ``(key, value)`` pairs into ``(bucketable, per_key)``.
+
+    Bucketable: >= 2 dense device copies — a real cross-device reduce.
+    Per-key: single arrays (SPMD path — XLA already reduced inside the
+    compiled step, pushpull is a near-no-op) and row-sparse values
+    (eager union path, no flat packing exists for them).
+    """
+    from ..ndarray.sparse import RowSparseNDArray
+
+    bucketable, per_key = [], []
+    for key, value in pairs:
+        vals = list(value) if isinstance(value, (list, tuple)) else [value]
+        if len(vals) >= 2 and not isinstance(vals[0], RowSparseNDArray):
+            bucketable.append((key, vals))
+        else:
+            per_key.append((key, value))
+    return bucketable, per_key
+
+
+def _fill_gauge():
+    return _telemetry.gauge(
+        "mxtpu_kvstore_bucket_fill_fraction",
+        "Payload fraction of each gradient bucket's quantum-padded "
+        "capacity, per bucket slot of the last bucketed pushpull",
+        labelnames=("bucket",))
+
+
+def _make_pack(pad, dtype):
+    """One jitted program: reshape + concat + zero-pad to capacity."""
+    def pack(*arrs):
+        flat = [a.reshape(-1) for a in arrs]
+        if pad:
+            flat.append(jnp.zeros((pad,), dtype))
+        return jnp.concatenate(flat) if len(flat) > 1 else flat[0]
+    return jax.jit(pack)
+
+
+def _make_unpack(offsets, sizes, shapes):
+    """One jitted program: slice every key's segment back out."""
+    def unpack(flat):
+        return tuple(
+            jax.lax.slice(flat, (off,), (off + size,)).reshape(shape)
+            for off, size, shape in zip(offsets, sizes, shapes))
+    return jax.jit(unpack)
+
+
+class _Bucket:
+    """One issue unit: contiguous segments of same-(dtype, device-set)
+    gradients, padded to a quantum capacity."""
+
+    __slots__ = ("positions", "keys", "shapes", "sizes", "offsets",
+                 "dtype", "devices", "used", "capacity", "pack", "unpack")
+
+    def __init__(self, dtype, devices):
+        self.positions = []      # indices into the pushpull items list
+        self.keys = []
+        self.shapes = []
+        self.sizes = []
+        self.offsets = []
+        self.dtype = dtype       # onp.dtype
+        self.devices = devices   # tuple of jax devices (or None entries)
+        self.used = 0            # elements
+        self.capacity = 0        # elements, quantum-padded
+
+    def add(self, pos, key, shape, size):
+        self.positions.append(pos)
+        self.keys.append(key)
+        self.shapes.append(tuple(shape))
+        self.sizes.append(size)
+        self.offsets.append(self.used)
+        self.used += size
+
+    def finalize(self, quantum_bytes):
+        q = max(1, quantum_bytes // self.dtype.itemsize)
+        self.capacity = -(-self.used // q) * q
+        pad = self.capacity - self.used
+        self.pack = _make_pack(pad, self.dtype)
+        self.unpack = _make_unpack(tuple(self.offsets), tuple(self.sizes),
+                                   tuple(self.shapes))
+
+    @property
+    def used_bytes(self):
+        return self.used * self.dtype.itemsize
+
+    @property
+    def fill_fraction(self):
+        return self.used / self.capacity if self.capacity else 0.0
+
+
+class GradBucketer:
+    """Pack -> one allreduce -> unpack, per size-capped bucket.
+
+    In-place contract (the Trainer path): every input copy is updated
+    with the reduced value on its own device; there is no ``out``.
+    The bucket plan is cached per item signature (keys, shapes, dtypes,
+    device sets), so a ``reset_ctx``/device-set change builds a fresh
+    plan — and fresh 2-bit residuals with it (stale error feedback from
+    a previous device set is never applied).
+
+    Env knobs are read when the bucketer is constructed:
+    ``MXNET_KVSTORE_BUCKET_BYTES`` (cap) — constructor args override.
+    """
+
+    def __init__(self, bucket_bytes=None, quantum_bytes=None):
+        self.bucket_bytes = int(bucket_bytes) if bucket_bytes is not None \
+            else globals()["bucket_bytes"]()
+        self.quantum_bytes = int(quantum_bytes) if quantum_bytes is not None \
+            else DEFAULT_QUANTUM_BYTES
+        self._plans = {}      # signature -> list[_Bucket]
+        self._residuals = {}  # (signature, bucket_idx, copy_idx) -> jax.Array
+        self._inflight = None  # host-CPU platform: last dispatched psum
+        # introspection for tests / benchmarks
+        self.last_issue_keys = []
+        self.last_num_buckets = 0
+
+    # -- planning ----------------------------------------------------------
+    @staticmethod
+    def _signature(items):
+        from .tpu_ici import _value_devices
+
+        return tuple(
+            (key, tuple(vals[0].shape), str(onp.dtype(vals[0]._data.dtype)),
+             tuple(_value_devices(vals)))
+            for key, vals in items)
+
+    def _build_plan(self, items):
+        from .tpu_ici import _value_devices
+
+        buckets, open_by_group = [], {}
+        for pos, (key, vals) in enumerate(items):
+            v0 = vals[0]
+            dtype = onp.dtype(v0._data.dtype)
+            devs = tuple(_value_devices(vals))
+            gkey = (str(dtype), devs)
+            size = int(v0.size)
+            nbytes = size * dtype.itemsize
+            b = open_by_group.get(gkey)
+            # close the open bucket when this item would overflow it; an
+            # oversize tensor then lands alone in its own bucket (its
+            # used_bytes already exceed the cap, so nothing joins it)
+            if b is None or (b.used_bytes + nbytes > self.bucket_bytes
+                             and b.keys):
+                b = _Bucket(dtype, devs)
+                open_by_group[gkey] = b
+                buckets.append(b)
+            b.add(pos, key, v0.shape, size)
+        for b in buckets:
+            b.finalize(self.quantum_bytes)
+        return buckets
+
+    # -- the reduce --------------------------------------------------------
+    def pushpull(self, items, compression=None):
+        """Reduce every ``(key, [copies])`` in ``items`` in ISSUE ORDER
+        (the caller encodes priority as order — reverse registration for
+        the Trainer), bucket by bucket, in place."""
+        if not items:
+            return
+        sig = self._signature(items)
+        plan = self._plans.get(sig)
+        if plan is None:
+            plan = self._plans[sig] = self._build_plan(items)
+        self.last_issue_keys = [k for b in plan for k in b.keys]
+        self.last_num_buckets = len(plan)
+        fill = _fill_gauge()
+        for bidx, b in enumerate(plan):
+            n_copies = len(items[b.positions[0]][1])
+            payload = b.used_bytes * n_copies
+            op = "allreduce_bucket" if compression is None \
+                else "allreduce_2bit_bucket"
+            if compression is not None:
+                payload //= 4  # int8 levels ride the wire, not f32 words
+            with _collective_span(op, payload):
+                self._issue_bucket(sig, bidx, b, items, compression)
+            fill.labels(bucket=str(bidx)).set(b.fill_fraction)
+
+    def _issue_bucket(self, sig, bidx, b, items, compression):
+        devs = b.devices
+        n = len(items[b.positions[0]][1])
+        if len(b.positions) == 1:
+            # single-key bucket (an oversize tensor, or a lone straggler):
+            # packing would only copy bytes and pad — reduce it directly
+            # on its own shape (the reference CommDevice likewise merges
+            # only small arrays)
+            return self._issue_single(sig, bidx, b, items, compression)
+        packed = []
+        for j in range(n):
+            flat = b.pack(*[items[pos][1][j]._data for pos in b.positions])
+            if devs[j] is not None:
+                flat = jax.device_put(flat, devs[j])
+            packed.append(flat)
+        if None in devs or len(set(devs)) < n:
+            reduced = self._reduce_flat_fallback(sig, bidx, b, packed,
+                                                 compression)
+            flats = [reduced] * n
+        else:
+            flats = self._reduce_flat_ring(sig, bidx, b, packed, compression)
+        for j in range(n):
+            flat = flats[j]
+            if devs[j] is not None:
+                flat = jax.device_put(flat, devs[j])
+            segs = b.unpack(flat)
+            for pos, seg in zip(b.positions, segs):
+                target = items[pos][1][j]
+                NDArray(seg, ctx=target.ctx).copyto(target)
+
+    def _issue_single(self, sig, bidx, b, items, compression):
+        """Reduce a one-key bucket without pack/unpack: the psum runs on
+        the tensor's own shape (one trace per oversize shape — these are
+        the few wide weights, exactly what per-key paid too)."""
+        pos = b.positions[0]
+        vals = items[pos][1]
+        devs, n = b.devices, len(vals)
+        shape, dtype = b.shapes[0], b.dtype
+        arrs = [v._data for v in vals]
+        if None in devs or len(set(devs)) < n:
+            packed = [a.reshape(-1) for a in arrs]
+            reduced = self._reduce_flat_fallback(sig, bidx, b, packed,
+                                                 compression)
+            flats = [reduced] * n
+            for j, v in enumerate(vals):
+                flat = flats[j]
+                if devs[j] is not None:
+                    flat = jax.device_put(flat, devs[j])
+                NDArray(flat.reshape(shape), ctx=v.ctx).copyto(v)
+            return
+        from .tpu_ici import _allreduce_fn, _compressed_allreduce_fn
+
+        if compression is not None:
+            thr = compression["threshold"]
+            levels = [self._quantize(sig, bidx, j, arrs[j], thr)
+                      for j in range(n)]
+            allreduce, sharding, _mesh = _compressed_allreduce_fn(
+                devs, shape, dtype, float(thr))
+            pieces = [jax.device_put(lvl.reshape((1,) + shape), devs[j])
+                      for j, lvl in enumerate(levels)]
+        else:
+            allreduce, sharding, _mesh = _allreduce_fn(
+                devs, shape, str(dtype))
+            pieces = [jax.device_put(a.reshape((1,) + shape), devs[j])
+                      for j, a in enumerate(arrs)]
+        stacked = jax.make_array_from_single_device_arrays(
+            (n,) + shape, sharding, pieces)
+        summed = self._dispatch_allreduce(devs, allreduce, stacked)
+        by_dev = {s.device: s.data for s in summed.addressable_shards}
+        for j, v in enumerate(vals):
+            NDArray(by_dev[devs[j]].reshape(shape), ctx=v.ctx).copyto(v)
+
+    def _dispatch_allreduce(self, devices, allreduce, stacked):
+        """Dispatch one bucket's psum.  On the host-CPU platform at most
+        ONE collective stays in flight: the emulated all-reduce deadlocks
+        when several independent rendezvous share one thread pool (XLA
+        `collective_ops_utils.h` "may be stuck" — participants of
+        different programs interleave and starve each other), so the
+        previous bucket's psum is fenced BEFORE dispatching the next.
+        Packing/unpacking still pipelines around the live collective, and
+        real accelerator platforms keep fully async dispatch — issue-order
+        overlap is the point of bucketing."""
+        on_cpu = devices and devices[0] is not None \
+            and devices[0].platform == "cpu"
+        if on_cpu and self._inflight is not None:
+            jax.block_until_ready(self._inflight)
+            self._inflight = None
+        summed = allreduce(stacked)
+        if on_cpu:
+            self._inflight = summed
+        return summed
+
+    def _reduce_flat_ring(self, sig, bidx, b, packed, compression):
+        """One compiled sharded psum over the copies' own devices — the
+        exact `_allreduce_fn` shard_map shape, (n, capacity) flat."""
+        from .tpu_ici import _allreduce_fn, _compressed_allreduce_fn
+
+        devs, n, cap = b.devices, len(packed), b.capacity
+        if compression is not None:
+            thr = compression["threshold"]
+            levels = [self._quantize(sig, bidx, j, flat, thr)
+                      for j, flat in enumerate(packed)]
+            allreduce, sharding, _mesh = _compressed_allreduce_fn(
+                devs, (cap,), b.dtype, float(thr))
+            pieces = [jax.device_put(lvl.reshape((1, cap)), devs[j])
+                      for j, lvl in enumerate(levels)]
+        else:
+            allreduce, sharding, _mesh = _allreduce_fn(
+                devs, (cap,), str(b.dtype))
+            pieces = [jax.device_put(flat.reshape((1, cap)), devs[j])
+                      for j, flat in enumerate(packed)]
+        stacked = jax.make_array_from_single_device_arrays(
+            (n, cap), sharding, pieces)
+        summed = self._dispatch_allreduce(devs, allreduce, stacked)
+        by_dev = {s.device: s.data for s in summed.addressable_shards}
+        return [by_dev[devs[j]].reshape((cap,)) for j in range(n)]
+
+    def _reduce_flat_fallback(self, sig, bidx, b, packed, compression):
+        """Copies sharing a device (or host-backed): no ring exists to
+        ride — accumulate on the first copy's device (mirrors
+        `TPUICIStore._reduce_copies`' fallback)."""
+        dev0 = b.devices[0]
+        if compression is not None:
+            thr = compression["threshold"]
+            levels = [self._quantize(sig, bidx, j, flat, thr)
+                      for j, flat in enumerate(packed)]
+            total = levels[0].astype(jnp.int32)
+            for lvl in levels[1:]:
+                lvl = jax.device_put(lvl, dev0) if dev0 is not None else lvl
+                total = total + lvl.astype(jnp.int32)
+            return total.astype(b.dtype) * b.dtype.type(thr)
+        total = packed[0]
+        for flat in packed[1:]:
+            flat = jax.device_put(flat, dev0) if dev0 is not None else flat
+            total = total + flat
+        return total
+
+    def _quantize(self, sig, bidx, j, flat, thr):
+        """2-bit levels with per-(bucket, copy) error feedback — one
+        residual and one quantize launch per bucket instead of one per
+        (key, copy).  The padding tail stays exactly zero: zero grad +
+        zero residual quantizes to level 0 and residual 0."""
+        from .tpu_ici import _quantize_2bit
+
+        rkey = (sig, bidx, j)
+        res = self._residuals.get(rkey)
+        if res is None:
+            res = jnp.zeros_like(flat)
+        lvl, res = _quantize_2bit(flat, res, thr)
+        self._residuals[rkey] = res
+        return lvl

@@ -22,6 +22,7 @@ class LocalKVStore(KVStoreBase):
     def __init__(self):
         self._store = {}
         self._updater = None
+        self._bucketer = None
 
     # -- classic API (reference include/mxnet/kvstore.h) ------------------
     def init(self, key, value):
@@ -69,6 +70,27 @@ class LocalKVStore(KVStoreBase):
             for dst in _as_list(o):
                 if dst is not reduced:
                     _copy_into(reduced, dst)
+
+    def pushpull_list(self, pairs):
+        """Reduce many keys in the caller's issue order, fusing multi-copy
+        dense gradients into size-capped buckets (one packed psum per
+        bucket — on the virtual/local device set the PjRt inter-device
+        DMAs still collapse to one program per bucket).  Row-sparse and
+        single-copy values keep the per-key path;
+        ``MXNET_KVSTORE_BUCKETING=0`` restores it for everything."""
+        from . import bucketing as _bucketing
+
+        if not _bucketing.bucketing_enabled():
+            for key, value in pairs:
+                self.pushpull(key, value)
+            return
+        bucketable, per_key = _bucketing.split_bucketable(pairs)
+        for key, value in per_key:
+            self.pushpull(key, value)
+        if bucketable:
+            if self._bucketer is None:
+                self._bucketer = _bucketing.GradBucketer()
+            self._bucketer.pushpull(bucketable)
 
     @staticmethod
     def is_capable(capability):

@@ -118,6 +118,28 @@ def _compressed_allreduce_fn(devices, shape, out_dtype, threshold):
     return allreduce, sharding, mesh
 
 
+def _residual_matches(res, data):
+    """An error-feedback residual is only valid for the tensor it was
+    recorded against: same shape, same dtype, and — when both sides are
+    COMMITTED device arrays — the same device set.  `reset_ctx` or a
+    device-set change must reset the residual instead of crashing the
+    quantize or silently applying stale feedback.  Uncommitted arrays
+    (the default for eagerly created values: computed outputs follow
+    jax's default-device placement, not the value's resident device)
+    carry no reliable placement signal, so they only gate on shape and
+    dtype."""
+    if tuple(res.shape) != tuple(data.shape) or res.dtype != data.dtype:
+        return False
+    if isinstance(res, jax.Array) and isinstance(data, jax.Array) and \
+            getattr(res, "_committed", False) and \
+            getattr(data, "_committed", False):
+        try:
+            return res.devices() == data.devices()
+        except Exception:
+            return True
+    return True
+
+
 def _quantize_2bit(x, residual, threshold):
     """Reference 2-bit compression (`src/kvstore/gradient_compression.cc`):
     values map to levels {-1, 0, +1} (scaled by threshold on the wire); the
@@ -138,6 +160,7 @@ class TPUICIStore(KVStoreBase):
         self._size = jax.process_count()
         self._compression = None
         self._residuals = {}
+        self._bucketer = None
         self._hb_stop = None
         # liveness grace period anchor: a rank that has never heartbeat is
         # only dead once it has had `timeout` seconds since this store
@@ -318,6 +341,31 @@ class TPUICIStore(KVStoreBase):
                 reduced.as_in_ctx(o.ctx).copyto(o)
         return None
 
+    def pushpull_list(self, pairs):
+        """Reduce many keys in the caller's issue order, fusing multi-copy
+        dense gradients into size-capped buckets: one packed psum per
+        bucket instead of one collective per key (`bucketing.GradBucketer`;
+        ``MXNET_KVSTORE_BUCKETING=0`` restores the per-key loop).
+
+        Single arrays (SPMD — already reduced inside the compiled step)
+        and row-sparse values keep the per-key path.  The 2-bit compressed
+        wire format composes per bucket: one quantize launch and one
+        residual per (bucket, copy)."""
+        from . import bucketing as _bucketing
+
+        if not _bucketing.bucketing_enabled():
+            for key, value in pairs:
+                self.pushpull(key, value)
+            return
+        bucketable, per_key = _bucketing.split_bucketable(pairs)
+        for key, value in per_key:
+            self.pushpull(key, value)
+        if bucketable:
+            if self._bucketer is None:
+                self._bucketer = _bucketing.GradBucketer()
+            self._bucketer.pushpull(bucketable,
+                                    compression=self._compression)
+
     def _reduce_compressed(self, key, vals):
         """Quantize each copy on its own device (error feedback per copy),
         then all-reduce the int8 levels with ONE compiled sharded psum —
@@ -329,6 +377,12 @@ class TPUICIStore(KVStoreBase):
         for i, v in enumerate(vals):
             rkey = (key, i)
             res = self._residuals.get(rkey)
+            if res is not None and not _residual_matches(res, v._data):
+                # the copy moved (reset_ctx), changed shape, or changed
+                # dtype since the residual was recorded: stale error
+                # feedback must be dropped, not crash the quantize or be
+                # silently applied to the wrong tensor
+                res = None
             if res is None:
                 # zeros_like inherits v's sharding (multi-host safe)
                 res = jnp.zeros_like(v._data)

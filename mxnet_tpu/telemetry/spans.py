@@ -104,6 +104,11 @@ def _collective_metrics():
                       "(device time overlaps async; see the XLA trace for "
                       "on-wire timing)",
                       labelnames=("op",)),
+        reg.counter("mxtpu_kvstore_collective_launches_total",
+                    "XLA collective program launches dispatched by the "
+                    "kvstore, across all ops (gradient bucketing collapses "
+                    "many keys into one launch; per-key pushpull pays one "
+                    "per parameter)"),
     )
 
 
@@ -119,8 +124,9 @@ class collective_span:
         self.nbytes = int(nbytes)
 
     def __enter__(self):
-        total, bytes_, _lat = _collective_metrics()
+        total, bytes_, _lat, launches = _collective_metrics()
         total.labels(op=self.op).inc()
+        launches.inc()
         if self.nbytes:
             bytes_.labels(op=self.op).inc(self.nbytes)
         self._span = span(f"collective/{self.op}", cat="collective",

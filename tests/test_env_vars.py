@@ -65,6 +65,67 @@ def test_bulk_and_worker_threads_env():
     assert "env-ok" in r.stdout
 
 
+def test_kvstore_bucketing_env_optout():
+    """MXNET_KVSTORE_BUCKETING=0 disables gradient bucketing process-wide:
+    the Trainer falls back to one collective per parameter."""
+    code = """
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu import autograd, telemetry
+        from mxnet_tpu.gluon.utils import split_and_load
+        from mxnet_tpu.kvstore import bucketing
+        assert not bucketing.bucketing_enabled()
+        ctxs = [mx.cpu(i) for i in range(2)]
+        net = mx.gluon.nn.Dense(4, in_units=3)
+        net.initialize(ctx=ctxs)
+        tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.1}, kvstore="tpu_ici")
+        def step():
+            xs = split_and_load(mx.np.array(
+                onp.random.randn(4, 3).astype(onp.float32)), ctxs)
+            with autograd.record():
+                ls = [(net(x) ** 2).mean() for x in xs]
+            autograd.backward(ls)
+            tr.step(4)
+        step()
+        reg = telemetry.default_registry()
+        name = "mxtpu_kvstore_collective_launches_total"
+        before = reg.get_sample_value(name) or 0.0
+        step()
+        delta = (reg.get_sample_value(name) or 0.0) - before
+        assert delta == 2, delta  # one collective per param: weight, bias
+        print("bucketing-off-ok")
+    """
+    r = _run(code, MXNET_KVSTORE_BUCKETING="0",
+             XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    assert r.returncode == 0, r.stderr
+    assert "bucketing-off-ok" in r.stdout
+
+
+def test_kvstore_bucket_bytes_env():
+    """MXNET_KVSTORE_BUCKET_BYTES caps bucket payloads (read when the
+    bucketer is created)."""
+    code = """
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu.kvstore import bucketing
+        assert bucketing.bucketing_enabled()
+        assert bucketing.bucket_bytes() == 2048
+        b = bucketing.GradBucketer()
+        assert b.bucket_bytes == 2048
+        pairs = [(k, [mx.np.array(onp.full(256, 1.0, onp.float32),
+                                  ctx=mx.cpu(c)) for c in range(2)])
+                 for k in range(8)]   # 1 KB tensors, 2 KB cap -> 4 buckets
+        b.pushpull(pairs)
+        assert b.last_num_buckets == 4, b.last_num_buckets
+        print("bucket-bytes-ok")
+    """
+    r = _run(code, MXNET_KVSTORE_BUCKET_BYTES="2048",
+             XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    assert r.returncode == 0, r.stderr
+    assert "bucket-bytes-ok" in r.stdout
+
+
 def test_describe_lists_honored_vars():
     table = mx.env.describe()
     names = [n for n, _v, _h in table]

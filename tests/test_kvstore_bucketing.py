@@ -1,0 +1,416 @@
+"""Bucketed gradient collectives (ISSUE 4).
+
+Reference seam: kvstore ``priority`` + `src/kvstore/comm.h` big-array
+bound grouping, rebuilt as `kvstore/bucketing.GradBucketer` — size-capped
+(dtype, device-set) buckets, one jitted pack / sharded-psum allreduce /
+jitted unpack per bucket, issued in reverse registration order.
+
+Value-deterministic style follows `tests/nightly/dist_sync_kvstore.py`:
+bucketed results are compared bit-for-bit (dense float32) / within
+error-feedback tolerance (2bit) against the per-key path, never
+eyeballed.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore, telemetry
+from mxnet_tpu.kvstore import bucketing
+
+
+N_COPIES = 4
+
+
+def _copies(arr, n=N_COPIES, dtype="float32"):
+    return [mx.np.array(arr, dtype=dtype, ctx=mx.cpu(c)) for c in range(n)]
+
+
+def _make_pairs(seed, specs, n=N_COPIES):
+    """specs: [(shape, dtype)] -> [(key, [per-device copies])] with
+    per-copy distinct values (deterministic in ``seed``)."""
+    rs = onp.random.RandomState(seed)
+    pairs = []
+    for k, (shape, dtype) in enumerate(specs):
+        base = rs.randn(*shape).astype(onp.float32)
+        pairs.append((k, [
+            mx.np.array(base + c, dtype=dtype, ctx=mx.cpu(c))
+            for c in range(n)
+        ]))
+    return pairs
+
+
+MIXED_SIZES = [((256,), "float32"), ((16, 16), "float32"),
+               ((4096,), "float32"), ((3, 3, 8, 8), "float32"),
+               ((1024, 64), "float32"), ((7,), "float32")]
+
+
+def test_dense_bitparity_bucketed_vs_perkey():
+    """Acceptance: bucketed and per-key pushpull are BIT-identical for
+    dense float32 — both reduce with the same psum over the same device
+    ring, just batched."""
+    p_bucket = _make_pairs(0, MIXED_SIZES)
+    p_perkey = _make_pairs(0, MIXED_SIZES)
+    kv_b = kvstore.create("tpu_ici")
+    kv_p = kvstore.create("tpu_ici")
+    kv_b.pushpull_list(list(reversed(p_bucket)))
+    for k, vals in reversed(p_perkey):
+        kv_p.pushpull(k, vals)
+    for (k, vb), (_, vp) in zip(p_bucket, p_perkey):
+        for a, b in zip(vb, vp):
+            assert onp.array_equal(a.asnumpy(), b.asnumpy()), k
+    # everything fused into few buckets, issued in the caller's order
+    assert kv_b._bucketer.last_num_buckets < len(MIXED_SIZES)
+    assert kv_b._bucketer.last_issue_keys == [k for k, _ in
+                                              reversed(p_bucket)]
+
+
+def test_mixed_dtype_groups_split_buckets():
+    """float32 and bfloat16 gradients never share a bucket (a flat pack
+    needs one dtype) but both fuse within their group — and values match
+    the per-key path."""
+    specs = [((256,), "float32"), ((128,), "bfloat16"),
+             ((512,), "float32"), ((64,), "bfloat16")]
+    p_bucket = _make_pairs(1, specs)
+    p_perkey = _make_pairs(1, specs)
+    kv_b = kvstore.create("tpu_ici")
+    kv_p = kvstore.create("tpu_ici")
+    kv_b.pushpull_list(list(reversed(p_bucket)))
+    for k, vals in reversed(p_perkey):
+        kv_p.pushpull(k, vals)
+    assert kv_b._bucketer.last_num_buckets == 2
+    sig = next(iter(kv_b._bucketer._plans))
+    for bucket in kv_b._bucketer._plans[sig]:
+        dts = {str(bucket.dtype)}
+        assert len(dts) == 1  # one dtype per bucket by construction
+    for (k, vb), (_, vp) in zip(p_bucket, p_perkey):
+        for a, b in zip(vb, vp):
+            assert onp.array_equal(
+                a.asnumpy().astype(onp.float32),
+                b.asnumpy().astype(onp.float32)), k
+
+
+def test_oversize_tensor_gets_own_bucket():
+    """A tensor larger than the cap lands alone in its own bucket; its
+    neighbours keep fusing around it, and values still match."""
+    b = bucketing.GradBucketer(bucket_bytes=1024)
+    pairs = [
+        (0, _copies(onp.full(64, 1.0, onp.float32), n=2)),
+        (1, _copies(onp.arange(1024, dtype=onp.float32), n=2)),  # 4 KB > cap
+        (2, _copies(onp.full(64, 3.0, onp.float32), n=2)),
+    ]
+    b.pushpull(pairs)
+    plan = b._plans[next(iter(b._plans))]
+    assert [bk.keys for bk in plan] == [[0], [1], [2]]
+    assert plan[1].used * 4 > 1024  # the oversize one really exceeds the cap
+    onp.testing.assert_array_equal(pairs[1][1][0].asnumpy(),
+                                   2 * onp.arange(1024, dtype=onp.float32))
+    onp.testing.assert_array_equal(pairs[0][1][1].asnumpy(),
+                                   onp.full(64, 2.0, onp.float32))
+
+
+def test_small_tensors_fuse_and_capacity_is_quantized():
+    """Many tiny tensors share one bucket; capacities are padded to the
+    quantum so the allreduce trace cache is keyed by O(#capacities),
+    not O(#shapes)."""
+    b = bucketing.GradBucketer()
+    pairs = [(k, _copies(onp.full(64, float(k + 1), onp.float32), n=2))
+             for k in range(12)]
+    b.pushpull(pairs)
+    plan = b._plans[next(iter(b._plans))]
+    assert len(plan) == 1 and b.last_num_buckets == 1
+    q = bucketing.DEFAULT_QUANTUM_BYTES // 4
+    assert plan[0].capacity % q == 0 and plan[0].capacity >= plan[0].used
+
+
+def test_2bit_error_feedback_parity_across_steps():
+    """Per-bucket quantization (one residual per (bucket, copy)) must
+    track the per-key path (one residual per (key, copy)) across >= 3
+    steps — the quantize is elementwise, so error feedback composes."""
+    specs = [((256,), "float32"), ((128,), "bfloat16"),
+             ((512,), "float32"), ((64,), "bfloat16")]
+    kv_b = kvstore.create("tpu_ici")
+    kv_b.set_gradient_compression({"type": "2bit", "threshold": 0.7})
+    kv_p = kvstore.create("tpu_ici")
+    kv_p.set_gradient_compression({"type": "2bit", "threshold": 0.7})
+    for step in range(3):
+        p_bucket = _make_pairs(step, specs)
+        p_perkey = _make_pairs(step, specs)
+        kv_b.pushpull_list(list(reversed(p_bucket)))
+        for k, vals in reversed(p_perkey):
+            kv_p.pushpull(k, vals)
+        for (k, vb), (_, vp) in zip(p_bucket, p_perkey):
+            for a, b in zip(vb, vp):
+                onp.testing.assert_allclose(
+                    a.asnumpy().astype(onp.float32),
+                    b.asnumpy().astype(onp.float32),
+                    atol=1e-6, err_msg=f"step {step} key {k}")
+
+
+def test_bucketer_residual_resets_on_device_set_change():
+    """A (dtype, device-set) change (reset_ctx) produces a fresh plan —
+    and fresh 2-bit residuals with it: stale error feedback from the old
+    device set is never applied."""
+    b = bucketing.GradBucketer()
+    comp = {"threshold": 1.0}
+    vals_a = _copies(onp.array([2.5, -0.4, 0.1, -3.0], onp.float32), n=2)
+    b.pushpull([(0, vals_a)], compression=comp)
+    assert vals_a[0].asnumpy().tolist() == [2.0, 0.0, 0.0, -2.0]
+    assert len(b._residuals) == 2  # one per copy
+    # new device set: cpu(2)/cpu(3) instead of cpu(0)/cpu(1)
+    vals_b = [mx.np.array(onp.array([2.5, -0.4, 0.1, -3.0], onp.float32),
+                          ctx=mx.cpu(c)) for c in (2, 3)]
+    b.pushpull([(0, vals_b)], compression=comp)
+    # fresh residuals: the result is the zero-residual quantization, not
+    # one biased by the first call's error feedback
+    assert vals_b[0].asnumpy().tolist() == [2.0, 0.0, 0.0, -2.0]
+    assert len(b._plans) == 2 and len(b._residuals) == 4
+
+
+def test_perkey_residual_staleness_reset():
+    """Satellite: `_reduce_compressed` residuals are keyed (key, copy) —
+    a shape change under the same key (reset_ctx / re-registered
+    parameter) must RESET the residual, not crash the quantize or apply
+    stale feedback."""
+    kv = kvstore.create("tpu_ici")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    a, b = (mx.np.array([2.5, -0.4, 0.1, -3.0]) for _ in range(2))
+    kv.pushpull("g", [a, b])
+    assert a.asnumpy().tolist() == [2.0, 0.0, 0.0, -2.0]
+    # residual is now [1.5, -0.4, 0.1, -2.0] per copy; a shape change
+    # under the same key previously crashed on the (4,) residual
+    c, d = (mx.np.array([2.5, -0.4, 0.1, -3.0, 9.9, 0.0])
+            for _ in range(2))
+    kv.pushpull("g", [c, d])
+    # fresh residual: plain zero-feedback quantization of the new shape
+    assert c.asnumpy().tolist() == [2.0, 0.0, 0.0, -2.0, 2.0, 0.0]
+    # and dtype changes reset rather than quantize garbage
+    e, f = (mx.np.array([2.5, -0.4, 0.1, -3.0, 9.9, 0.0],
+                        dtype="bfloat16") for _ in range(2))
+    kv.pushpull("g", [e, f])
+    assert e.asnumpy().astype(onp.float32).tolist() == \
+        [2.0, 0.0, 0.0, -2.0, 2.0, 0.0]
+
+
+def test_launches_collapse_and_fill_gauge():
+    """Telemetry acceptance: N tiny gradients cost ONE collective launch
+    bucketed (vs N per-key), and the fill gauge reflects the bucket's
+    payload fraction."""
+    reg = telemetry.default_registry()
+    name = "mxtpu_kvstore_collective_launches_total"
+    kv = kvstore.create("tpu_ici")
+    n_keys = 12
+    pairs = _make_pairs(3, [((256,), "float32")] * n_keys)
+
+    before = reg.get_sample_value(name) or 0.0
+    kv.pushpull_list(list(reversed(pairs)))
+    bucketed_launches = (reg.get_sample_value(name) or 0.0) - before
+    assert bucketed_launches == kv._bucketer.last_num_buckets == 1
+
+    before = reg.get_sample_value(name) or 0.0
+    for k, vals in reversed(_make_pairs(3, [((256,), "float32")] * n_keys)):
+        kv.pushpull(k, vals)
+    perkey_launches = (reg.get_sample_value(name) or 0.0) - before
+    assert perkey_launches == n_keys
+
+    fill = reg.get_sample_value("mxtpu_kvstore_bucket_fill_fraction",
+                                {"bucket": "0"})
+    assert fill is not None and 0.0 < fill <= 1.0
+    # per-bucket bytes ride the existing collective series
+    assert (reg.get_sample_value("mxtpu_kvstore_collective_bytes_total",
+                                 {"op": "allreduce_bucket"}) or 0) > 0
+
+
+class _SpyStore(kvstore.KVStoreBase):
+    """Order/priority probe delegating to a real tpu_ici store."""
+
+    def __init__(self):
+        self._inner = kvstore.create("tpu_ici")
+        self.pushpull_calls = []
+        self.list_keys = None
+
+    def broadcast(self, key, value, out, priority=0):
+        self._inner.broadcast(key, value, out, priority)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.pushpull_calls.append((key, priority))
+        self._inner.pushpull(key, value, out)
+
+    def pushpull_list(self, pairs):
+        self.list_keys = [k for k, _ in pairs]
+        self._inner.pushpull_list(pairs)
+
+    @staticmethod
+    def is_capable(capability):
+        return kvstore.TPUICIStore.is_capable(capability)
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    @property
+    def type(self):
+        return "spy"
+
+
+def _multi_device_trainer(spy=None, n_ctx=2):
+    from mxnet_tpu.gluon import nn
+
+    ctxs = [mx.cpu(i) for i in range(n_ctx)]
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=6))
+    net.add(nn.Dense(8, in_units=8))
+    net.add(nn.Dense(4, in_units=8))
+    net.initialize(ctx=ctxs)
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.05},
+                               kvstore=spy if spy is not None else "tpu_ici")
+    return net, trainer, ctxs
+
+
+def _step(net, trainer, ctxs, batch=8):
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.utils import split_and_load
+
+    xs = split_and_load(
+        mx.np.array(onp.random.randn(batch, 6).astype(onp.float32)), ctxs)
+    with autograd.record():
+        ls = [(net(xb) ** 2).mean() for xb in xs]
+    autograd.backward(ls)
+    trainer.step(batch)
+
+
+def test_trainer_issues_reverse_registration_order():
+    """Satellite: priority is load-bearing as ISSUE ORDER — the trainer
+    hands the kvstore pairs in REVERSE registration order (backward
+    produces last-layer grads first; dispatch order IS the overlap)."""
+    spy = _SpyStore()
+    net, trainer, ctxs = _multi_device_trainer(spy)
+    _step(net, trainer, ctxs)
+    n_params = len([k for k in net.collect_params()])
+    assert spy.list_keys == list(range(n_params))[::-1]
+    assert spy.pushpull_calls == []  # everything went through the list API
+
+
+def test_trainer_bucketing_optout_env(monkeypatch):
+    """MXNET_KVSTORE_BUCKETING=0 restores the classic per-key path with
+    the priority=-i hint intact."""
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKETING", "0")
+    spy = _SpyStore()
+    net, trainer, ctxs = _multi_device_trainer(spy)
+    _step(net, trainer, ctxs)
+    n_params = len([k for k in net.collect_params()])
+    assert spy.list_keys is None
+    assert spy.pushpull_calls == [(i, -i) for i in range(n_params)]
+
+
+def test_trainer_multi_device_training_stays_in_sync():
+    """End to end through the bucketed path: copies start identical and
+    stay bitwise identical across steps, and a full step costs fewer
+    collective launches than parameters."""
+    onp.random.seed(42)
+    net, trainer, ctxs = _multi_device_trainer(n_ctx=4)
+    reg = telemetry.default_registry()
+    name = "mxtpu_kvstore_collective_launches_total"
+    _step(net, trainer, ctxs)  # kv init + broadcast + first-step traces
+    before = reg.get_sample_value(name) or 0.0
+    _step(net, trainer, ctxs)
+    launches = (reg.get_sample_value(name) or 0.0) - before
+    params = net.collect_params()
+    n_params = len([k for k in params])
+    assert n_params == 6
+    assert launches < n_params, (launches, n_params)
+    for k in params:
+        copies = [d.asnumpy() for d in params[k].list_data()]
+        for c in copies[1:]:
+            assert onp.array_equal(copies[0], c), k
+
+
+def test_trainer_bucketed_matches_perkey_training(monkeypatch):
+    """The whole training trajectory (allreduce + eager multi-device
+    update) is identical with bucketing on and off."""
+    def run(bucketing_flag):
+        monkeypatch.setenv("MXNET_KVSTORE_BUCKETING", bucketing_flag)
+        onp.random.seed(7)
+        mx.random.seed(7)  # identical weight init in both runs
+        net, trainer, ctxs = _multi_device_trainer()
+        for _ in range(3):
+            _step(net, trainer, ctxs)
+        params = net.collect_params()
+        return {k: params[k].list_data()[0].asnumpy() for k in params}
+
+    w_on, w_off = run("1"), run("0")
+    for k in w_on:
+        assert onp.array_equal(w_on[k], w_off[k]), k
+
+
+def test_eager_update_counter_and_batched_scalars():
+    """Satellite: multi-device (de-fused) updates tick the eager-updates
+    counter, and the per-param scalar batching preserves per-device
+    update counts."""
+    reg = telemetry.default_registry()
+    name = "mxtpu_trainer_eager_updates_total"
+    net, trainer, ctxs = _multi_device_trainer()
+    before = reg.get_sample_value(name) or 0.0
+    _step(net, trainer, ctxs)
+    delta = (reg.get_sample_value(name) or 0.0) - before
+    n_params = len([k for k in net.collect_params()])
+    assert delta == n_params
+    # per-device update counts advanced once per device copy
+    opt = trainer.optimizer
+    for dev_id in range(len(ctxs)):
+        counts = opt._all_index_update_counts[dev_id]
+        assert all(v == 1 for v in counts.values()), counts
+
+
+def test_local_store_bucketed_parity():
+    """LocalKVStore rides the same bucketer; bucketed results match its
+    per-key reduce (psum vs sequential adds agree to float tolerance)."""
+    p_bucket = _make_pairs(5, MIXED_SIZES, n=2)
+    p_perkey = _make_pairs(5, MIXED_SIZES, n=2)
+    kv_b = kvstore.LocalKVStore()
+    kv_p = kvstore.LocalKVStore()
+    kv_b.pushpull_list(list(reversed(p_bucket)))
+    for k, vals in reversed(p_perkey):
+        kv_p.pushpull(k, vals)
+    for (k, vb), (_, vp) in zip(p_bucket, p_perkey):
+        for a, b in zip(vb, vp):
+            onp.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                                        rtol=1e-6, err_msg=str(k))
+
+
+def test_single_copy_and_rowsparse_stay_per_key():
+    """SPMD singles and row-sparse values are not bucketable: they keep
+    the per-key path (and its semantics) under pushpull_list."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    kv = kvstore.create("tpu_ici")
+    single = mx.np.array([0.3, -0.2])
+    rs = RowSparseNDArray(onp.ones((2, 3), onp.float32),
+                          onp.array([1, 4], onp.int32), (10, 3))
+    rs2 = RowSparseNDArray(onp.full((2, 3), 2.0, onp.float32),
+                           onp.array([4, 7], onp.int32), (10, 3))
+    dense = _copies(onp.full(8, 1.0, onp.float32), n=2)
+    kv.pushpull_list([(0, [single]), (1, [rs, rs2]), (2, dense)])
+    onp.testing.assert_allclose(single.asnumpy(), [0.3, -0.2])
+    expect = onp.zeros((10, 3), onp.float32)
+    expect[[1, 4, 7]] = [[1, 1, 1], [3, 3, 3], [2, 2, 2]]
+    onp.testing.assert_allclose(rs.asnumpy(), expect)
+    onp.testing.assert_array_equal(dense[0].asnumpy(),
+                                   onp.full(8, 2.0, onp.float32))
+    # only the dense pair was bucketed
+    assert kv._bucketer.last_issue_keys == [2]
+
+
+def test_bucket_bytes_env_controls_plan(monkeypatch):
+    """MXNET_KVSTORE_BUCKET_BYTES shapes the plan of a fresh bucketer."""
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", "2048")
+    b = bucketing.GradBucketer()
+    assert b.bucket_bytes == 2048
+    pairs = [(k, _copies(onp.full(256, 1.0, onp.float32), n=2))
+             for k in range(8)]  # 1 KB each, 2 KB cap -> 4 buckets
+    b.pushpull(pairs)
+    assert b.last_num_buckets == 4

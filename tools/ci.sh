@@ -30,6 +30,9 @@ run_test()   {
   # telemetry next: the observability layer every later perf PR reads
   # its numbers from fails fast and loud (ISSUE 2)
   python -m pytest tests/test_telemetry.py -q
+  # bucketed collectives (ISSUE 4): the allreduce path every multi-device
+  # trainer step rides — bit-parity vs per-key must fail fast
+  python -m pytest tests/test_kvstore_bucketing.py -q
   python -m pytest tests/ -q -x
 }
 run_perf()   { python benchmark/opperf/opperf.py --smoke; }

@@ -71,6 +71,38 @@ assert completed and completed >= 1, f"serve counter not published: {completed}"
 assert "mxtpu_trainer_step_phase_seconds" in text  # trainer series present
 print("smoke: telemetry export ok")
 
+# 2c. bucketed allreduce gate (ISSUE 4): a multi-copy trainer step must
+# collapse gradient collectives below one-per-parameter — if this fires,
+# bucketing silently disengaged and every step pays per-key launches
+ctxs = [mx.cpu(i) for i in range(4)]
+net2 = mx.gluon.nn.HybridSequential()
+net2.add(mx.gluon.nn.Dense(8, in_units=6))
+net2.add(mx.gluon.nn.Dense(8, in_units=8))
+net2.add(mx.gluon.nn.Dense(4, in_units=8))
+net2.initialize(ctx=ctxs)
+tr2 = mx.gluon.Trainer(net2.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="tpu_ici")
+from mxnet_tpu import autograd as _ag
+from mxnet_tpu.gluon.utils import split_and_load as _sal
+
+def _dp_step():
+    xs = _sal(mx.np.array(onp.random.randn(8, 6).astype(onp.float32)), ctxs)
+    with _ag.record():
+        ls = [(net2(xb) ** 2).mean() for xb in xs]
+    _ag.backward(ls)
+    tr2.step(8)
+
+_dp_step()  # kv init + broadcast + first-step traces
+_reg = telemetry.default_registry()
+_launch_name = "mxtpu_kvstore_collective_launches_total"
+_before = _reg.get_sample_value(_launch_name) or 0.0
+_dp_step()
+_delta = (_reg.get_sample_value(_launch_name) or 0.0) - _before
+_n_params = len([k for k in net2.collect_params()])
+assert _n_params == 6 and _delta < _n_params, (_delta, _n_params)
+print(f"smoke: bucketed allreduce ok ({int(_delta)} launches for "
+      f"{_n_params} params)")
+
 # 3. bench.py must at least import (its main guard must not run)
 import importlib.util as _u
 spec = _u.spec_from_file_location("bench", "bench.py")
