@@ -28,6 +28,8 @@ import os
 import sys
 import time
 
+# mxlint: disable-file=env-read-at-trace-time -- benchmark orchestration: MFU_BATCH_PROBE is the parent<->child subprocess protocol, read host-side before any compilation
+
 import numpy as onp
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))

@@ -9,6 +9,8 @@ from __future__ import annotations
 import os
 import warnings
 
+# mxlint: disable-file=env-read-at-trace-time -- process bootstrap: every read happens once during package import / jax.distributed init, before any model code can trace
+
 _ENV_VARS = ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
              "JAX_PROCESS_ID")
 

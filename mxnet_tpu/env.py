@@ -37,6 +37,42 @@ MXNET_GPU_MEM_POOL_RESERVE   accepted, no-op (PjRt owns device memory);
 MXNET_STORAGE_FALLBACK_LOG_VERBOSE  accepted, no-op (no storage fallback:
                              sparse compute is explicit here)
 =========================== =================================================
+
+Read by their owning subsystem (import-time reads are baked in for the
+process — set them before ``import mxnet_tpu``; the runtime reads say
+so explicitly).  mxlint's ``env-var-undocumented`` rule and
+``tests/test_env_vars.py`` both enforce that every ``MXNET_*`` access
+in the codebase appears in this module:
+
+=========================== =================================================
+variable                     behavior
+=========================== =================================================
+MXNET_ENGINE_DEBUG           read once at import (`ops/invoke.py`):
+                             stale-read diagnostics — warn at backward
+                             when a recorded input was mutated in place
+                             (reference §5.2 versioned-var visibility)
+MXNET_DROPOUT_RNG            read once at import (`ops/nn.py`):
+                             ``rbg`` (default, XLA hardware RNG) or
+                             ``threefry`` dropout mask bitstream; see
+                             docs/DESIGN.md "Dropout RNG streams"
+MXNET_TELEMETRY_STEADY_STEPS retrace-watchdog steady-state call count:
+                             a jit cache miss after this many calls of a
+                             watched function logs a WARNING (default 2;
+                             read when a watchdog is constructed)
+MXNET_PROFILE_RANK           set by ``tools/launch.py --profile-rank``:
+                             the matching rank (or every rank, ``-1``)
+                             starts the profiler at import and dumps a
+                             chrome trace at exit
+MXNET_PROFILE_DIR            output directory for the launcher-requested
+                             profile dumps (default ``.``)
+MXNET_KVSTORE_SPARSE_HOST_BOUND  row-sparse pushpull crossover: below
+                             this many touched rows the host union beats
+                             the device sort (default 256; re-read per
+                             pushpull so it can be tuned mid-run)
+MXNET_TPU_MODEL_REPO         colon-separated directories searched for
+                             pretrained weight files (no network egress;
+                             read at each ``get_model_file`` call)
+=========================== =================================================
 """
 from __future__ import annotations
 
@@ -98,5 +134,12 @@ def describe():
              "MXNET_ENFORCE_DETERMINISM", "MXNET_HOME",
              "MXNET_HEARTBEAT_INTERVAL", "MXNET_KVSTORE_BUCKETING",
              "MXNET_KVSTORE_BUCKET_BYTES", "MXNET_GPU_MEM_POOL_RESERVE",
-             "MXNET_STORAGE_FALLBACK_LOG_VERBOSE"]
+             "MXNET_STORAGE_FALLBACK_LOG_VERBOSE",
+             # subsystem-owned knobs (second docstring table); mxlint's
+             # env-var-undocumented rule diffs this list against every
+             # MXNET_* access in the codebase
+             "MXNET_ENGINE_DEBUG", "MXNET_DROPOUT_RNG",
+             "MXNET_TELEMETRY_STEADY_STEPS", "MXNET_PROFILE_RANK",
+             "MXNET_PROFILE_DIR", "MXNET_KVSTORE_SPARSE_HOST_BOUND",
+             "MXNET_TPU_MODEL_REPO"]
     return [(n, os.environ.get(n), n in __doc__) for n in names]

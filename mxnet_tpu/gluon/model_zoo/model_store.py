@@ -64,6 +64,7 @@ _url_format = "{repo_url}gluon/models/{file_name}.zip"
 
 
 def _default_root():
+    # mxlint: disable=env-read-at-trace-time -- host-side path lookup at file-staging time; a cache root can legitimately move between loads
     return os.path.join(os.environ.get(
         "MXNET_HOME", os.path.join(os.path.expanduser("~"), ".mxnet")),
         "models")
@@ -106,6 +107,7 @@ def get_model_file(name, root=None):
         raise IOError(
             f"{path} exists but its sha1 does not match {sha1}; delete or "
             "re-stage it")
+    # mxlint: disable=env-read-at-trace-time -- host-side file staging; users stage weights and re-point the repo between load calls
     for repo in os.environ.get("MXNET_TPU_MODEL_REPO", "").split(":"):
         if not repo:
             continue

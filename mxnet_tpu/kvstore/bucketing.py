@@ -55,11 +55,13 @@ DEFAULT_QUANTUM_BYTES = 64 << 10  # capacity padding quantum
 
 def bucketing_enabled():
     """``MXNET_KVSTORE_BUCKETING=0`` opts out (default: on)."""
+    # mxlint: disable=env-read-at-trace-time -- intentional per-call read (env.py table: "read when a store's bucketer is created"); gates host-side partitioning, never traced code
     return os.environ.get("MXNET_KVSTORE_BUCKETING", "1") != "0"
 
 
 def bucket_bytes():
     """Bucket payload cap (``MXNET_KVSTORE_BUCKET_BYTES``, default 4 MB)."""
+    # mxlint: disable=env-read-at-trace-time -- intentional per-bucketer read (documented contract); sizes host-side bucket planning, the jitted pack/unpack only ever sees the resulting static capacities
     return int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",
                               DEFAULT_BUCKET_BYTES))
 

@@ -162,6 +162,7 @@ class TPUICIStore(KVStoreBase):
         self._residuals = {}
         self._bucketer = None
         self._hb_stop = None
+        self._hb_thread = None
         # liveness grace period anchor: a rank that has never heartbeat is
         # only dead once it has had `timeout` seconds since this store
         # came up to register its first stamp
@@ -205,6 +206,9 @@ class TPUICIStore(KVStoreBase):
         client = self._kv_client()
         if client is None:
             return
+        # per-store runtime read by design: stores are constructed host-side
+        # (never under a trace) and tests tune the period per store
+        # mxlint: disable=env-read-at-trace-time -- host-side read at store construction; value only feeds the beat thread's wait()
         interval = float(os.environ.get("MXNET_HEARTBEAT_INTERVAL", "5"))
         self._hb_stop = threading.Event()
         key = f"mxtpu/heartbeat/{self._rank}"
@@ -225,6 +229,7 @@ class TPUICIStore(KVStoreBase):
         t = threading.Thread(target=beat, daemon=True,
                              name="mxtpu-heartbeat")
         t.start()
+        self._hb_thread = t
 
     def get_dead_nodes(self, timeout=60):
         """Ranks whose heartbeat is older than ``timeout`` seconds
@@ -256,8 +261,16 @@ class TPUICIStore(KVStoreBase):
         return dead
 
     def close(self):
+        """Stop AND reap the heartbeat thread.  Setting the event alone
+        left the thread parked in ``wait(interval)`` for up to a full
+        period — repeated store construction in tests leaked one daemon
+        thread per store.  The beat loop only blocks on the stop event
+        (KV calls are short), so the join is interval-bounded."""
         if self._hb_stop is not None:
             self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=10)
+            self._hb_thread = None
 
     # -- interface ---------------------------------------------------------
     def broadcast(self, key, value, out, priority=0):
@@ -438,6 +451,7 @@ class TPUICIStore(KVStoreBase):
         couple of device dispatches cost more than the host loop there."""
         from ..ndarray.sparse import RowSparseNDArray
 
+        # mxlint: disable=env-read-at-trace-time -- host-side crossover knob re-read per pushpull on purpose (tunable mid-run); selects a host branch, never enters traced code
         bound = int(os.environ.get("MXNET_KVSTORE_SPARSE_HOST_BOUND",
                                    self._SPARSE_HOST_BOUND))
         cols = tuple(vals[0].shape[1:])

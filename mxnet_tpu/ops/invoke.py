@@ -27,6 +27,7 @@ Reference analogue: `src/imperative/imperative.cc` (``Imperative::Invoke`` at
 """
 from __future__ import annotations
 
+import os
 import threading
 from collections import defaultdict
 
@@ -148,9 +149,16 @@ class Node:
             if _engine_debug() else None)
 
 
+# Read ONCE at import (the _DROPOUT_RNG_IMPL convention, ADVICE r5):
+# Node.__init__ consults this on every recorded op, so a per-call environ
+# read was both hot-path overhead and a half-applied-config hazard — ops
+# recorded before an env change carried no versions while later ones did.
+# Tests toggle the module flag directly (monkeypatch.setattr).
+_ENGINE_DEBUG = os.environ.get("MXNET_ENGINE_DEBUG", "0") not in ("0", "")
+
+
 def _engine_debug():
-    import os
-    return os.environ.get("MXNET_ENGINE_DEBUG", "0") not in ("0", "")
+    return _ENGINE_DEBUG
 
 
 def _is_nd(x):

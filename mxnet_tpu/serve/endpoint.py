@@ -74,13 +74,17 @@ class _Request:
 
 
 class _HookHandle:
-    def __init__(self, collection, hook):
+    def __init__(self, collection, hook, lock):
         self._collection = collection
         self._hook = hook
+        self._lock = lock
 
     def detach(self):
-        if self._hook in self._collection:
-            self._collection.remove(self._hook)
+        # check-then-remove must be atomic: two concurrent detaches of the
+        # same hook otherwise race between the `in` and the `remove`
+        with self._lock:
+            if self._hook in self._collection:
+                self._collection.remove(self._hook)
 
 
 class Endpoint:
@@ -225,8 +229,9 @@ class Endpoint:
     def register_batch_hook(self, hook):
         """``hook(endpoint, real_rows, bucket_rows, latency_s)`` after
         every dispatched batch (monitor integration)."""
-        self._batch_hooks.append(hook)
-        return _HookHandle(self._batch_hooks, hook)
+        with self._model_lock:
+            self._batch_hooks.append(hook)
+        return _HookHandle(self._batch_hooks, hook, self._model_lock)
 
     # -- model -> pure fn --------------------------------------------------
     def _ensure_executable(self, arrays):

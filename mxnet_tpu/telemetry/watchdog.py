@@ -100,6 +100,7 @@ class RetraceWatchdog:
 
     def __init__(self, steady_after=None, registry=None, logger=None):
         if steady_after is None:
+            # mxlint: disable=env-read-at-trace-time -- host-side read at watchdog construction; per-instance override is the documented contract
             steady_after = int(
                 os.environ.get("MXNET_TELEMETRY_STEADY_STEPS", "2"))
         self.steady_after = int(steady_after)

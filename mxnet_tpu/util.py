@@ -83,7 +83,9 @@ def get_gpu_count():
 
 
 def getenv(name):
+    """Reference ``mx.util.getenv`` parity shim."""
     import os
+    # mxlint: disable=env-read-at-trace-time -- public reference-API shim: live read is the documented behavior, host-side by contract
     v = os.environ.get(name)
     return v
 

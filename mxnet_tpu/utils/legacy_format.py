@@ -93,6 +93,7 @@ def _read_array(r):
         import jax.numpy as jnp
         n = int(onp.prod(shape, dtype=onp.int64)) if shape else 1
         raw = onp.frombuffer(r.raw(2 * n), dtype=onp.uint16)
+        # mxlint: disable=bits-as-float -- THE codec boundary: uint16 wire bytes -> bf16 values; bits go straight to the caller as data, no integer payload ever rides a float container
         return raw.view(jnp.bfloat16).reshape(shape)
     dt = onp.dtype(_TYPE_FLAGS[type_flag])
     n = int(onp.prod(shape, dtype=onp.int64)) if shape else 1
@@ -125,6 +126,7 @@ def save_legacy(arrays, names=()):
         a = onp.ascontiguousarray(a)
         if str(a.dtype) == "bfloat16":
             flag = _BF16_FLAG
+            # mxlint: disable=bits-as-float -- codec boundary (inverse of _read_array): bf16 values -> uint16 wire bytes, serialized immediately, never used as floats
             raw = a.view(onp.uint16).tobytes()
         else:
             flag = _FLAG_OF[onp.dtype(a.dtype)]

@@ -1,0 +1,11 @@
+"""Clean: astype converts values, not bit patterns."""
+import jax.numpy as jnp
+
+
+def widen(x):
+    return x.astype(jnp.float32)
+
+
+def reshape_not_dtype(x):
+    # torch-style shape .view is not a bit reinterpretation
+    return x.view(2, 3)

@@ -1,0 +1,4 @@
+"""Clean: MXNET_SEED is in env.describe()'s documented table."""
+import os
+
+SEED = os.environ.get("MXNET_SEED")

@@ -1,0 +1,4 @@
+"""True positive: an MXNET_* knob that env.describe() does not list."""
+import os
+
+FLAG = os.environ.get("MXNET_NOT_IN_THE_TABLE", "0")

@@ -1,0 +1,9 @@
+"""Clean: env reads at module scope execute once at import."""
+import os
+
+KNOB = os.environ.get("SOME_KNOB", "0")
+OTHER = os.environ["PATH"] if "PATH" in os.environ else ""
+
+
+def uses_baked_value():
+    return KNOB

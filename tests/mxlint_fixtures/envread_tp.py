@@ -1,0 +1,15 @@
+"""True positive: os.environ read inside a function body."""
+import os
+
+
+def knob():
+    # read at call time: if the caller is ever traced, this bakes in
+    return os.environ.get("SOME_KNOB", "0")
+
+
+def knob_subscript():
+    return os.environ["SOME_KNOB"]
+
+
+def knob_membership():
+    return "SOME_KNOB" in os.environ

@@ -1,0 +1,20 @@
+"""Clean: static shape math inside jit is host math on Python ints."""
+import jax
+
+from mxnet_tpu.gluon.block import HybridBlock
+
+
+@jax.jit
+def good_step(x):
+    n = int(x.shape[0])              # static: allowed
+    m = float(len(x.shape))          # static: allowed
+    return x * (n + m)
+
+
+class Net(HybridBlock):
+    def forward(self, x):
+        return x.reshape(int(x.shape[0]), -1)
+
+
+def host_helper(x):
+    return float(x)                  # NOT traced anywhere: fine

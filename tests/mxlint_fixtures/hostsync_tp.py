@@ -1,0 +1,23 @@
+"""True positive: host syncs inside traced functions (never imported)."""
+import jax
+import numpy as onp
+
+from mxnet_tpu.gluon.block import HybridBlock
+
+
+@jax.jit
+def bad_step(x):
+    s = x.sum()
+    return s.item()                  # device->host sync under jit
+
+
+def also_bad(x):
+    return float(x)                  # concretizes a tracer
+
+
+also_bad_jit = jax.jit(also_bad)     # marks also_bad as traced
+
+
+class Net(HybridBlock):
+    def forward(self, x):
+        return onp.asarray(x) * 2    # hybridize() would trace this

@@ -1,0 +1,22 @@
+"""Clean: every shared-state mutation happens under the lock."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+        self._events = []
+
+    def bump(self, delta=1):
+        with self._lock:
+            self._value += delta
+
+    def record(self, ev):
+        with self._lock:
+            self._events.append(ev)
+
+    def snapshot(self):
+        # reads are not flagged (GIL-atomic; staleness is the caller's
+        # problem, lost updates are not)
+        return self._value, list(self._events)

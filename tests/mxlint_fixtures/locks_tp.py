@@ -1,0 +1,19 @@
+"""True positive: lock-owning class mutating shared state unlocked."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+        self._events = []
+        self._by_key = {}
+
+    def bump(self, delta=1):
+        self._value += delta          # unlocked read-modify-write
+
+    def record(self, ev):
+        self._events.append(ev)       # unlocked container mutation
+
+    def index(self, k, v):
+        self._by_key[k] = v           # unlocked subscript store

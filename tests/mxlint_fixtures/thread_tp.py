@@ -1,0 +1,18 @@
+"""True positive: daemon thread with no join path in the owning class."""
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def start(self):
+        t = threading.Thread(target=self._poll, daemon=True)
+        t.start()                    # never retained, never joined
+
+    def close(self):
+        self._stop.set()             # stop event alone does not reap
+
+    def _poll(self):
+        while not self._stop.wait(1):
+            pass

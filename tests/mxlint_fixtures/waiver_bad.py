@@ -1,0 +1,7 @@
+"""A waiver without a reason is itself a finding and waives nothing."""
+import os
+
+
+def knob():
+    # mxlint: disable=env-read-at-trace-time
+    return os.environ.get("SOME_KNOB")

@@ -1,0 +1,11 @@
+"""Waivers with reasons suppress findings (same-line and line-above)."""
+import os
+
+
+def knob():
+    # mxlint: disable=env-read-at-trace-time -- fixture: host-side by contract
+    return os.environ.get("SOME_KNOB")
+
+
+def other():
+    return os.environ.get("OTHER_KNOB")  # mxlint: disable=env-read-at-trace-time -- fixture: trailing-comment form

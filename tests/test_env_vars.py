@@ -133,6 +133,53 @@ def test_describe_lists_honored_vars():
     assert all(h for _n, _v, h in table)
 
 
+def test_env_inventory_matches_describe_exactly():
+    """ISSUE 5: the env-var surface can never drift again.  mxlint's
+    AST inventory of every MXNET_* access across mxnet_tpu/, tools/,
+    and benchmark/ must equal describe()'s documented table, modulo the
+    two declared accepted-no-op knobs (documented for reference parity,
+    intentionally never read).  A new knob read without documentation
+    fails here AND fails `python -m tools.mxlint` in CI; a documented
+    knob whose last read is deleted fails here until the table (or
+    DECLARED_NOOPS) is updated."""
+    from tools.mxlint.rules.env_doc import (DECLARED_NOOPS,
+                                            discovered_env_vars,
+                                            documented_env_vars)
+
+    documented = documented_env_vars()
+    discovered = set(discovered_env_vars())
+    undocumented = discovered - documented
+    assert not undocumented, \
+        f"MXNET_* vars read in code but missing from env.describe(): " \
+        f"{sorted(undocumented)}"
+    never_read = documented - discovered
+    assert never_read == set(DECLARED_NOOPS), \
+        f"documented vars with no read site (and not declared no-ops): " \
+        f"{sorted(never_read - set(DECLARED_NOOPS))} / stale no-op " \
+        f"declarations: {sorted(set(DECLARED_NOOPS) - never_read)}"
+    # the AST view agrees with the live function
+    assert documented == {n for n, _v, _h in mx.env.describe()}
+
+
+def test_engine_debug_env_read_once_at_import():
+    """MXNET_ENGINE_DEBUG follows the _DROPOUT_RNG_IMPL convention: read
+    once at import (it is consulted per recorded op on the tape hot
+    path), so setting it pre-import works and post-import changes are
+    inert."""
+    code = """
+        import mxnet_tpu as mx
+        from mxnet_tpu.ops import invoke
+        assert invoke._ENGINE_DEBUG is True
+        import os
+        os.environ["MXNET_ENGINE_DEBUG"] = "0"   # post-import: inert
+        assert invoke._engine_debug() is True
+        print("engine-debug-ok")
+    """
+    r = _run(code, MXNET_ENGINE_DEBUG="1")
+    assert r.returncode == 0, r.stderr
+    assert "engine-debug-ok" in r.stdout
+
+
 def test_dropout_rng_env_read_once_at_import(monkeypatch):
     """ADVICE r5: MXNET_DROPOUT_RNG is consulted inside traced code, so
     a post-import change could never reach cached executables — it is

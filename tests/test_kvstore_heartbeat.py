@@ -1,0 +1,81 @@
+"""Heartbeat thread lifecycle (ISSUE 5 satellite).
+
+Before the fix, ``TPUICIStore.close()`` only set the stop event: the
+daemon thread object was never retained or joined, so every store
+constructed in a test leaked one ``mxtpu-heartbeat`` thread for up to a
+full interval (and forever if the event was never set).  mxlint's
+``daemon-thread-no-shutdown`` rule now catches the pattern statically;
+this is the runtime regression test.
+"""
+import threading
+
+import pytest
+
+from mxnet_tpu.kvstore.tpu_ici import TPUICIStore
+
+
+class _FakeKVClient:
+    """In-process stand-in for jax.distributed's coordination KV."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_set(self, k, v):
+        self.kv[k] = v
+
+    def key_value_delete(self, k):
+        self.kv.pop(k, None)
+
+    def key_value_try_get(self, k):
+        return self.kv.get(k)
+
+
+def _hb_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "mxtpu-heartbeat" and t.is_alive()]
+
+
+def test_heartbeat_threads_reaped_on_close(monkeypatch):
+    """Thread count returns to baseline after close: repeated store
+    construction cannot leak one daemon thread per store."""
+    client = _FakeKVClient()
+    monkeypatch.setenv("MXNET_HEARTBEAT_INTERVAL", "0.05")
+    monkeypatch.setattr(TPUICIStore, "_kv_client", lambda self: client)
+    baseline = len(_hb_threads())
+    stores = []
+    for _ in range(5):
+        s = TPUICIStore()        # process_count()==1: start explicitly,
+        s._start_heartbeat()     # exactly as a size>1 __init__ would
+        stores.append(s)
+    assert len(_hb_threads()) == baseline + 5
+    for s in stores:
+        s.close()
+    assert len(_hb_threads()) == baseline
+    # close is idempotent (reference KVStore contract)
+    stores[0].close()
+    assert len(_hb_threads()) == baseline
+
+
+def test_heartbeat_actually_beats_then_stops(monkeypatch):
+    client = _FakeKVClient()
+    monkeypatch.setenv("MXNET_HEARTBEAT_INTERVAL", "0.01")
+    monkeypatch.setattr(TPUICIStore, "_kv_client", lambda self: client)
+    s = TPUICIStore()
+    s._start_heartbeat()
+    assert s._hb_thread is not None and s._hb_thread.is_alive()
+    deadline = threading.Event()
+    for _ in range(200):
+        if any(k.startswith("mxtpu/heartbeat/") for k in client.kv):
+            break
+        deadline.wait(0.01)
+    else:
+        pytest.fail("heartbeat never stamped the KV store")
+    s.close()
+    assert s._hb_thread is None
+    assert not _hb_threads()
+
+
+def test_close_without_heartbeat_is_a_noop():
+    s = TPUICIStore()   # single process: no thread started
+    assert s._hb_thread is None
+    s.close()

@@ -1,0 +1,2 @@
+# Makes `tools` importable so `python -m tools.mxlint` and
+# `import tools.mxlint` resolve from the repo root.

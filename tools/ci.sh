@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI entry (reference: ci/build.py + runtime_functions.sh stages).
-# Stages: import | smoke | test | perf | dryrun | all (default).
+# Stages: lint | import | smoke | test | perf | dryrun | all (default).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -9,6 +9,14 @@ export JAX_PLATFORMS=cpu
 export PALLAS_AXON_POOL_IPS=
 export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 
+run_lint() {
+  # zero-unbaselined-findings gate (ISSUE 5): pure-AST, runs before
+  # anything imports — trace-time env reads, lock discipline, host
+  # syncs in jit, daemon-thread leaks, undocumented MXNET_* knobs
+  # (docs/STATIC_ANALYSIS.md; waive with `# mxlint: disable=<rule> --
+  # <reason>`, grandfather with --update-baseline)
+  python -m tools.mxlint
+}
 run_import() {
   # hard gate (ISSUE 1): bare import + zero collection errors, so an
   # import-time crash can never land again
@@ -46,11 +54,12 @@ run_dryrun() {
 }
 
 case "$stage" in
+  lint)   run_lint ;;
   import) run_import ;;
   smoke)  run_smoke ;;
   test)   run_test ;;
   perf)   run_perf ;;
   dryrun) run_dryrun ;;
-  all)    run_import; run_smoke; run_test; run_perf; run_dryrun ;;
+  all)    run_lint; run_import; run_smoke; run_test; run_perf; run_dryrun ;;
   *) echo "unknown stage $stage" >&2; exit 2 ;;
 esac

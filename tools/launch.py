@@ -35,6 +35,7 @@ import socket
 import subprocess
 import sys
 
+# mxlint: disable-file=env-read-at-trace-time -- launcher plumbing: forwards the caller's environment into worker processes before mxnet_tpu ever imports
 __all__ = ["launch_local", "launch_ssh", "parse_hostfile"]
 
 

@@ -1,0 +1,22 @@
+"""mxlint — project-aware static analysis for mxnet-tpu.
+
+Rules are distilled from this repo's own recurring bug classes (see
+docs/STATIC_ANALYSIS.md for the genealogy): trace-time env reads that
+get baked into cached executables, undocumented ``MXNET_*`` knobs,
+unlocked mutation of thread-shared state, host syncs inside traced
+code, int<->float bit reinterpretation, and daemon threads without a
+shutdown path.
+
+Entry points:
+
+* ``python -m tools.mxlint`` — lint the project (mxnet_tpu/, tools/,
+  benchmark/), exit nonzero on any unbaselined finding.
+* :func:`tools.mxlint.driver.run` — programmatic API (tests use it).
+* :func:`tools.mxlint.rules.env_doc.discovered_env_vars` /
+  :func:`documented_env_vars` — the env-var inventory that
+  ``tests/test_env_vars.py`` locks against ``env.describe()``.
+"""
+from .core import Finding  # noqa: F401
+from .driver import lint, main, run  # noqa: F401
+
+__all__ = ["Finding", "lint", "main", "run"]

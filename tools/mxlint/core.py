@@ -1,0 +1,348 @@
+"""Shared mxlint infrastructure: findings, file contexts, waivers.
+
+A *finding* is one rule violation at one source location.  Its ``id``
+is stable across unrelated edits: it hashes (rule, path, enclosing
+qualname, normalized source line) rather than the line number, so
+inserting code above a grandfathered finding does not invalidate the
+baseline, while editing the offending line itself does — exactly when
+a human should re-look.
+
+Waiver grammar (reason REQUIRED — an empty reason is itself the
+``bad-waiver`` finding)::
+
+    x = os.environ.get("MXNET_FOO")  # mxlint: disable=env-read-at-trace-time -- host-side only
+    # mxlint: disable=lock-discipline -- single-writer by construction
+    counters[k] += 1
+
+    # mxlint: disable-file=env-read-at-trace-time -- launcher plumbing
+
+Line waivers cover their own line or, when the comment stands alone,
+the next line.  File waivers cover the whole module.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: Directories walked by default, relative to the repo root.
+DEFAULT_ROOTS = ("mxnet_tpu", "tools", "benchmark")
+
+_SKIP_DIRS = {"__pycache__", ".git", "results"}
+
+_WAIVER_RE = re.compile(
+    r"#\s*mxlint:\s*(disable|disable-file)="
+    r"(?P<rules>[A-Za-z0-9_,-]+)"
+    r"(?:\s*--\s*(?P<reason>\S.*?))?\s*$")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    qualname: str = "<module>"
+    id: str = ""
+    waived: bool = False
+    waive_reason: str | None = None
+    baselined: bool = False
+
+    def to_json(self):
+        return {
+            "id": self.id,
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "qualname": self.qualname,
+            "message": self.message,
+            "waived": self.waived,
+            "waive_reason": self.waive_reason,
+            "baselined": self.baselined,
+        }
+
+
+@dataclass
+class Waiver:
+    line: int
+    rules: tuple
+    reason: str | None
+    file_level: bool
+    used: bool = False
+
+
+@dataclass
+class FileContext:
+    """Everything a rule needs about one source file (parsed once)."""
+    abspath: str
+    relpath: str
+    source: str
+    lines: list
+    tree: ast.AST
+    waivers: list = field(default_factory=list)
+    _scopes: list = field(default_factory=list)   # (start, end, qualname)
+    _stmt_start: dict = field(default_factory=dict)  # line -> stmt first line
+
+    def finding(self, rule, node, message):
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, path=self.relpath, line=line, col=col,
+                       message=message, qualname=self.qualname_at(line))
+
+    def qualname_at(self, line):
+        best = "<module>"
+        best_span = None
+        for start, end, qn in self._scopes:
+            if start <= line <= end:
+                span = end - start
+                if best_span is None or span <= best_span:
+                    best, best_span = qn, span
+        return best
+
+    def line_text(self, line):
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def stmt_start(self, line):
+        """First line of the innermost statement containing ``line`` —
+        waivers on a multi-line statement's opening line cover findings
+        anchored anywhere inside it."""
+        return self._stmt_start.get(line, line)
+
+
+def iter_py_files(paths=None, repo_root=None):
+    """Yield absolute paths of .py files under ``paths`` (files or
+    directories; default: the project roots)."""
+    root = repo_root or REPO_ROOT
+    if paths is None:
+        paths = [os.path.join(root, r) for r in DEFAULT_ROOTS]
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def _build_scopes(tree):
+    scopes = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qn = f"{prefix}.{child.name}" if prefix else child.name
+                scopes.append((child.lineno, child.end_lineno or child.lineno,
+                               qn))
+                walk(child, qn)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return scopes
+
+
+def _parse_waivers(source):
+    waivers = []
+    try:
+        import io
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        comments = [(t.start[0], t.string) for t in tokens
+                    if t.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError):
+        comments = [(i + 1, line[line.index("#"):])
+                    for i, line in enumerate(source.splitlines())
+                    if "#" in line]
+    for line, text in comments:
+        m = _WAIVER_RE.search(text)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group("rules").split(",")
+                      if r.strip())
+        waivers.append(Waiver(line=line, rules=rules,
+                              reason=m.group("reason"),
+                              file_level=m.group(1) == "disable-file"))
+    return waivers
+
+
+def load_file(abspath, repo_root=None):
+    """Parse one file into a :class:`FileContext` (None on read error)."""
+    root = repo_root or REPO_ROOT
+    with open(abspath, "r", encoding="utf-8") as f:
+        source = f.read()
+    relpath = os.path.relpath(abspath, root).replace(os.sep, "/")
+    tree = ast.parse(source, filename=relpath)
+    ctx = FileContext(abspath=abspath, relpath=relpath, source=source,
+                      lines=source.splitlines(), tree=tree)
+    ctx.waivers = _parse_waivers(source)
+    ctx._scopes = _build_scopes(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.stmt):
+            for ln in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+                # innermost statement wins: later (deeper) visits overwrite
+                # only if they start later
+                if ln not in ctx._stmt_start or \
+                        node.lineno >= ctx._stmt_start[ln]:
+                    ctx._stmt_start[ln] = node.lineno
+    return ctx
+
+
+def assign_ids(findings, ctx_by_path):
+    """Stable IDs: hash of (rule, path, qualname, normalized line text),
+    disambiguated by occurrence order for identical keys."""
+    seen = {}
+    for f in findings:
+        ctx = ctx_by_path.get(f.path)
+        text = ctx.line_text(f.line).strip() if ctx else ""
+        key = f"{f.rule}|{f.path}|{f.qualname}|{text}"
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        if n:
+            key = f"{key}|#{n + 1}"
+        f.id = hashlib.sha1(key.encode("utf-8")).hexdigest()[:12]
+    return findings
+
+
+def apply_waivers(findings, ctx):
+    """Mark findings covered by a (reasoned) waiver; emit ``bad-waiver``
+    findings for waivers missing the required reason."""
+    out = []
+    file_waivers = [w for w in ctx.waivers if w.file_level and w.reason]
+    line_waivers = {}
+    for w in ctx.waivers:
+        if not w.file_level and w.reason:
+            line_waivers.setdefault(w.line, []).append(w)
+
+    for f in findings:
+        hit = None
+        for w in file_waivers:
+            if f.rule in w.rules:
+                hit = w
+                break
+        if hit is None:
+            anchor_lines = {f.line, ctx.stmt_start(f.line)}
+            candidates = []
+            for ln in anchor_lines:
+                candidates.extend(line_waivers.get(ln, ()))
+                # a standalone comment line waives the line BELOW it
+                for w in line_waivers.get(ln - 1, ()):
+                    if ctx.line_text(w.line).lstrip().startswith("#"):
+                        candidates.append(w)
+            for w in candidates:
+                if f.rule in w.rules:
+                    hit = w
+                    break
+        if hit is not None:
+            f.waived, f.waive_reason = True, hit.reason
+            hit.used = True
+        out.append(f)
+
+    for w in ctx.waivers:
+        if not w.reason:
+            out.append(Finding(
+                rule="bad-waiver", path=ctx.relpath, line=w.line, col=0,
+                message="mxlint waiver without a reason — append "
+                        "`-- <why this is safe>` (unreasoned waivers are "
+                        "worse than findings: they hide intent)",
+                qualname=ctx.qualname_at(w.line)))
+    return out
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+# --------------------------------------------------------------------------
+def unparse(node):
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def is_environ_expr(node):
+    """``os.environ`` / bare ``environ`` (from-import)."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ" and \
+            isinstance(node.value, ast.Name) and node.value.id == "os":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def _const_env_name(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def iter_env_accesses(tree):
+    """Yield ``(node, var_name_or_None, is_read)`` for every access of the
+    process environment: ``os.environ.get/.setdefault/.pop``,
+    ``os.environ[...]`` (load and store), ``os.getenv``, ``K in
+    os.environ``, and bare ``os.environ`` passed around (``dict(os.environ)``).
+    """
+    claimed = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and is_environ_expr(fn.value) \
+                    and fn.attr in ("get", "setdefault", "pop",
+                                    "__getitem__", "__contains__"):
+                claimed.add(id(fn.value))
+                name = _const_env_name(node.args[0]) if node.args else None
+                yield node, name, True
+            elif isinstance(fn, ast.Attribute) and is_environ_expr(fn.value):
+                # other environ methods (keys/items/update/delete): treat as
+                # a read of the whole env except pure writes
+                claimed.add(id(fn.value))
+                is_read = fn.attr not in ("update", "__setitem__",
+                                          "__delitem__", "clear")
+                yield node, None, is_read
+            elif (isinstance(fn, ast.Attribute) and fn.attr == "getenv"
+                  and isinstance(fn.value, ast.Name) and fn.value.id == "os") \
+                    or (isinstance(fn, ast.Name) and fn.id == "getenv"):
+                name = _const_env_name(node.args[0]) if node.args else None
+                yield node, name, True
+        elif isinstance(node, ast.Subscript) and is_environ_expr(node.value):
+            claimed.add(id(node.value))
+            name = _const_env_name(node.slice)
+            yield node, name, isinstance(node.ctx, ast.Load)
+        elif isinstance(node, ast.Compare) and any(
+                is_environ_expr(c) for c in node.comparators) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+            for c in node.comparators:
+                if is_environ_expr(c):
+                    claimed.add(id(c))
+            yield node, _const_env_name(node.left), True
+    # bare `os.environ` loads not consumed above (dict(os.environ), ...)
+    for node in ast.walk(tree):
+        if is_environ_expr(node) and id(node) not in claimed and \
+                isinstance(getattr(node, "ctx", None), ast.Load):
+            yield node, None, True
+
+
+def enclosing_function_lines(tree):
+    """Set of line numbers that fall inside any def/lambda body — i.e.
+    NOT executed at import time."""
+    lines = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            body = node.body if not isinstance(node, ast.Lambda) else [node.body]
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    ln = getattr(sub, "lineno", None)
+                    if ln is not None:
+                        lines.add(ln)
+    return lines

@@ -1,0 +1,185 @@
+"""mxlint driver: walk, check, waive, baseline, report.
+
+Exit status: 0 when every finding is waived or baselined, 1 when any
+unbaselined finding remains, 2 on usage error.  ``tools/ci.sh`` runs
+this as a hard gate before anything else.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import core
+from .rules import all_rules
+
+DEFAULT_BASELINE = os.path.join(core.REPO_ROOT, "tools",
+                                "mxlint_baseline.json")
+
+JSON_SCHEMA_VERSION = 1
+
+
+def lint(paths=None, rules=None, repo_root=None):
+    """Run ``rules`` (default: all) over ``paths`` (default: project
+    roots).  Returns (findings, n_files); waivers applied, no baseline."""
+    root = repo_root or core.REPO_ROOT
+    rules = all_rules() if rules is None else rules
+    ctx_by_path = {}
+    by_file = {}
+    n_files = 0
+    for abspath in core.iter_py_files(paths, repo_root=root):
+        n_files += 1
+        try:
+            ctx = core.load_file(abspath, repo_root=root)
+        except SyntaxError as e:
+            f = core.Finding(
+                rule="parse-error", path=os.path.relpath(
+                    abspath, root).replace(os.sep, "/"),
+                line=e.lineno or 1, col=e.offset or 0,
+                message=f"file does not parse: {e.msg}")
+            by_file.setdefault(f.path, []).append(f)
+            continue
+        except UnicodeDecodeError:
+            continue
+        ctx_by_path[ctx.relpath] = ctx
+        for rule in rules:
+            for f in rule.check_file(ctx) or ():
+                by_file.setdefault(ctx.relpath, []).append(f)
+    for rule in rules:
+        for f in rule.finalize() or ():
+            by_file.setdefault(f.path, []).append(f)
+
+    findings = []
+    for relpath, ctx in ctx_by_path.items():
+        findings.extend(core.apply_waivers(by_file.pop(relpath, []), ctx))
+    for leftover in by_file.values():   # parse errors: no ctx, no waivers
+        findings.extend(leftover)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    core.assign_ids(findings, ctx_by_path)
+    return findings, n_files
+
+
+def load_baseline(path):
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return data.get("findings", {})
+
+
+def write_baseline(path, findings):
+    """Grandfather every current unwaived finding (``--update-baseline``)."""
+    entries = {
+        f.id: {"rule": f.rule, "path": f.path, "qualname": f.qualname,
+               "message": f.message}
+        for f in findings if not f.waived}
+    payload = {
+        "comment": "mxlint grandfathered findings — entries are debts, not "
+                   "permissions; remove as they are fixed. Regenerate with "
+                   "`python -m tools.mxlint --update-baseline`.",
+        "version": JSON_SCHEMA_VERSION,
+        "findings": dict(sorted(entries.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return entries
+
+
+def report_text(findings, n_files, stale_ids, out=sys.stdout):
+    unbaselined = [f for f in findings if not f.waived and not f.baselined]
+    for f in unbaselined:
+        out.write(f"{f.path}:{f.line}:{f.col + 1}: [{f.rule}] "
+                  f"{f.message}  (id {f.id})\n")
+    n_w = sum(1 for f in findings if f.waived)
+    n_b = sum(1 for f in findings if f.baselined)
+    if stale_ids:
+        out.write(f"mxlint: note — {len(stale_ids)} baseline entr"
+                  f"{'y is' if len(stale_ids) == 1 else 'ies are'} stale "
+                  f"(finding fixed): rerun with --update-baseline to "
+                  f"prune: {', '.join(sorted(stale_ids))}\n")
+    verdict = "clean" if not unbaselined else \
+        f"{len(unbaselined)} unbaselined finding" + \
+        ("s" if len(unbaselined) != 1 else "")
+    out.write(f"mxlint: {verdict} — {n_files} files, "
+              f"{len(findings)} findings ({n_w} waived, {n_b} baselined)\n")
+
+
+def report_json(findings, n_files, stale_ids, out=sys.stdout):
+    unbaselined = [f for f in findings if not f.waived and not f.baselined]
+    payload = {
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "mxlint",
+        "files_scanned": n_files,
+        "findings": [f.to_json() for f in findings],
+        "stale_baseline_ids": sorted(stale_ids),
+        "summary": {
+            "total": len(findings),
+            "waived": sum(1 for f in findings if f.waived),
+            "baselined": sum(1 for f in findings if f.baselined),
+            "unbaselined": len(unbaselined),
+        },
+    }
+    json.dump(payload, out, indent=2)
+    out.write("\n")
+
+
+def run(paths=None, baseline_path=None, update_baseline=False,
+        fmt="text", out=sys.stdout, repo_root=None):
+    """Full pipeline; returns the process exit code."""
+    findings, n_files = lint(paths, repo_root=repo_root)
+    baseline = {}
+    if baseline_path:
+        baseline = load_baseline(baseline_path)
+        for f in findings:
+            if not f.waived and f.id in baseline:
+                f.baselined = True
+    if update_baseline:
+        if not baseline_path:
+            out.write("mxlint: --update-baseline needs --baseline PATH\n")
+            return 2
+        entries = write_baseline(baseline_path, findings)
+        out.write(f"mxlint: baseline written — {len(entries)} entr"
+                  f"{'y' if len(entries) == 1 else 'ies'} -> "
+                  f"{baseline_path}\n")
+        return 0
+    present = {f.id for f in findings if not f.waived}
+    stale_ids = set(baseline) - present
+    (report_json if fmt == "json" else report_text)(
+        findings, n_files, stale_ids, out=out)
+    return 1 if any(not f.waived and not f.baselined for f in findings) else 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m tools.mxlint",
+        description="Project-aware static analysis for mxnet-tpu "
+                    "(docs/STATIC_ANALYSIS.md).")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: mxnet_tpu/ "
+                        "tools/ benchmark/)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON of grandfathered finding IDs "
+                        "(default: tools/mxlint_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report everything)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.name:28s} {rule.description}")
+        return 0
+
+    return run(paths=args.paths or None,
+               baseline_path=None if args.no_baseline else args.baseline,
+               update_baseline=args.update_baseline,
+               fmt=args.format)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

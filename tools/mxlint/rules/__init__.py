@@ -1,0 +1,41 @@
+"""mxlint rule registry.
+
+Each rule is a class with a unique ``name`` (the waiver token), a
+``description`` (one line, shown by ``--list-rules``), a
+``check_file(ctx)`` hook yielding :class:`~tools.mxlint.core.Finding`
+per file, and an optional ``finalize()`` hook for project-wide checks
+that need the whole inventory (e.g. env-var documentation coverage).
+
+Rules are instantiated fresh per run, so ``check_file`` may accumulate
+state for ``finalize``.
+"""
+from __future__ import annotations
+
+
+class Rule:
+    name = ""
+    description = ""
+
+    def check_file(self, ctx):
+        return []
+
+    def finalize(self):
+        return []
+
+
+def all_rules():
+    """Fresh instances of every shipped rule."""
+    from .bits import BitsAsFloat
+    from .env_doc import EnvVarUndocumented
+    from .env_trace import EnvReadAtTraceTime
+    from .host_sync import HostSyncInJit
+    from .locks import LockDiscipline
+    from .threads import DaemonThreadNoShutdown
+    return [
+        EnvReadAtTraceTime(),
+        EnvVarUndocumented(),
+        LockDiscipline(),
+        HostSyncInJit(),
+        BitsAsFloat(),
+        DaemonThreadNoShutdown(),
+    ]

@@ -1,0 +1,67 @@
+"""bits-as-float: int<->float bit reinterpretation outside a boundary.
+
+Ancestor bug (fixed in PR 3): ``FusedTrainStep`` carried its PRNG
+counter as int bits viewed into a float gradient buffer; any value
+landing in the NaN-payload encoding zone was silently canonicalized by
+the next float op and the counter corrupted — a once-a-week NaN cliff.
+The fix shipped the counter as its own int32 array; this rule keeps
+the pattern from growing back.
+
+Flags ``x.view(<dtype>)`` (ndarray bit reinterpretation) and
+``lax.bitcast_convert_type`` / ``.bitcast`` anywhere outside an
+explicitly allowlisted module.  Legitimate format-conversion sites
+(e.g. the legacy bf16 checkpoint codec) carry a waiver naming the
+invariant that makes them safe.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .. import core
+from . import Rule
+
+#: Modules allowed to reinterpret bits without a waiver (empty: the
+#: repo's codec sites carry explicit per-line waivers instead, so every
+#: boundary states its own safety argument).
+ALLOWED_MODULES = frozenset()
+
+_DTYPEISH = re.compile(
+    r"(?:jnp|onp|np|numpy|jax\.numpy)\.(?:bfloat|float|u?int)[0-9]*|"
+    r"(?:^|[(,=\s])[\"'](?:bfloat|float|u?int)[0-9]+[\"']|dtype")
+
+
+class BitsAsFloat(Rule):
+    name = "bits-as-float"
+    description = (".view(dtype)/bitcast between int and float bits outside "
+                   "an allowlisted quantization/codec boundary")
+
+    def check_file(self, ctx):
+        if ctx.relpath in ALLOWED_MODULES:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in ("bitcast_convert_type", "bitcast"):
+                yield ctx.finding(
+                    self.name, node,
+                    f"`{core.unparse(f)}` reinterprets raw bits: payloads "
+                    f"that alias NaN encodings get canonicalized by the "
+                    f"next float op (the FusedTrainStep counter class) — "
+                    f"keep integer payloads in integer arrays, or waive "
+                    f"naming the invariant that keeps the bits inert")
+            elif isinstance(f, ast.Attribute) and f.attr == "view" \
+                    and self._dtype_arg(node):
+                yield ctx.finding(
+                    self.name, node,
+                    f"`.view({core.unparse(node.args[0]) if node.args else ''})`"
+                    f" reinterprets array bits across dtypes (the "
+                    f"FusedTrainStep NaN-cliff class) — isolate in a codec "
+                    f"boundary and waive with the safety invariant")
+
+    @staticmethod
+    def _dtype_arg(call):
+        exprs = list(call.args) + [kw.value for kw in call.keywords]
+        return any(_DTYPEISH.search(core.unparse(e)) for e in exprs)

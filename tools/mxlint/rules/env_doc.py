@@ -1,0 +1,111 @@
+"""env-var-undocumented: every ``MXNET_*`` knob must be in env.describe().
+
+Ancestor gap: six live knobs (``MXNET_TELEMETRY_STEADY_STEPS``,
+``MXNET_PROFILE_RANK``, ``MXNET_PROFILE_DIR``,
+``MXNET_KVSTORE_SPARSE_HOST_BOUND``, ``MXNET_TPU_MODEL_REPO``,
+``MXNET_DROPOUT_RNG``) were read by their subsystems but invisible in
+``mxnet_tpu/env.py`` — the one place users are told to look.  An
+undocumented knob is a support incident: someone sets it, nothing is
+specified to happen.
+
+The rule inventories every ``MXNET_[A-Z0-9_]+`` string literal used in
+an environment access across the project and requires each to appear
+in the ``names`` list inside :func:`mxnet_tpu.env.describe` (and hence,
+via describe's own ``n in __doc__`` check, in the docstring table).
+
+``tests/test_env_vars.py`` locks the same inventory against
+``describe()`` from the other side, so the two can never drift.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .. import core
+from . import Rule
+
+_MXNET_NAME = re.compile(r"^MXNET_[A-Z0-9_]+$")
+
+#: Documented-but-never-read knobs that describe() intentionally carries
+#: (accepted no-ops kept for reference parity). test_env_vars asserts
+#: this is EXACTLY the documented-minus-discovered set.
+DECLARED_NOOPS = frozenset({
+    "MXNET_GPU_MEM_POOL_RESERVE",
+    "MXNET_STORAGE_FALLBACK_LOG_VERBOSE",
+})
+
+ENV_PY = "mxnet_tpu/env.py"
+
+
+def documented_env_vars(repo_root=None):
+    """The ``names`` list literal inside ``env.describe()``, by AST (no
+    import of mxnet_tpu needed — the linter must run anywhere)."""
+    root = repo_root or core.REPO_ROOT
+    path = os.path.join(root, *ENV_PY.split("/"))
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "describe":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "names"
+                        for t in sub.targets) and \
+                        isinstance(sub.value, ast.List):
+                    return {e.value for e in sub.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)}
+    raise RuntimeError(f"could not locate describe()'s names list in {path}")
+
+
+def discovered_env_vars(paths=None, repo_root=None):
+    """``{MXNET_* name: [(relpath, line), ...]}`` for every environment
+    access site in the scanned roots (reads AND writes — a written knob
+    is still part of the configuration surface)."""
+    root = repo_root or core.REPO_ROOT
+    inventory = {}
+    for abspath in core.iter_py_files(paths, repo_root=root):
+        try:
+            ctx = core.load_file(abspath, repo_root=root)
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+        for node, name, _is_read in core.iter_env_accesses(ctx.tree):
+            if name and _MXNET_NAME.match(name):
+                inventory.setdefault(name, []).append(
+                    (ctx.relpath, getattr(node, "lineno", 0)))
+    return inventory
+
+
+class EnvVarUndocumented(Rule):
+    name = "env-var-undocumented"
+    description = ("MXNET_* variable accessed but missing from "
+                   "env.describe()'s documented table")
+
+    def __init__(self, repo_root=None):
+        self._repo_root = repo_root
+        self._sites = []   # (ctx, node, var)
+
+    def check_file(self, ctx):
+        for node, name, _is_read in core.iter_env_accesses(ctx.tree):
+            if name and _MXNET_NAME.match(name):
+                self._sites.append((ctx, node, name))
+        return []
+
+    def finalize(self):
+        try:
+            documented = documented_env_vars(self._repo_root)
+        except (OSError, RuntimeError):
+            documented = set()   # fixture runs without a real env.py
+        seen = set()
+        for ctx, node, name in self._sites:
+            if name in documented:
+                continue
+            key = (ctx.relpath, name)
+            if key in seen:
+                continue   # one finding per (file, var) keeps noise down
+            seen.add(key)
+            yield ctx.finding(
+                self.name, node,
+                f"`{name}` is read here but missing from env.py's "
+                f"describe() table — every MXNET_* knob must be "
+                f"documented in the one place users are told to look")

@@ -1,0 +1,46 @@
+"""env-read-at-trace-time: runtime ``os.environ`` reads outside env.py.
+
+Ancestor bug (PR 3): ``MXNET_DROPOUT_RNG`` was consulted inside traced
+dropout code, so a post-import change could never reach already-jitted
+executables — the read silently returned whatever was baked in at first
+trace.  The same class recurred in ``ops/invoke.py`` with
+``MXNET_ENGINE_DEBUG`` (read per recorded op).
+
+Contract: environment is configuration, and configuration is read at
+import.  ``mxnet_tpu/env.py`` is the sanctioned reader (exempt
+wholesale); elsewhere, module-scope reads (executed at import) are
+fine, while reads inside a function body need either hoisting to a
+module-level constant (the ``_DROPOUT_RNG_IMPL`` convention) or a
+waiver stating why the read is host-side-only and re-read on purpose.
+"""
+from __future__ import annotations
+
+from .. import core
+from . import Rule
+
+#: The sanctioned environment reader — exempt wholesale.
+EXEMPT_FILES = ("mxnet_tpu/env.py",)
+
+
+class EnvReadAtTraceTime(Rule):
+    name = "env-read-at-trace-time"
+    description = ("os.environ read inside a function body (outside env.py):"
+                   " hoist to module scope or waive as host-side-only")
+
+    def check_file(self, ctx):
+        if ctx.relpath in EXEMPT_FILES:
+            return
+        deferred = core.enclosing_function_lines(ctx.tree)
+        for node, name, is_read in core.iter_env_accesses(ctx.tree):
+            if not is_read:
+                continue
+            if getattr(node, "lineno", 0) not in deferred:
+                continue  # module scope: executed once at import
+            what = f"`{name}`" if name else "the environment"
+            yield ctx.finding(
+                self.name, node,
+                f"runtime read of {what}: env reads inside functions can "
+                f"be consulted at trace time and baked into cached "
+                f"executables (the MXNET_DROPOUT_RNG class) — hoist to a "
+                f"module-level constant read at import, or waive with the "
+                f"reason the read is host-side and intentionally repeated")

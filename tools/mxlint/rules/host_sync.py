@@ -1,0 +1,132 @@
+"""host-sync-in-jit: device->host syncs inside traced functions.
+
+Ancestor bug class: the PR 2 retrace watchdog exists because host syncs
+and shape-driven retraces inside jitted code only announce themselves
+as mysterious step-time cliffs at runtime.  The static half: ``.item()``,
+``.asnumpy()``, ``float()/int()/bool()`` coercion, or ``onp.asarray``
+on a traced value inside a function that is jitted, pallas_call-ed, or
+shard_map-ed forces a blocking transfer (or a ConcretizationTypeError)
+every step.
+
+A function counts as *traced* when it is decorated with — or lexically
+passed to — ``jax.jit`` / ``pjit`` / ``pl.pallas_call`` / ``shard_map``
+anywhere in the same module, or when it is the ``forward`` /
+``hybrid_forward`` of a ``HybridBlock`` subclass (the framework jits
+those under ``hybridize()``; plain ``Block`` transforms are host-side
+by design and exempt).  Coercions whose argument is static shape
+arithmetic (``.shape``/``.ndim``/``.size``/``len()``/``.dtype``) are
+host math on Python ints and are not flagged.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .. import core
+from . import Rule
+
+_TRACERS = {"jit", "pjit", "pallas_call", "shard_map"}
+_NP_MODULES = {"onp", "np", "numpy"}
+_NP_CONVERTERS = {"asarray", "array", "ascontiguousarray"}
+_COERCIONS = {"float", "int", "bool", "complex"}
+_STATIC_ARG = re.compile(
+    r"\.shape|\.ndim|\.size\b|\.dtype|\.itemsize|len\(|range\(|"
+    r"\.num_programs|program_id")
+
+
+def _mentions_tracer(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in _TRACERS:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in _TRACERS:
+            return True
+    return False
+
+
+def _is_hybrid_block(cls):
+    """Base list mentions HybridBlock (direct subclass — transitive bases
+    across modules are out of reach for a single-file pass)."""
+    for base in cls.bases:
+        if isinstance(base, ast.Name) and base.id == "HybridBlock":
+            return True
+        if isinstance(base, ast.Attribute) and base.attr == "HybridBlock":
+            return True
+    return False
+
+
+def _collect_traced_names(tree):
+    """Function names decorated with, or passed as arguments to, a
+    jit/pallas_call/shard_map call in this module."""
+    traced = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_mentions_tracer(d) for d in node.decorator_list):
+                traced.add(node.name)
+        elif isinstance(node, ast.Call) and _mentions_tracer(node.func):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    traced.add(arg.id)
+    return traced
+
+
+class HostSyncInJit(Rule):
+    name = "host-sync-in-jit"
+    description = (".item()/float()/onp.asarray on traced values inside "
+                   "jit/pallas_call/shard_map functions (host sync)")
+
+    def check_file(self, ctx):
+        traced = _collect_traced_names(ctx.tree)
+        checked = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in traced:
+                checked.add(id(node))
+                yield from self._check_body(ctx, node)
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef) and _is_hybrid_block(cls):
+                for m in cls.body:
+                    if isinstance(m, ast.FunctionDef) and \
+                            m.name in ("forward", "hybrid_forward") and \
+                            id(m) not in checked:
+                        yield from self._check_body(ctx, m)
+
+    def _check_body(self, ctx, fn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in ("item", "asnumpy") \
+                    and not node.args:
+                yield ctx.finding(
+                    self.name, node,
+                    f"`.{f.attr}()` inside traced `{fn.name}`: forces a "
+                    f"device->host sync (or fails to trace) every step — "
+                    f"keep values on device, or compute outside the jit "
+                    f"boundary")
+            elif isinstance(f, ast.Attribute) and f.attr in _NP_CONVERTERS \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in _NP_MODULES:
+                if node.args and self._static(node.args[0]):
+                    continue
+                yield ctx.finding(
+                    self.name, node,
+                    f"`{core.unparse(f)}(...)` inside traced `{fn.name}`: "
+                    f"materializes a traced value on host (retrace-watchdog "
+                    f"class) — use jnp, or hoist the conversion out of the "
+                    f"traced region")
+            elif isinstance(f, ast.Name) and f.id in _COERCIONS \
+                    and len(node.args) == 1:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) or self._static(arg):
+                    continue
+                yield ctx.finding(
+                    self.name, node,
+                    f"`{f.id}(...)` on a (potentially traced) value inside "
+                    f"traced `{fn.name}`: concretizes the operand — a host "
+                    f"sync at best, ConcretizationTypeError at worst; if "
+                    f"the operand is static (shape math), make that visible "
+                    f"(`.shape`/`len()`), else waive with the reason")
+
+    @staticmethod
+    def _static(arg):
+        return bool(_STATIC_ARG.search(core.unparse(arg)))

@@ -1,0 +1,145 @@
+"""lock-discipline: lock-owning classes must mutate shared state locked.
+
+Ancestor bug (fixed in PR 2): ``profiler.Counter.increment`` did an
+unlocked read-modify-write on ``self._value`` while concurrent serve
+threads incremented it — lost updates, silently wrong metrics.  The
+class HAD a lock; the bug was one mutation path that bypassed it.
+
+Heuristic (tuned for near-zero noise): in any class whose ``__init__``
+creates a ``threading.Lock``/``RLock`` on ``self``, attributes
+initialized in ``__init__`` to a plain counter/container literal
+(int/float, ``[]``, ``{}``, ``set()``, ``dict()``, ``deque()``,
+``defaultdict()``, ``OrderedDict()``, ``Counter()``) are *shared
+state*.  Any read-modify-write of those — augmented assignment,
+subscript store, or a mutating method call (``append``/``add``/
+``update``/``pop``/...) — outside a ``with self.<lock>`` block in a
+method other than ``__init__`` is a finding.  Plain rebinding
+(``self.x = v``) is NOT flagged: it is atomic under the GIL and common
+for benign flags; the lost-update class needs a read first.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter"}
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+             "popitem", "remove", "discard", "clear", "setdefault",
+             "appendleft", "extendleft"}
+
+
+def _ctor_name(call):
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _self_attr(node, names=None):
+    """``self.X`` -> 'X' (optionally restricted to ``names``)."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        if names is None or node.attr in names:
+            return node.attr
+    return None
+
+
+class LockDiscipline(Rule):
+    name = "lock-discipline"
+    description = ("class creates a threading.Lock in __init__ but mutates "
+                   "shared counters/containers outside `with self.<lock>`")
+
+    def check_file(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    def _check_class(self, ctx, cls):
+        init = next((n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == "__init__"), None)
+        if init is None:
+            return
+        locks, guarded = set(), set()
+        for stmt in ast.walk(init):
+            if not (isinstance(stmt, ast.Assign)
+                    and isinstance(stmt.value, ast.Call)
+                    or isinstance(stmt, ast.Assign)):
+                continue
+            for tgt in stmt.targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                v = stmt.value
+                if isinstance(v, ast.Call):
+                    name = _ctor_name(v)
+                    if name in _LOCK_CTORS:
+                        locks.add(attr)
+                    elif name in _CONTAINER_CTORS:
+                        guarded.add(attr)
+                elif isinstance(v, ast.Constant) and \
+                        isinstance(v.value, (int, float)) and \
+                        not isinstance(v.value, bool):
+                    guarded.add(attr)
+                elif isinstance(v, (ast.List, ast.Dict, ast.Set)):
+                    guarded.add(attr)
+        if not locks or not guarded:
+            return
+        for method in cls.body:
+            if isinstance(method, ast.FunctionDef) and \
+                    method.name != "__init__":
+                yield from self._check_method(ctx, cls, method, locks,
+                                              guarded)
+
+    def _check_method(self, ctx, cls, method, locks, guarded):
+        # ancestor stack so we can ask "is this mutation under the lock?"
+        def visit(node, locked):
+            if isinstance(node, ast.With):
+                holds = any(
+                    _self_attr(item.context_expr, locks) for item in node.items)
+                locked = locked or holds
+            mutated = self._mutation(node, guarded)
+            if mutated and not locked:
+                yield ctx.finding(
+                    self.name, node,
+                    f"`self.{mutated}` is mutated outside `with self."
+                    f"{sorted(locks)[0]}` in {cls.name}.{method.name}; the "
+                    f"lock created in __init__ promises shared-state "
+                    f"mutations are serialized (the profiler.Counter "
+                    f"lost-update class)")
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, locked)
+
+        for stmt in method.body:
+            yield from visit(stmt, False)
+
+    @staticmethod
+    def _mutation(node, guarded):
+        """Return the mutated guarded attr name, or None."""
+        if isinstance(node, ast.AugAssign):
+            attr = _self_attr(node.target, guarded)
+            if attr:
+                return attr
+            if isinstance(node.target, ast.Subscript):
+                return _self_attr(node.target.value, guarded)
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    attr = _self_attr(tgt.value, guarded)
+                    if attr:
+                        return attr
+        if isinstance(node, (ast.Delete,)):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    attr = _self_attr(tgt.value, guarded)
+                    if attr:
+                        return attr
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATORS:
+                return _self_attr(node.func.value, guarded)
+        return None

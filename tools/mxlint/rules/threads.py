@@ -1,0 +1,91 @@
+"""daemon-thread-no-shutdown: daemon threads need a paired join path.
+
+Ancestor bug: ``kvstore/tpu_ici.py`` started a daemon heartbeat thread
+per store and ``close()`` only set the stop event — the thread object
+was never retained or joined, so every store constructed in a test
+leaked one thread until interpreter exit (daemon=True just means "don't
+block exit", not "free").
+
+Heuristic: a ``threading.Thread(..., daemon=True)`` construction is a
+finding unless the enclosing class (or module, for free functions)
+also calls ``.join(...)`` somewhere — i.e. there exists *some* shutdown
+path that waits for the thread.  Fire-and-forget threads that are
+genuinely unjoinable (process-lifetime singletons) carry a waiver
+saying so.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+
+
+def _is_thread_ctor(call):
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else None
+    return name == "Thread"
+
+
+def _daemon_true(call):
+    return any(kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+               and kw.value.value is True for kw in call.keywords)
+
+
+def _thread_join(call):
+    """A call that plausibly joins a thread: ``X.join()`` or
+    ``X.join(timeout)`` — not ``", ".join(...)`` / ``os.path.join(...)``."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "join"):
+        return False
+    if isinstance(f.value, ast.Constant):         # "sep".join(...)
+        return False
+    recv = f.value
+    if isinstance(recv, ast.Attribute) and recv.attr == "path":
+        return False                              # os.path.join
+    if isinstance(recv, ast.Name) and recv.id in ("path", "osp", "op"):
+        return False
+    if len(call.args) > 1:
+        return False                              # join(a, b): path-like
+    if call.args and isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, str):
+        return False
+    return True
+
+
+def _has_join(scope):
+    return any(isinstance(n, ast.Call) and _thread_join(n)
+               for n in ast.walk(scope))
+
+
+class DaemonThreadNoShutdown(Rule):
+    name = "daemon-thread-no-shutdown"
+    description = ("threading.Thread(daemon=True) started with no join() "
+                   "anywhere in the owning class/module (leaked per "
+                   "construction)")
+
+    def check_file(self, ctx):
+        # map each Thread(...) ctor to its nearest enclosing class
+        classes = [n for n in ast.walk(ctx.tree)
+                   if isinstance(n, ast.ClassDef)]
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and _is_thread_ctor(node)
+                    and _daemon_true(node)):
+                continue
+            owner = None
+            for cls in classes:
+                if cls.lineno <= node.lineno <= (cls.end_lineno or 0):
+                    if owner is None or cls.lineno > owner.lineno:
+                        owner = cls
+            scope = owner if owner is not None else ctx.tree
+            if _has_join(scope):
+                continue
+            where = f"class {owner.name}" if owner is not None else \
+                "this module"
+            yield ctx.finding(
+                self.name, node,
+                f"daemon thread started but {where} never join()s any "
+                f"thread: each construction leaks a thread until process "
+                f"exit (the tpu_ici heartbeat class) — retain the Thread, "
+                f"signal a stop Event on close, and join(); or waive for a "
+                f"true process-lifetime singleton")
