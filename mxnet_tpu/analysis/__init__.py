@@ -1,0 +1,19 @@
+"""Compiled-artifact capture for static analysis (tools/hloscan).
+
+This package turns the project's *real* entry points — the fused SPMD
+train step, the bucketed kvstore collectives, the flash-attention
+kernels, the serve endpoint's cached executable — into inspectable
+artifacts: jaxpr text, lowered (pre-optimization) HLO, and the
+optimized/scheduled HLO the backend actually runs, each bundled with
+the **contract** that entry point declares (expected collective
+census, dtype policy, sharding promises).
+
+It deliberately knows nothing about rules or findings: the analyzer
+side lives in ``tools/hloscan`` and consumes the plain dict specs
+returned here, so the library keeps zero dependencies on tooling.
+"""
+from .capture import (  # noqa: F401
+    capture_all,
+    capture_one,
+    entrypoint_names,
+)

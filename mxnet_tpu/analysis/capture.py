@@ -1,0 +1,400 @@
+"""Capture jaxprs + HLO of the real entry points, with their contracts.
+
+Every capture function returns a plain dict spec::
+
+    {"name": "allreduce.bucket_dense", "kind": "allreduce",
+     "jaxpr": "...", "lowered": "...", "optimized": "...",
+     "contract": {...}, "meta": {...}}
+
+``lowered`` is the pre-optimization HLO (the user program as written —
+dtype intent lives here), ``optimized`` the compiled, scheduled module
+(collective census, schedule, partitioning live here).  Contracts are
+pinned literals, not derived at capture time wherever possible: a
+contract computed from the same code it checks can never catch a
+regression in that code.  The one exception is the bucketed-step
+census, which is derived from the ``GradBucketer`` *plan* and then
+cross-checked against the pinned PR 4 headline (160 tensors -> 4
+buckets at 1 MB) by ``tests/test_hloscan.py``.
+
+Everything lowers on the virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``), same as tests and the
+driver dryrun — no TPU needed.
+"""
+from __future__ import annotations
+
+import os
+
+_ENTRYPOINTS = {}
+
+#: Bucket cap reproducing the PR 4 headline census on the resnet50
+#: profile (benchmark/COLLECTIVES_ANALYSIS.md: 160 -> 4 at 1 MB).
+BUCKETED_STEP_BUCKET_BYTES = 1 << 20
+
+#: The ResNet-50-like gradient profile (benchmark/allreduce_bench.py).
+RESNET50_PROFILE = [256] * 104 + [1024] * 26 + [16384] * 22 + [65536] * 8
+
+
+def _entrypoint(name):
+    def deco(fn):
+        _ENTRYPOINTS[name] = fn
+        return fn
+    return deco
+
+
+def entrypoint_names():
+    return sorted(_ENTRYPOINTS)
+
+
+def _ensure_virtual_mesh(n=8):
+    """Force the 8-device CPU mesh before the first backend init — the
+    same steering tests/conftest.py applies (env alone is read too late
+    when a site hook pre-imports jax)."""
+    # mxlint: disable=env-read-at-trace-time -- pre-backend-init launcher plumbing: must read current flags each call, never traced
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    # mxlint: disable=env-read-at-trace-time -- same launcher plumbing: respect an explicit platform choice per invocation
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    if jax.local_device_count() < n:
+        raise RuntimeError(
+            f"analysis capture needs >= {n} devices for the dp mesh, got "
+            f"{jax.local_device_count()} — jax initialized before the "
+            f"virtual-mesh flags landed (import mxnet_tpu.analysis "
+            f"earlier, or export XLA_FLAGS/JAX_PLATFORMS as tools/ci.sh "
+            f"does)")
+
+
+def _stage_texts(traced):
+    """(jaxpr, lowered, optimized) texts from a ``jax.stages.Traced``."""
+    lowered = traced.lower()
+    compiled = lowered.compile()
+    return (str(traced.jaxpr),
+            lowered.compiler_ir(dialect="hlo").as_hlo_text(),
+            compiled.as_text())
+
+
+def _capture_jit(jitted, args, name, kind, contract, meta=None):
+    jaxpr, low, opt = _stage_texts(jitted.trace(*args))
+    return {"name": name, "kind": kind, "jaxpr": jaxpr, "lowered": low,
+            "optimized": opt, "contract": contract, "meta": meta or {}}
+
+
+# --------------------------------------------------------------------------
+# fused SPMD train step
+# --------------------------------------------------------------------------
+@_entrypoint("fused_train_step.dp")
+def _capture_fused_train_step():
+    """FusedTrainStep(mesh=dp) on a small MLP: the single donated XLA
+    program a data-parallel training step dispatches.  The captured
+    program is built by FusedTrainStep._prepare itself — identical arg
+    treatment to a live step, not a reconstruction."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import FusedTrainStep, Trainer, loss as gloss, nn
+    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.parallel import mesh as pmesh
+
+    class _NetWithLoss(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d1 = nn.Dense(16, in_units=8)
+            self.d2 = nn.Dense(8, in_units=16)
+            self.loss_fn = gloss.SoftmaxCrossEntropyLoss()
+
+        def forward(self, x, y):
+            return self.loss_fn(self.d2(self.d1(x)), y)
+
+    rng = onp.random.RandomState(7)
+    mod = _NetWithLoss()
+    mod.initialize()
+    tr = Trainer(mod.collect_params(), "sgd",
+                 {"learning_rate": 0.1, "momentum": 0.9})
+    mesh = pmesh.make_mesh({"dp": 8})
+    fused = FusedTrainStep(mod, tr, mesh=mesh)
+    x = mx.np.array(rng.uniform(-1, 1, (16, 8)).astype(onp.float32))
+    y = mx.np.array(rng.randint(0, 8, (16,)), dtype="int32")
+
+    traced = fused.trace(x, y, batch_size=16)
+    jaxpr, low, opt = _stage_texts(traced)
+    # census: one gradient all-reduce per trainable tensor (4: two
+    # weights + two biases; the per-sample loss output stays dp-sharded,
+    # so no extra loss reduction).  Pinned: an issue-order or sharding
+    # regression moves this number, and that is the point (ROADMAP
+    # item 1).
+    return {
+        "name": "fused_train_step.dp", "kind": "train_step",
+        "jaxpr": jaxpr, "lowered": low, "optimized": opt,
+        "contract": {
+            "expect_overlap": True,
+            "resharding_free": True,
+            "expected_collectives": {"all-reduce": 4},
+        },
+        "meta": {"mesh": "dp:8", "params": 4, "batch": 16},
+    }
+
+
+# --------------------------------------------------------------------------
+# kvstore collectives
+# --------------------------------------------------------------------------
+def _ici_devices():
+    import jax
+
+    return tuple(jax.local_devices()[:8])
+
+
+@_entrypoint("allreduce.bucket_dense")
+def _capture_allreduce_dense():
+    """One dense bucket reduce: the `_allreduce_fn` shard_map+psum
+    program the kvstore dispatches per bucket."""
+    import jax.numpy as jnp
+    import numpy as onp
+
+    from mxnet_tpu.kvstore.tpu_ici import _allreduce_fn
+
+    devices = _ici_devices()
+    shape = (16384,)
+    allreduce, sharding, _mesh = _allreduce_fn(
+        devices, shape, onp.dtype(onp.float32))
+    import jax
+    spec = jax.ShapeDtypeStruct((len(devices),) + shape, jnp.float32,
+                                sharding=sharding)
+    return _capture_jit(
+        allreduce, (spec,), "allreduce.bucket_dense", "allreduce",
+        contract={
+            # a bucket reduce IS the collective — exactly one launch, and
+            # nothing for it to overlap with inside its own program
+            "expected_collectives": {"all-reduce": 1},
+            "resharding_free": True,
+        },
+        meta={"shape": list(shape), "dtype": "float32", "devices": 8})
+
+
+@_entrypoint("allreduce.bucket_2bit")
+def _capture_allreduce_2bit():
+    """The compressed bucket reduce: int8 levels ride the ring, each
+    device rescales its own shard — the narrow dtype must SURVIVE into
+    the collective (EQuARX-style), which the dtype census locks."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    from mxnet_tpu.kvstore.tpu_ici import _compressed_allreduce_fn
+
+    devices = _ici_devices()
+    shape = (16384,)
+    allreduce, sharding, _mesh = _compressed_allreduce_fn(
+        devices, shape, onp.dtype(onp.float32), 0.01)
+    spec = jax.ShapeDtypeStruct((len(devices),) + shape, jnp.int8,
+                                sharding=sharding)
+    return _capture_jit(
+        allreduce, (spec,), "allreduce.bucket_2bit", "allreduce",
+        contract={
+            "expected_collectives": {"all-reduce": 1},
+            "resharding_free": True,
+        },
+        meta={"shape": list(shape), "dtype": "int8->float32",
+              "threshold": 0.01, "devices": 8})
+
+
+class _PlanVal:
+    """Shape/dtype stand-in for a gradient copy: exactly what
+    GradBucketer's planner reads (``._data.dtype``, ``.shape``,
+    ``.size``; `_value_devices` sees a non-jax ``.data`` and records
+    host placement), so the REAL planner produces the plan without
+    materializing 3.75 MB of fake gradients."""
+
+    def __init__(self, shape, dtype):
+        import jax
+
+        self._data = jax.ShapeDtypeStruct(tuple(shape), dtype)
+        self.data = self._data
+        self.shape = tuple(shape)
+        self.size = 1
+        for d in shape:
+            self.size *= int(d)
+
+
+def bucketed_step_plan(bucket_bytes=BUCKETED_STEP_BUCKET_BYTES):
+    """The GradBucketer plan for the resnet50 profile: list of bucket
+    capacities (elements).  This is the planner the trainer runs, fed
+    the benchmark's canonical gradient profile."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.kvstore.bucketing import GradBucketer
+
+    items = [(f"g{i}", [_PlanVal((n,), jnp.float32)])
+             for i, n in enumerate(RESNET50_PROFILE)]
+    bucketer = GradBucketer(bucket_bytes=bucket_bytes)
+    plan = bucketer._build_plan(items)
+    return [b.capacity for b in plan]
+
+
+@_entrypoint("allreduce.bucketed_step")
+def _capture_bucketed_step():
+    """One step's worth of bucketed gradient collectives as a single
+    module: the resnet50 profile planned by the real GradBucketer, one
+    shard_map psum per bucket.  launch-count on this artifact is the
+    compiled-side lock on PR 4's 160 -> 4 collapse: if the planner (or
+    a bucketer bypass) changes the bucket count, the census moves and
+    the scan fails."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu._compat import shard_map
+
+    capacities = bucketed_step_plan()
+    devices = tuple(jax.local_devices()[:8])
+    mesh = Mesh(onp.asarray(devices), ("dev",))
+    sharding = NamedSharding(mesh, P("dev"))
+
+    def step(*bufs):
+        return tuple(jax.lax.psum(b, "dev") for b in bufs)
+
+    reduce_all = shard_map(step, mesh,
+                           in_specs=(P("dev"),) * len(capacities),
+                           out_specs=(P("dev"),) * len(capacities))
+    jitted = jax.jit(reduce_all,
+                     in_shardings=(sharding,) * len(capacities),
+                     out_shardings=(sharding,) * len(capacities))
+    specs = tuple(
+        jax.ShapeDtypeStruct((len(devices), cap), jnp.float32,
+                             sharding=sharding)
+        for cap in capacities)
+    return _capture_jit(
+        jitted, specs, "allreduce.bucketed_step", "allreduce",
+        contract={
+            "expected_collectives": {"all-reduce": len(capacities)},
+            "resharding_free": True,
+        },
+        meta={"profile": "resnet50",
+              "n_tensors": len(RESNET50_PROFILE),
+              "n_buckets": len(capacities),
+              "bucket_bytes": BUCKETED_STEP_BUCKET_BYTES,
+              "capacities": capacities})
+
+
+# --------------------------------------------------------------------------
+# flash attention
+# --------------------------------------------------------------------------
+def _flash_fn():
+    import functools
+
+    from mxnet_tpu.ops.pallas_kernels import flash_attention
+
+    # interpret mode: the kernel lowers to plain HLO on CPU — the same
+    # program structure (blocked streaming, masks) without Mosaic
+    return functools.partial(flash_attention, causal=True, interpret=True)
+
+
+def _flash_specs():
+    import jax
+    import jax.numpy as jnp
+
+    shape = (1, 2, 16, 8)   # (B, H, T, D): tiny — capture, not perf
+    return tuple(jax.ShapeDtypeStruct(shape, jnp.bfloat16)
+                 for _ in range(3))
+
+
+@_entrypoint("flash_attention.fwd")
+def _capture_flash_fwd():
+    import jax
+
+    fa = _flash_fn()
+    jitted = jax.jit(lambda q, k, v: fa(q, k, v))
+    return _capture_jit(
+        jitted, _flash_specs(), "flash_attention.fwd", "kernel",
+        contract=_flash_contract(),
+        meta={"shape": [1, 2, 16, 8], "dtype": "bfloat16",
+              "causal": True, "mode": "interpret"})
+
+
+@_entrypoint("flash_attention.bwd")
+def _capture_flash_bwd():
+    import jax
+    import jax.numpy as jnp
+
+    fa = _flash_fn()
+
+    def loss(q, k, v):
+        return jnp.sum(fa(q, k, v).astype(jnp.float32))
+
+    jitted = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return _capture_jit(
+        jitted, _flash_specs(), "flash_attention.bwd", "kernel",
+        contract=_flash_contract(),
+        meta={"shape": [1, 2, 16, 8], "dtype": "bfloat16",
+              "causal": True, "mode": "interpret"})
+
+
+def _flash_contract():
+    return {
+        "dtype_policy": "bf16",
+        "collective_free": True,
+        "resharding_free": True,
+        "waivers": [
+            {"rule": "dtype-cliff",
+             "reason": "flash softmax accumulates scores/log-sum-exp in "
+                       "f32 by design (the kernel's documented numerics: "
+                       "bf16 operands, f32 running max/denominator) — "
+                       "the f32 island is the NaN fence, not a leak"},
+        ],
+    }
+
+
+# --------------------------------------------------------------------------
+# serve endpoint
+# --------------------------------------------------------------------------
+@_entrypoint("serve.endpoint")
+def _capture_serve_endpoint():
+    """The serve Endpoint's cached executable for one bucket: the very
+    program traffic runs through (ExecutableCache.hlo_texts), not a
+    re-lowering.  Single-device serving must stay collective- and
+    host-callback-free."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+
+    net = mx.gluon.nn.Dense(8, in_units=16)
+    net.initialize()
+    ep = mx.serve.Endpoint(net, max_batch_size=4, batch_buckets=[4],
+                           start=False)
+    x = onp.zeros((4, 16), onp.float32)
+    ep._ensure_executable([x])
+    ep._cache.warm([((4, 16), onp.float32)])
+    texts = ep._cache.hlo_texts()
+    sig, opt = sorted(texts.items())[0]
+    return {
+        "name": "serve.endpoint", "kind": "serve",
+        "jaxpr": None, "lowered": None, "optimized": opt,
+        "contract": {
+            "collective_free": True,
+            "resharding_free": True,
+        },
+        "meta": {"signature": sig, "entries": len(texts)},
+    }
+
+
+# --------------------------------------------------------------------------
+# driver API
+# --------------------------------------------------------------------------
+def capture_one(name):
+    _ensure_virtual_mesh()
+    try:
+        fn = _ENTRYPOINTS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown artifact {name!r}; known: {entrypoint_names()}") \
+            from None
+    return fn()
+
+
+def capture_all(names=None):
+    """Capture specs for ``names`` (default: every entry point)."""
+    _ensure_virtual_mesh()
+    names = entrypoint_names() if not names else list(names)
+    return [capture_one(n) for n in names]

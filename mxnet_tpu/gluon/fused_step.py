@@ -195,7 +195,13 @@ class FusedTrainStep:
     def __call__(self, *args, batch_size=1):
         return self.step(*args, batch_size=batch_size)
 
-    def step(self, *args, batch_size=1):
+    def _prepare(self, args, batch_size):
+        """Everything between user args and the jitted call: setup on
+        first use, per-step scalar bundling, mesh placement, treedef
+        interning.  Returns the exact argument tuple ``self._jit`` is
+        invoked with — shared by :meth:`step` and the AOT capture
+        methods (:meth:`trace` / :meth:`lower`), so what hloscan
+        inspects is the very program the step dispatches."""
         if self._plist is None:
             self._setup(args)
         trainer = self._trainer
@@ -263,12 +269,15 @@ class FusedTrainStep:
         else:
             scal = jnp.asarray(scal)
             cnt = jnp.asarray(cnt)
+        return (train_ws, const_pd, states, root, flat, scal, cnt,
+                optimizer.clip_gradient, treedef_id)
 
+    def step(self, *args, batch_size=1):
+        call_args = self._prepare(args, batch_size)
+        trainer, plist = self._trainer, self._plist
         _telemetry.mark_step()
         with _telemetry.step_phase("fused-step"):
-            outs, auxs, new_ws, new_states = self._jit(
-                train_ws, const_pd, states, root, flat, scal, cnt,
-                optimizer.clip_gradient, treedef_id)
+            outs, auxs, new_ws, new_states = self._jit(*call_args)
         _telemetry.watchdog().observe(
             self._jit, name=f"FusedTrainStep[{type(self._block).__name__}]")
 
@@ -285,3 +294,21 @@ class FusedTrainStep:
         ctx = plist[0].list_ctx()[0] if plist else None
         return jax.tree_util.tree_map(
             lambda o: NDArray(o, ctx=ctx), outs)
+
+    # -- AOT capture (mxnet_tpu.analysis / tools.hloscan) ----------------
+    # Same argument prep as step(), so the traced/lowered program is the
+    # one a real step dispatches — not a reconstruction.  Neither method
+    # executes the step: weights and optimizer state are untouched (the
+    # per-step scalar bookkeeping in _prepare does advance update counts,
+    # as a dry trace of one step should).
+
+    def trace(self, *args, batch_size=1):
+        """``jax.stages.Traced`` for one step (``.jaxpr`` for analysis)."""
+        call_args = self._prepare(args, batch_size)  # builds self._jit
+        return self._jit.trace(*call_args)
+
+    def lower(self, *args, batch_size=1):
+        """``jax.stages.Lowered`` for one step — ``.compiler_ir()`` /
+        ``.compile().as_text()`` give hloscan its input texts."""
+        call_args = self._prepare(args, batch_size)  # builds self._jit
+        return self._jit.lower(*call_args)

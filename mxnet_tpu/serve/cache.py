@@ -80,6 +80,20 @@ class ExecutableCache:
         with self._lock:
             return len(self._entries)
 
+    def hlo_texts(self):
+        """Optimized HLO text per cached entry, keyed by a readable
+        ``shape/dtype[,donated]`` signature — the artifact source for
+        ``tools.hloscan``'s serve contract (the scanned program IS the
+        executable traffic runs through, not a re-lowering)."""
+        with self._lock:
+            entries = dict(self._entries)
+        out = {}
+        for (sig, donate), exe in entries.items():
+            name = ";".join(f"{'x'.join(map(str, shp))}:{dt}"
+                            for shp, dt in sig)
+            out[name + (",donated" if donate else "")] = exe.as_text()
+        return out
+
     def __call__(self, arrays, donate=False):
         exe = self.get(arrays, donate=donate)
         return exe(*self._static_args, *arrays)

@@ -1,0 +1,187 @@
+"""Live-lowered TP/clean fixture programs, one pair per hloscan rule.
+
+Each builder compiles a tiny self-contained jax program on the CPU
+backend (the virtual 8-device mesh from ``tests/conftest.py``) and
+wraps the captured stage texts in a :class:`tools.hloscan.core.Artifact`.
+TP programs are minimal reproductions of the defect class the rule
+hunts; the clean twin differs only in the one property under test.
+Builders are cached per process — each program compiles once.
+
+See README.md for why these are generated live rather than pinned.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from tools.hloscan import core
+
+
+def _texts(jitted, avals):
+    traced = jitted.trace(*avals)
+    lowered = traced.lower()
+    return (str(traced.jaxpr),
+            lowered.compiler_ir(dialect="hlo").as_hlo_text(),
+            lowered.compile().as_text())
+
+
+def artifact_from_texts(name, texts, contract=None):
+    jaxpr, low, opt = texts
+    return core.Artifact(name=name, kind="fixture", jaxpr=jaxpr,
+                         lowered=low, optimized=opt,
+                         contract=contract or {})
+
+
+def _artifact(name, jitted, avals, contract=None):
+    return artifact_from_texts(name, _texts(jitted, avals), contract)
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh():
+    devs = jax.devices()[:8]
+    if len(devs) < 8:
+        raise RuntimeError(
+            "hloscan fixtures need the virtual 8-device mesh "
+            "(tests/conftest.py sets --xla_force_host_platform_device_count)")
+    return Mesh(onp.array(devs), ("dp",))
+
+
+def _shardings():
+    mesh = _mesh()
+    return NamedSharding(mesh, P("dp")), NamedSharding(mesh, P())
+
+
+# -- shared programs -------------------------------------------------------
+@functools.lru_cache(maxsize=None)
+def serial_allreduce_texts():
+    """One all-reduce on the critical path, nothing independent of it:
+    every compute op is the collective's producer or consumer."""
+    shard, rep = _shardings()
+    x = jax.ShapeDtypeStruct((16, 8), jnp.float32, sharding=shard)
+    w = jax.ShapeDtypeStruct((8, 8), jnp.float32, sharding=rep)
+
+    def fn(x, w):
+        return jnp.tanh(jnp.dot(x, w)).sum()
+
+    return _texts(jax.jit(fn, out_shardings=rep), (x, w))
+
+
+@functools.lru_cache(maxsize=None)
+def two_tower_texts():
+    """Same all-reduce, plus a replicated tower whose dot is independent
+    of it — the compute an async scheduler can hide the transfer behind."""
+    shard, rep = _shardings()
+    x = jax.ShapeDtypeStruct((16, 8), jnp.float32, sharding=shard)
+    w = jax.ShapeDtypeStruct((8, 8), jnp.float32, sharding=rep)
+    a = jax.ShapeDtypeStruct((8, 8), jnp.float32, sharding=rep)
+    b = jax.ShapeDtypeStruct((8, 8), jnp.float32, sharding=rep)
+
+    def fn(x, w, a, b):
+        loss = jnp.dot(x, w).sum()
+        side = jnp.tanh(jnp.dot(a, b))
+        return loss, side
+
+    return _texts(jax.jit(fn, out_shardings=(rep, rep)), (x, w, a, b))
+
+
+# -- per-rule pairs --------------------------------------------------------
+@functools.lru_cache(maxsize=None)
+def overlap_pair():
+    tp = artifact_from_texts("fixture.overlap_tp", serial_allreduce_texts(),
+                             {"expect_overlap": True})
+    clean = artifact_from_texts("fixture.overlap_clean", two_tower_texts(),
+                                {"expect_overlap": True})
+    return tp, clean, 1
+
+
+def _roundtrip_host(x):
+    return x * 2.0
+
+
+@functools.lru_cache(maxsize=None)
+def host_roundtrip_pair():
+    x = jax.ShapeDtypeStruct((4, 4), jnp.float32)
+
+    def tp_fn(x):
+        y = jax.pure_callback(
+            _roundtrip_host, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return y + 1.0
+
+    def clean_fn(x):
+        return x * 2.0 + 1.0
+
+    tp = _artifact("fixture.host_roundtrip_tp", jax.jit(tp_fn), (x,))
+    clean = _artifact("fixture.host_roundtrip_clean", jax.jit(clean_fn), (x,))
+    return tp, clean, 1
+
+
+@functools.lru_cache(maxsize=None)
+def dtype_cliff_pair():
+    a = jax.ShapeDtypeStruct((8, 8), jnp.bfloat16)
+    b = jax.ShapeDtypeStruct((8, 8), jnp.bfloat16)
+    c = jax.ShapeDtypeStruct((4, 4), jnp.bfloat16)
+
+    def tp_fn(a, b, c):
+        # the cliff: upcast operands make the contraction itself run f32
+        hot = jnp.dot(a.astype(jnp.float32), b.astype(jnp.float32))
+        # plus an undeclared f32 detour that converts straight back
+        detour = (c.astype(jnp.float32) * 2.0).astype(jnp.bfloat16)
+        return hot, detour
+
+    def clean_fn(a, b):
+        # the recipe: bf16 inputs, f32 accumulation via the dot itself
+        acc = jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return acc.astype(jnp.bfloat16)
+
+    contract = {"dtype_policy": "bf16"}
+    tp = _artifact("fixture.dtype_cliff_tp", jax.jit(tp_fn), (a, b, c),
+                   contract)
+    clean = _artifact("fixture.dtype_cliff_clean", jax.jit(clean_fn), (a, b),
+                      contract)
+    return tp, clean, 3   # 2 upcast-dot converts + 1 f32 round-trip
+
+
+@functools.lru_cache(maxsize=None)
+def resharding_pair():
+    shard, rep = _shardings()
+    x = jax.ShapeDtypeStruct((16, 8), jnp.float32, sharding=shard)
+
+    def fn(x):
+        return x * 2.0
+
+    contract = {"resharding_free": True}
+    # replicated output from a sharded input: the partitioner must insert
+    # an all-gather the elementwise math never asked for
+    tp = _artifact("fixture.resharding_tp",
+                   jax.jit(fn, out_shardings=rep), (x,), contract)
+    clean = _artifact("fixture.resharding_clean",
+                      jax.jit(fn, out_shardings=shard), (x,), contract)
+    return tp, clean, 1
+
+
+@functools.lru_cache(maxsize=None)
+def launch_count_pair():
+    texts = serial_allreduce_texts()
+    tp = artifact_from_texts("fixture.launch_count_tp", texts,
+                             {"expected_collectives": {"all-reduce": 4}})
+    clean = artifact_from_texts("fixture.launch_count_clean", texts,
+                                {"expected_collectives": {"all-reduce": 1}})
+    return tp, clean, 1
+
+
+RULE_PAIRS = {
+    "collective-overlap": overlap_pair,
+    "no-host-roundtrip": host_roundtrip_pair,
+    "dtype-cliff": dtype_cliff_pair,
+    "resharding-detector": resharding_pair,
+    "launch-count": launch_count_pair,
+}
+
+
+def pair(rule):
+    """(tp_artifact, clean_artifact, n_expected_tp_findings) for ``rule``."""
+    return RULE_PAIRS[rule]()

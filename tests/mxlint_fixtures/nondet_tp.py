@@ -1,0 +1,30 @@
+"""True positive: host nondeterminism read at trace time (never imported)."""
+import os
+import random
+import time
+
+import jax
+import numpy as onp
+
+from mxnet_tpu.gluon.block import HybridBlock
+
+
+@jax.jit
+def bad_step(x):
+    t0 = time.time()                   # baked at trace: constant timestamp
+    return x * t0
+
+
+def bad_dropout(x):
+    keep = random.random()             # stdlib RNG: one sample, forever
+    noise = onp.random.randn(4)        # numpy global RNG: same
+    return x * keep + noise.sum()
+
+
+bad_dropout_jit = jax.jit(bad_dropout)  # marks bad_dropout as traced
+
+
+class Net(HybridBlock):
+    def forward(self, x):
+        seed = os.urandom(4)           # OS entropy baked into the program
+        return x * len(seed)

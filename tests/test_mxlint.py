@@ -43,6 +43,7 @@ def _unwaived(findings):
     ("host-sync-in-jit", "hostsync_tp.py", "hostsync_clean.py", 3),
     ("bits-as-float", "bits_tp.py", "bits_clean.py", 2),
     ("daemon-thread-no-shutdown", "thread_tp.py", "thread_clean.py", 1),
+    ("nondeterministic-trace", "nondet_tp.py", "nondet_clean.py", 4),
 ])
 def test_rule_fixture_pair(rule, tp, clean, n_expected):
     hits = _unwaived(_lint(tp, rule))
@@ -60,7 +61,7 @@ def test_rule_names_unique_and_documented():
     names = [r.name for r in rules]
     assert len(set(names)) == len(names)
     assert all(r.description for r in rules)
-    assert len(rules) == 6
+    assert len(rules) == 7
 
 
 # -- waivers ---------------------------------------------------------------
@@ -126,7 +127,10 @@ def test_baseline_roundtrip(tmp_path):
     assert "baselined" in out.getvalue()
 
 
-def test_stale_baseline_entries_reported_not_fatal(tmp_path):
+def test_stale_baseline_entries_fail(tmp_path):
+    """A baseline naming findings that no longer exist FAILS the run
+    (ISSUE 7): the debt was paid, so the entry must be pruned in the
+    same change — `--update-baseline` does it and the run goes green."""
     fixture = os.path.join(FIXTURES, "envread_clean.py")
     baseline = str(tmp_path / "baseline.json")
     json.dump({"version": 1, "findings": {
@@ -135,9 +139,31 @@ def test_stale_baseline_entries_reported_not_fatal(tmp_path):
                          "message": "fixed long ago"}}},
               open(baseline, "w"))
     out = io.StringIO()
-    assert driver.run([fixture], baseline_path=baseline, out=out) == 0
-    assert "stale" in out.getvalue()
+    assert driver.run([fixture], baseline_path=baseline, out=out) == 1
+    assert "FAIL" in out.getvalue()
     assert "deadbeef0000" in out.getvalue()
+    # pruning via --update-baseline clears the failure
+    assert driver.run([fixture], baseline_path=baseline,
+                      update_baseline=True, out=io.StringIO()) == 0
+    assert json.load(open(baseline))["findings"] == {}
+    assert driver.run([fixture], baseline_path=baseline,
+                      out=io.StringIO()) == 0
+
+
+def test_stale_baseline_ids_in_json_reporter(tmp_path):
+    fixture = os.path.join(FIXTURES, "envread_clean.py")
+    baseline = str(tmp_path / "baseline.json")
+    json.dump({"version": 1, "findings": {
+        "deadbeef0000": {"rule": "env-read-at-trace-time",
+                         "path": "gone.py", "qualname": "f",
+                         "message": "fixed long ago"}}},
+              open(baseline, "w"))
+    out = io.StringIO()
+    assert driver.run([fixture], baseline_path=baseline, fmt="json",
+                      out=out) == 1
+    payload = json.loads(out.getvalue())
+    assert payload["stale_baseline_ids"] == ["deadbeef0000"]
+    assert payload["summary"]["unbaselined"] == 0
 
 
 # -- JSON reporter schema --------------------------------------------------
@@ -195,7 +221,7 @@ def test_cli_list_rules():
     assert r.returncode == 0
     for name in ("env-read-at-trace-time", "env-var-undocumented",
                  "lock-discipline", "host-sync-in-jit", "bits-as-float",
-                 "daemon-thread-no-shutdown"):
+                 "daemon-thread-no-shutdown", "nondeterministic-trace"):
         assert name in r.stdout
 
 

@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # CI entry (reference: ci/build.py + runtime_functions.sh stages).
-# Stages: lint | import | smoke | test | perf | dryrun | all (default).
+# Stages: lint | import | hloscan | smoke | test | perf | dryrun | all
+# (default: all).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -30,11 +31,23 @@ run_import() {
   fi
   echo "ci: collect-only 0 errors"
 }
+run_hloscan() {
+  # compiled-program contract gate (ISSUE 7): captures the real entry
+  # points (train step on the virtual mesh, bucketed allreduce, flash
+  # attention, serve endpoint) and checks their jaxprs + HLO against the
+  # declared contracts — collective overlap, host round-trips, dtype
+  # cliffs, resharding, launch counts (docs/STATIC_ANALYSIS.md; waive in
+  # the artifact's contract, grandfather with --update-baseline)
+  python -m tools.hloscan --verdicts
+}
 run_smoke()  { bash tools/smoke.sh; }
 run_test()   {
   # masked/dropout flash parity first (ISSUE 3): the kernel tier BERT
   # training rides must fail fast and loud before anything else runs
   python -m pytest tests/test_flash_attention.py -q
+  # the two static-analysis gates' own suites next (ISSUEs 5+7): a
+  # broken checker is worse than no checker
+  python -m pytest tests/test_mxlint.py tests/test_hloscan.py -q
   # telemetry next: the observability layer every later perf PR reads
   # its numbers from fails fast and loud (ISSUE 2)
   python -m pytest tests/test_telemetry.py -q
@@ -54,12 +67,14 @@ run_dryrun() {
 }
 
 case "$stage" in
-  lint)   run_lint ;;
-  import) run_import ;;
-  smoke)  run_smoke ;;
-  test)   run_test ;;
-  perf)   run_perf ;;
-  dryrun) run_dryrun ;;
-  all)    run_lint; run_import; run_smoke; run_test; run_perf; run_dryrun ;;
+  lint)    run_lint ;;
+  import)  run_import ;;
+  hloscan) run_hloscan ;;
+  smoke)   run_smoke ;;
+  test)    run_test ;;
+  perf)    run_perf ;;
+  dryrun)  run_dryrun ;;
+  all)     run_lint; run_import; run_hloscan; run_smoke; run_test
+           run_perf; run_dryrun ;;
   *) echo "unknown stage $stage" >&2; exit 2 ;;
 esac

@@ -1,0 +1,25 @@
+"""hloscan: compiled-program contract checker over jaxprs and HLO.
+
+mxlint (PR 5) gates Python-source bug classes; hloscan gates the claims
+that live in the *compiled* artifact — "communication overlaps
+backward", "no host round-trip inside the step", "the bf16 recipe
+stays bf16", "the sharding doesn't secretly gather", "4 launches, not
+160".  Input is not source text but captured jaxprs and lowered /
+optimized HLO of the project's real entry points (see
+``mxnet_tpu.analysis``), plus per-artifact contracts declaring the
+invariants.
+
+Same conventions as mxlint: stable finding IDs, reasoned waivers (on
+the artifact contract — HLO has no comment lines to waive from), an
+empty checked-in baseline (``tools/hloscan_baseline.json``), text/JSON
+reporters.  One deliberate divergence: stale baseline entries FAIL the
+scan instead of printing a note — see ``driver.run``.
+
+Usage::
+
+    python -m tools.hloscan                  # scan all real entry points
+    python -m tools.hloscan allreduce.bucket_dense --verdicts
+    python -m tools.hloscan --list-rules
+"""
+from .core import Artifact, Finding                      # noqa: F401
+from .driver import run, scan, verdict_lines             # noqa: F401

@@ -1,0 +1,244 @@
+"""hloscan driver: capture, check, waive, baseline, report.
+
+Exit status mirrors mxlint: 0 when every finding is waived or
+baselined AND the baseline is not stale, 1 when an unbaselined finding
+remains or the baseline names findings that no longer exist, 2 on
+usage error.  Stale baseline entries are a *failure* here (not a note):
+a stale entry means a grandfathered debt was paid and the baseline no
+longer reflects reality — prune it in the same change
+(``--update-baseline``) or CI stops.
+
+The default artifact set is the project's real entry points, captured
+live by ``mxnet_tpu.analysis`` (train step on the virtual 8-device
+mesh, bucketed allreduce dense+2bit, flash attention fwd/bwd, the
+serve endpoint executable).  Tests and the dryrun rider pass their own
+``artifacts=`` instead.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import core
+from .rules import all_rules
+
+DEFAULT_BASELINE = os.path.join(core.REPO_ROOT, "tools",
+                                "hloscan_baseline.json")
+
+JSON_SCHEMA_VERSION = 1
+
+
+def scan(artifacts, rules=None):
+    """Run ``rules`` (default: all) over ``artifacts``.  Returns the
+    finding list with waivers applied and IDs assigned, no baseline."""
+    rules = all_rules() if rules is None else rules
+    findings = []
+    for artifact in artifacts:
+        per_artifact = []
+        for rule in rules:
+            per_artifact.extend(rule.check(artifact) or ())
+        findings.extend(core.apply_waivers(per_artifact, artifact))
+    findings.sort(key=lambda f: (f.artifact, f.rule, f.key))
+    core.assign_ids(findings)
+    return findings
+
+
+def default_artifacts(names=None):
+    """Capture the project's real entry points (imports jax; compiles).
+    ``mxnet_tpu.analysis`` returns plain dict specs so the library
+    carries no tooling dependency; the Artifact wrapper lives here."""
+    from mxnet_tpu.analysis import capture_all
+    return [core.Artifact(**spec) for spec in capture_all(names)]
+
+
+def load_baseline(path):
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return data.get("findings", {})
+
+
+def write_baseline(path, findings):
+    """Grandfather every current unwaived finding (``--update-baseline``)."""
+    entries = {
+        f.id: {"rule": f.rule, "artifact": f.artifact, "key": f.key,
+               "message": f.message}
+        for f in findings if not f.waived}
+    payload = {
+        "comment": "hloscan grandfathered findings — entries are debts, not "
+                   "permissions; remove as they are fixed. Stale entries "
+                   "FAIL the scan. Regenerate with "
+                   "`python -m tools.hloscan --update-baseline`.",
+        "version": JSON_SCHEMA_VERSION,
+        "findings": dict(sorted(entries.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return entries
+
+
+def verdict_lines(findings, artifacts, rules=None):
+    """Per-rule ``hloscan <rule> PASS|FAIL`` lines for the dryrun rider —
+    a rule FAILs when any unwaived, unbaselined finding of it exists."""
+    rules = all_rules() if rules is None else rules
+    live = {}
+    for f in findings:
+        if not f.waived and not f.baselined:
+            live.setdefault(f.rule, 0)
+            live[f.rule] += 1
+    n_art = len(list(artifacts))
+    lines = []
+    for rule in rules:
+        n = live.get(rule.name, 0)
+        verdict = "PASS" if not n else f"FAIL ({n})"
+        lines.append(f"hloscan {rule.name:22s} {verdict}  "
+                     f"[{n_art} artifacts]")
+    return lines
+
+
+def publish_metrics(findings):
+    """Mirror the finding census into the telemetry registry (best
+    effort: hloscan must work without mxnet_tpu importable)."""
+    try:
+        from mxnet_tpu import telemetry
+    except Exception:
+        return False
+    g = telemetry.gauge(
+        "mxtpu_hloscan_findings",
+        "hloscan findings by rule and disposition",
+        labelnames=("rule", "disposition"))
+    per = {}
+    for f in findings:
+        disp = "waived" if f.waived else (
+            "baselined" if f.baselined else "live")
+        per[(f.rule, disp)] = per.get((f.rule, disp), 0) + 1
+    for rule in all_rules():
+        for disp in ("live", "waived", "baselined"):
+            g.labels(rule=rule.name, disposition=disp).set(
+                per.get((rule.name, disp), 0))
+    return True
+
+
+def report_text(findings, artifacts, stale_ids, out=sys.stdout):
+    unbaselined = [f for f in findings if not f.waived and not f.baselined]
+    for f in unbaselined:
+        loc = f"{f.artifact}[{f.where}]" if f.where else f.artifact
+        out.write(f"{loc}: [{f.rule}] {f.message}  (id {f.id})\n")
+    n_w = sum(1 for f in findings if f.waived)
+    n_b = sum(1 for f in findings if f.baselined)
+    if stale_ids:
+        out.write(f"hloscan: FAIL — {len(stale_ids)} baseline entr"
+                  f"{'y names a finding' if len(stale_ids) == 1 else 'ies name findings'} "
+                  f"that no longer exist{'s' if len(stale_ids) == 1 else ''}; "
+                  f"prune with --update-baseline: "
+                  f"{', '.join(sorted(stale_ids))}\n")
+    verdict = "clean" if not unbaselined else \
+        f"{len(unbaselined)} unbaselined finding" + \
+        ("s" if len(unbaselined) != 1 else "")
+    out.write(f"hloscan: {verdict} — {len(artifacts)} artifacts, "
+              f"{len(findings)} findings ({n_w} waived, {n_b} baselined)\n")
+
+
+def report_json(findings, artifacts, stale_ids, out=sys.stdout):
+    unbaselined = [f for f in findings if not f.waived and not f.baselined]
+    payload = {
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "hloscan",
+        "artifacts": [a.name for a in artifacts],
+        "findings": [f.to_json() for f in findings],
+        "stale_baseline_ids": sorted(stale_ids),
+        "summary": {
+            "total": len(findings),
+            "waived": sum(1 for f in findings if f.waived),
+            "baselined": sum(1 for f in findings if f.baselined),
+            "unbaselined": len(unbaselined),
+            "stale_baseline": len(stale_ids),
+        },
+    }
+    json.dump(payload, out, indent=2)
+    out.write("\n")
+
+
+def run(artifacts=None, artifact_names=None, baseline_path=None,
+        update_baseline=False, fmt="text", verdicts=False,
+        metrics=True, out=sys.stdout):
+    """Full pipeline; returns the process exit code."""
+    if artifacts is None:
+        artifacts = default_artifacts(artifact_names)
+    artifacts = list(artifacts)
+    findings = scan(artifacts)
+    baseline = {}
+    if baseline_path:
+        baseline = load_baseline(baseline_path)
+        for f in findings:
+            if not f.waived and f.id in baseline:
+                f.baselined = True
+    if update_baseline:
+        if not baseline_path:
+            out.write("hloscan: --update-baseline needs --baseline PATH\n")
+            return 2
+        entries = write_baseline(baseline_path, findings)
+        out.write(f"hloscan: baseline written — {len(entries)} entr"
+                  f"{'y' if len(entries) == 1 else 'ies'} -> "
+                  f"{baseline_path}\n")
+        return 0
+    present = {f.id for f in findings if not f.waived}
+    stale_ids = set(baseline) - present
+    if metrics:
+        publish_metrics(findings)
+    (report_json if fmt == "json" else report_text)(
+        findings, artifacts, stale_ids, out=out)
+    if verdicts:
+        for line in verdict_lines(findings, artifacts):
+            out.write(line + "\n")
+    failed = any(not f.waived and not f.baselined for f in findings)
+    return 1 if (failed or stale_ids) else 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m tools.hloscan",
+        description="Compiled-program contract checker over captured "
+                    "jaxprs and lowered HLO (docs/STATIC_ANALYSIS.md).")
+    p.add_argument("artifacts", nargs="*",
+                   help="artifact names to scan (default: all real entry "
+                        "points; see --list-artifacts)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON of grandfathered finding IDs "
+                        "(default: tools/hloscan_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report everything)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings")
+    p.add_argument("--verdicts", action="store_true",
+                   help="append per-rule PASS/FAIL verdict lines")
+    p.add_argument("--no-metrics", action="store_true",
+                   help="skip publishing the finding census to telemetry")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--list-artifacts", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.name:24s} {rule.description}")
+        return 0
+    if args.list_artifacts:
+        from mxnet_tpu.analysis import entrypoint_names
+        for name in entrypoint_names():
+            print(name)
+        return 0
+
+    return run(artifact_names=args.artifacts or None,
+               baseline_path=None if args.no_baseline else args.baseline,
+               update_baseline=args.update_baseline,
+               fmt=args.format, verdicts=args.verdicts,
+               metrics=not args.no_metrics)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

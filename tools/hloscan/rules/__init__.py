@@ -1,0 +1,34 @@
+"""hloscan rules: one class per compiled-program contract.
+
+A rule reads one :class:`~tools.hloscan.core.Artifact` (jaxpr + lowered
+HLO + optimized HLO + contract) and yields findings where the program
+XLA will actually run breaks the invariant the entry point declared.
+Rules must be deterministic and total: no finding may depend on
+instruction numbering, channel ids, or layout braces (use
+``Artifact.keyed`` / ``hlo.stable_key`` so IDs survive recompiles).
+"""
+from __future__ import annotations
+
+
+class Rule:
+    name = "abstract"
+    description = ""
+
+    def check(self, artifact):
+        """Yield :class:`~tools.hloscan.core.Finding` for ``artifact``."""
+        raise NotImplementedError
+
+
+def all_rules():
+    from .overlap import CollectiveOverlap
+    from .host_roundtrip import NoHostRoundtrip
+    from .dtype_cliff import DtypeCliff
+    from .resharding import ReshardingDetector
+    from .launch_count import LaunchCount
+    return [
+        CollectiveOverlap(),
+        NoHostRoundtrip(),
+        DtypeCliff(),
+        ReshardingDetector(),
+        LaunchCount(),
+    ]

@@ -1,0 +1,88 @@
+"""dtype-cliff: bf16 recipes must not silently climb back to f32.
+
+Ancestor claim (PR 3, the FusedTrainStep NaN cliff): the bf16 recipe's
+whole point is that matmuls *run* in bf16 with f32 accumulation —
+``dot(bf16, bf16) -> f32`` via ``preferred_element_type``.  The cliff's
+compiled-side twin is the *other* way to get f32 out of a dot: a
+``convert(bf16 -> f32)`` feeding the dot's operand, which makes the
+MXU/FMA units compute in full f32 — 2x the flops and bandwidth of the
+recipe the user asked for, indistinguishable from the intended program
+at the Python level (one stray ``.astype`` or dtype-promoting constant
+does it).
+
+Checked on the LOWERED module: that is the user program as written —
+the optimizer is *allowed* to upcast for its own reasons (CPU has no
+bf16 FMA), and flagging its choices would make the rule backend noise.
+Two findings:
+
+* **upcast-dot** — ``convert`` producing f32 from a bf16 value whose
+  consumer is a ``dot``/``convolution``: the contraction itself now
+  runs in f32.
+* **f32-roundtrip** — ``convert`` bf16→f32 whose descendants do real
+  compute and convert back to bf16: a full-precision detour the recipe
+  did not declare.  Intentional f32 islands (softmax accumulation, loss
+  reduction) are declared with a contract waiver stating why.
+
+Only artifacts with ``"dtype_policy": "bf16"`` are checked.
+"""
+from __future__ import annotations
+
+from .. import hlo
+from . import Rule
+
+_CONTRACTIONS = ("dot", "convolution")
+
+
+class DtypeCliff(Rule):
+    name = "dtype-cliff"
+    description = ("f32 convert chains inside bf16 recipes: upcast "
+                   "contractions and undeclared f32 round-trips")
+
+    def check(self, artifact):
+        if artifact.contract.get("dtype_policy") != "bf16":
+            return
+        mod = artifact.module("lowered") or artifact.module("optimized")
+        if mod is None:
+            return
+        ordinals = {}
+        for comp in mod.computations.values():
+            cons = comp.consumers()
+            for instr in comp.instructions:
+                if instr.opcode != "convert":
+                    continue
+                if instr.result_dtypes[:1] != ("f32",):
+                    continue
+                src = comp.by_name.get(instr.operands[0]) \
+                    if instr.operands else None
+                if src is None or "bf16" not in src.result_dtypes[:1]:
+                    continue
+                k = (instr.opcode, instr.clean_shape)
+                n = ordinals.get(k, 0)
+                ordinals[k] = n + 1
+                users = cons.get(instr.name, [])
+                contraction = next(
+                    (u for u in users if u.opcode in _CONTRACTIONS), None)
+                if contraction is not None:
+                    yield artifact.keyed(
+                        self.name, instr, n,
+                        f"bf16->f32 convert feeds `{contraction.opcode}` "
+                        f"{contraction.clean_shape}: the contraction runs "
+                        f"in full f32 — the bf16 recipe wants bf16 inputs "
+                        f"with f32 accumulation (preferred_element_type), "
+                        f"not upcast operands; drop the convert or waive "
+                        f"with the reason this op needs f32 inputs",
+                        where=f"{comp.name}/{instr.name}")
+                    continue
+                desc = comp.descendants(instr, cons)
+                back = any(d.opcode == "convert" and
+                           d.result_dtypes[:1] == ("bf16",) for d in desc)
+                arith = any(hlo.is_compute(d) for d in desc)
+                if back and arith:
+                    yield artifact.keyed(
+                        self.name, instr, n,
+                        f"bf16->f32->compute->bf16 round-trip starting at "
+                        f"`{instr.name}`: an f32 detour the recipe did not "
+                        f"declare (the PR 3 NaN-cliff's silent-upcast "
+                        f"twin) — keep the chain bf16, or waive with the "
+                        f"reason this island accumulates in f32 by design",
+                        where=f"{comp.name}/{instr.name}")

@@ -1,0 +1,56 @@
+"""no-host-roundtrip: step programs never bounce through the host.
+
+Ancestor claim (PR 2 retrace watchdog, PR 5 host-sync-in-jit): the
+Python-side lint catches ``.item()``/``onp.asarray`` in *source*; this
+rule catches what actually survives into the compiled artifact —
+``infeed``/``outfeed``, ``send``/``recv``, and ``custom-call``s whose
+target re-enters the Python process (``xla_python_cpu_callback`` and
+friends from ``jax.pure_callback`` / ``io_callback`` /
+``host_callback``).  Any of these inside a train-step or serve
+executable is a per-step device→host→device round-trip that caps step
+time at host latency no matter how fast the accelerator is.
+
+Checked on the artifact's best module (optimized when captured): a
+callback the optimizer deleted as dead code costs nothing and is not
+flagged.  Artifacts that genuinely want host I/O (a debugging harness)
+opt out with ``"allow_host_roundtrip": true`` plus a waiver-grade
+justification in the contract.
+"""
+from __future__ import annotations
+
+from .. import hlo
+from . import Rule
+
+
+class NoHostRoundtrip(Rule):
+    name = "no-host-roundtrip"
+    description = ("infeed/outfeed/send/recv/host-callback custom-calls "
+                   "inside step or serve programs")
+
+    def check(self, artifact):
+        if artifact.contract.get("allow_host_roundtrip"):
+            return
+        mod = artifact.best_module
+        if mod is None:
+            return
+        ordinals = {}
+        for comp in mod.computations.values():
+            for instr in comp.instructions:
+                if not hlo.is_host_op(instr):
+                    continue
+                k = (instr.opcode, instr.clean_shape)
+                n = ordinals.get(k, 0)
+                ordinals[k] = n + 1
+                if instr.opcode == "custom-call":
+                    what = (f"host-callback custom-call "
+                            f"(target `{instr.custom_call_target}`)")
+                else:
+                    what = f"`{instr.opcode}`"
+                yield artifact.keyed(
+                    self.name, instr, n,
+                    f"{what} in computation `{comp.name}`: a device->host "
+                    f"round-trip inside a step program caps throughput at "
+                    f"host latency — move the host work outside the jit "
+                    f"boundary, or set allow_host_roundtrip with a reasoned "
+                    f"waiver if this artifact is host-interactive by design",
+                    where=f"{comp.name}/{instr.name}")

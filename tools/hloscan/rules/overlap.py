@@ -1,0 +1,74 @@
+"""collective-overlap: gradient collectives must be hideable behind compute.
+
+Ancestor claim (PR 4, locked by ROADMAP item 1): the bucketed allreduce
+path issues each bucket's collective *as soon as its last gradient is
+produced*, so the transfer for bucket k overlaps the backward compute
+of buckets k+1..n.  That claim is only real if the compiled program's
+dependence structure permits it — a collective whose operands transitively
+include (or whose result transitively feeds) *every* compute op cannot be
+hidden by any scheduler on any backend.
+
+Two checking modes (see :func:`tools.hloscan.hlo.overlap_report`):
+
+* ``paired`` — the module already carries ``all-reduce-start``/``-done``
+  (TPU latency-hiding pipeline ran): the rule requires real compute
+  scheduled strictly between start and done.
+* ``modeled`` — collectives are synchronous in HLO (this repo's CPU CI;
+  the async split happens in the thunk runtime below HLO): the rule
+  requires that compute *independent* of the collective exists — the
+  exact set XLA's AsyncCollectiveCreator + LatencyHidingScheduler may
+  move into the start→done window on TPU.
+
+Only artifacts that declare ``"expect_overlap": true`` are checked: a
+standalone allreduce microbenchmark has nothing to overlap with, and
+demanding it would force fake compute into the program.
+"""
+from __future__ import annotations
+
+from .. import hlo
+from . import Rule
+
+
+class CollectiveOverlap(Rule):
+    name = "collective-overlap"
+    description = ("collectives whose dependence structure (or actual "
+                   "schedule) forbids overlap with real compute")
+
+    def check(self, artifact):
+        if not artifact.contract.get("expect_overlap"):
+            return
+        mod = artifact.module("optimized") or artifact.module("lowered")
+        if mod is None or mod.entry is None:
+            yield artifact.finding(
+                self.name, "no-module",
+                "expect_overlap declared but no HLO captured for this "
+                "artifact — capture layer broken")
+            return
+        reports = hlo.overlap_report(mod.entry)
+        if not reports:
+            yield artifact.finding(
+                self.name, "no-collectives",
+                "expect_overlap declared but the entry computation issues "
+                "no collectives — either the contract is stale or the "
+                "collective was traced away (check shardings)")
+            return
+        ordinals = {}
+        for rep in reports:
+            instr = rep["instr"]
+            k = (instr.opcode, instr.clean_shape)
+            n = ordinals.get(k, 0)
+            ordinals[k] = n + 1
+            if rep["compute"]:
+                continue
+            if rep["mode"] == "paired":
+                msg = (f"`{instr.opcode}` pair has NO compute scheduled "
+                       f"between start and done: the latency-hiding "
+                       f"scheduler exposed this collective on the critical "
+                       f"path — check bucket issue order (PR 4 contract)")
+            else:
+                msg = (f"`{instr.opcode}` {instr.clean_shape} has no "
+                       f"compute independent of it in the dependence "
+                       f"graph: every op is its producer or consumer, so "
+                       f"NO schedule on any backend can hide this "
+                       f"collective — it serializes the step")
+            yield artifact.keyed(self.name, instr, n, msg)

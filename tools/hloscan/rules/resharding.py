@@ -1,0 +1,61 @@
+"""resharding-detector: data-movement collectives the shardings did not buy.
+
+Ancestor claim (PR 4 / PAPERS.md pod-scale scaling): a dp gradient step
+needs exactly its ``all-reduce``s — every ``all-gather`` /
+``all-to-all`` / ``collective-permute`` in the module is the SPMD
+partitioner *repairing a sharding mismatch* the user wrote: an output
+sharding that doesn't match the computation's natural layout, a
+``PartitionSpec`` that silently replicates, an operand the partitioner
+must gather to satisfy a dot.  On 8 virtual CPU devices that repair
+costs microseconds; at pod scale the same gather is a full-mesh
+broadcast per step.
+
+The rule is declarative: artifacts that promise
+``"resharding_free": true`` must compile to a module with NO
+data-movement collective; programs whose contract *includes* a gather
+(serving a replicated output from sharded params, say) list the base
+opcodes under ``"allowed_reshard_ops"``.  Reductions (``all-reduce``,
+``reduce-scatter``) are never flagged here — they are the payload, and
+launch-count owns their census.
+
+Checked on the best module (optimized when captured): resharding is
+inserted by the partitioner, so it only exists post-SPMD.
+"""
+from __future__ import annotations
+
+from .. import hlo
+from . import Rule
+
+
+class ReshardingDetector(Rule):
+    name = "resharding-detector"
+    description = ("all-gather/all-to-all/collective-permute not implied "
+                   "by the declared in/out shardings")
+
+    def check(self, artifact):
+        if not artifact.contract.get("resharding_free"):
+            return
+        allowed = set(artifact.contract.get("allowed_reshard_ops", ()))
+        mod = artifact.best_module
+        if mod is None:
+            return
+        ordinals = {}
+        for comp in mod.computations.values():
+            for instr in comp.instructions:
+                if not hlo.is_collective_issue(instr):
+                    continue
+                base = hlo.base_collective(instr.opcode)
+                if base not in hlo.RESHARD_OPS or base in allowed:
+                    continue
+                k = (instr.opcode, instr.clean_shape)
+                n = ordinals.get(k, 0)
+                ordinals[k] = n + 1
+                yield artifact.keyed(
+                    self.name, instr, n,
+                    f"`{base}` {instr.clean_shape} in a resharding_free "
+                    f"program: the partitioner inserted this to repair a "
+                    f"sharding mismatch — audit the PartitionSpecs "
+                    f"(in/out shardings vs the computation's natural "
+                    f"layout); at pod scale this is a per-step full-mesh "
+                    f"transfer",
+                    where=f"{comp.name}/{instr.name}")

@@ -332,6 +332,69 @@ def iter_env_accesses(tree):
             yield node, None, True
 
 
+#: Names that mark a function as traced when used as a decorator or as
+#: the callable a function is lexically passed to.
+TRACERS = {"jit", "pjit", "pallas_call", "shard_map"}
+
+
+def mentions_tracer(node):
+    """``node`` (a decorator or call target) references jit/pjit/
+    pallas_call/shard_map anywhere inside it."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in TRACERS:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in TRACERS:
+            return True
+    return False
+
+
+def is_hybrid_block(cls):
+    """Base list mentions HybridBlock (direct subclass — transitive bases
+    across modules are out of reach for a single-file pass)."""
+    for base in cls.bases:
+        if isinstance(base, ast.Name) and base.id == "HybridBlock":
+            return True
+        if isinstance(base, ast.Attribute) and base.attr == "HybridBlock":
+            return True
+    return False
+
+
+def collect_traced_names(tree):
+    """Function names decorated with, or passed as arguments to, a
+    jit/pallas_call/shard_map call in this module."""
+    traced = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(mentions_tracer(d) for d in node.decorator_list):
+                traced.add(node.name)
+        elif isinstance(node, ast.Call) and mentions_tracer(node.func):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    traced.add(arg.id)
+    return traced
+
+
+def iter_traced_functions(tree):
+    """Yield every function body that is traced in this module: named
+    functions collected by :func:`collect_traced_names` plus
+    ``forward``/``hybrid_forward`` methods of direct HybridBlock
+    subclasses (jitted under ``hybridize()``), each yielded once."""
+    traced = collect_traced_names(tree)
+    seen = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in traced:
+            seen.add(id(node))
+            yield node
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef) and is_hybrid_block(cls):
+            for m in cls.body:
+                if isinstance(m, ast.FunctionDef) and \
+                        m.name in ("forward", "hybrid_forward") and \
+                        id(m) not in seen:
+                    yield m
+
+
 def enclosing_function_lines(tree):
     """Set of line numbers that fall inside any def/lambda body — i.e.
     NOT executed at import time."""

@@ -1,8 +1,11 @@
 """mxlint driver: walk, check, waive, baseline, report.
 
-Exit status: 0 when every finding is waived or baselined, 1 when any
-unbaselined finding remains, 2 on usage error.  ``tools/ci.sh`` runs
-this as a hard gate before anything else.
+Exit status: 0 when every finding is waived or baselined AND the
+baseline is current, 1 when any unbaselined finding remains OR the
+baseline names findings that no longer exist (stale entries are paid
+debts — prune them in the same change via ``--update-baseline``), 2 on
+usage error.  ``tools/ci.sh`` runs this as a hard gate before anything
+else.
 """
 from __future__ import annotations
 
@@ -95,10 +98,11 @@ def report_text(findings, n_files, stale_ids, out=sys.stdout):
     n_w = sum(1 for f in findings if f.waived)
     n_b = sum(1 for f in findings if f.baselined)
     if stale_ids:
-        out.write(f"mxlint: note — {len(stale_ids)} baseline entr"
-                  f"{'y is' if len(stale_ids) == 1 else 'ies are'} stale "
-                  f"(finding fixed): rerun with --update-baseline to "
-                  f"prune: {', '.join(sorted(stale_ids))}\n")
+        out.write(f"mxlint: FAIL — {len(stale_ids)} baseline entr"
+                  f"{'y names a finding' if len(stale_ids) == 1 else 'ies name findings'} "
+                  f"that no longer exist{'s' if len(stale_ids) == 1 else ''} "
+                  f"(debt paid — prune it in the same change with "
+                  f"--update-baseline): {', '.join(sorted(stale_ids))}\n")
     verdict = "clean" if not unbaselined else \
         f"{len(unbaselined)} unbaselined finding" + \
         ("s" if len(unbaselined) != 1 else "")
@@ -148,7 +152,11 @@ def run(paths=None, baseline_path=None, update_baseline=False,
     stale_ids = set(baseline) - present
     (report_json if fmt == "json" else report_text)(
         findings, n_files, stale_ids, out=out)
-    return 1 if any(not f.waived and not f.baselined for f in findings) else 0
+    # stale entries fail too: a baseline that names fixed findings no
+    # longer reflects reality, and letting it drift re-grandfathers the
+    # next regression that happens to hash onto an old id
+    failed = any(not f.waived and not f.baselined for f in findings)
+    return 1 if (failed or stale_ids) else 0
 
 
 def main(argv=None):

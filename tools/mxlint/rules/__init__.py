@@ -30,12 +30,14 @@ def all_rules():
     from .env_trace import EnvReadAtTraceTime
     from .host_sync import HostSyncInJit
     from .locks import LockDiscipline
+    from .nondet_trace import NondeterministicTrace
     from .threads import DaemonThreadNoShutdown
     return [
         EnvReadAtTraceTime(),
         EnvVarUndocumented(),
         LockDiscipline(),
         HostSyncInJit(),
+        NondeterministicTrace(),
         BitsAsFloat(),
         DaemonThreadNoShutdown(),
     ]

@@ -25,7 +25,6 @@ import re
 from .. import core
 from . import Rule
 
-_TRACERS = {"jit", "pjit", "pallas_call", "shard_map"}
 _NP_MODULES = {"onp", "np", "numpy"}
 _NP_CONVERTERS = {"asarray", "array", "ascontiguousarray"}
 _COERCIONS = {"float", "int", "bool", "complex"}
@@ -34,61 +33,14 @@ _STATIC_ARG = re.compile(
     r"\.num_programs|program_id")
 
 
-def _mentions_tracer(node):
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Name) and sub.id in _TRACERS:
-            return True
-        if isinstance(sub, ast.Attribute) and sub.attr in _TRACERS:
-            return True
-    return False
-
-
-def _is_hybrid_block(cls):
-    """Base list mentions HybridBlock (direct subclass — transitive bases
-    across modules are out of reach for a single-file pass)."""
-    for base in cls.bases:
-        if isinstance(base, ast.Name) and base.id == "HybridBlock":
-            return True
-        if isinstance(base, ast.Attribute) and base.attr == "HybridBlock":
-            return True
-    return False
-
-
-def _collect_traced_names(tree):
-    """Function names decorated with, or passed as arguments to, a
-    jit/pallas_call/shard_map call in this module."""
-    traced = set()
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if any(_mentions_tracer(d) for d in node.decorator_list):
-                traced.add(node.name)
-        elif isinstance(node, ast.Call) and _mentions_tracer(node.func):
-            for arg in list(node.args) + [kw.value for kw in node.keywords]:
-                if isinstance(arg, ast.Name):
-                    traced.add(arg.id)
-    return traced
-
-
 class HostSyncInJit(Rule):
     name = "host-sync-in-jit"
     description = (".item()/float()/onp.asarray on traced values inside "
                    "jit/pallas_call/shard_map functions (host sync)")
 
     def check_file(self, ctx):
-        traced = _collect_traced_names(ctx.tree)
-        checked = set()
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and node.name in traced:
-                checked.add(id(node))
-                yield from self._check_body(ctx, node)
-        for cls in ast.walk(ctx.tree):
-            if isinstance(cls, ast.ClassDef) and _is_hybrid_block(cls):
-                for m in cls.body:
-                    if isinstance(m, ast.FunctionDef) and \
-                            m.name in ("forward", "hybrid_forward") and \
-                            id(m) not in checked:
-                        yield from self._check_body(ctx, m)
+        for fn in core.iter_traced_functions(ctx.tree):
+            yield from self._check_body(ctx, fn)
 
     def _check_body(self, ctx, fn):
         for node in ast.walk(fn):

@@ -1,0 +1,86 @@
+"""nondeterministic-trace: wall-clock/OS entropy reads at trace time.
+
+Ancestor bug class: same shape as ``env-read-at-trace-time``, but for
+*values* instead of configuration.  ``time.time()``, stdlib/numpy
+``random.*``, or ``os.urandom`` inside a function that jax traces does
+not sample per step — it executes ONCE, at trace time, and the sampled
+value is baked into the compiled program as a constant.  Every
+subsequent step replays the first step's "random" number; dropout
+becomes a fixed mask, a jittered timeout becomes a constant, and in
+SPMD each process bakes a DIFFERENT constant, so the supposedly
+replicated programs silently diverge (the deadliest form: no error,
+just non-reproducible, cross-process-inconsistent numerics).
+
+A function counts as *traced* exactly as in ``host-sync-in-jit``:
+decorated with or lexically passed to ``jax.jit`` / ``pjit`` /
+``pl.pallas_call`` / ``shard_map``, or the ``forward`` /
+``hybrid_forward`` of a direct ``HybridBlock`` subclass.
+
+The fix is jax's functional RNG (``jax.random`` with an explicit key
+threaded through the program — the ``mx.random`` stream does this) or
+hoisting the host-side sample out of the traced region.  ``jax.random``
+calls are never flagged.  Time reads that are genuinely host-side
+(a traced helper also called eagerly for logging) take a waiver with
+that reason.
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import core
+from . import Rule
+
+#: time-module clock reads (all bake a trace-time timestamp).
+_CLOCKS = {"time", "time_ns", "monotonic", "monotonic_ns",
+           "perf_counter", "perf_counter_ns", "process_time", "clock"}
+
+#: numpy aliases whose ``.random`` attribute is the legacy global RNG.
+_NP_MODULES = {"onp", "np", "numpy"}
+
+
+def _nondet_call(node):
+    """(kind, spelled) when ``node`` is a nondeterministic host call:
+    time.<clock>(), random.<fn>(), onp.random.<fn>(), os.urandom()."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        base = f.value
+        if isinstance(base, ast.Name):
+            if base.id == "time" and f.attr in _CLOCKS:
+                return "wall clock", f"time.{f.attr}()"
+            if base.id == "random":
+                return "stdlib RNG", f"random.{f.attr}()"
+            if base.id == "os" and f.attr == "urandom":
+                return "OS entropy", "os.urandom()"
+        if isinstance(base, ast.Attribute) and base.attr == "random" \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id in _NP_MODULES:
+            return "numpy global RNG", \
+                f"{base.value.id}.random.{f.attr}()"
+    elif isinstance(f, ast.Name) and f.id == "urandom":
+        return "OS entropy", "urandom()"
+    return None
+
+
+class NondeterministicTrace(Rule):
+    name = "nondeterministic-trace"
+    description = ("time.time()/random.*/os.urandom inside traced "
+                   "functions: sampled once at trace, baked as constant")
+
+    def check_file(self, ctx):
+        for fn in core.iter_traced_functions(ctx.tree):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = _nondet_call(node)
+                if hit is None:
+                    continue
+                kind, spelled = hit
+                yield ctx.finding(
+                    self.name, node,
+                    f"`{spelled}` inside traced `{fn.name}`: the {kind} "
+                    f"is read at TRACE time and baked into the compiled "
+                    f"program — every step replays the same value, and "
+                    f"SPMD processes bake different ones (silent "
+                    f"divergence); thread a jax.random key instead, or "
+                    f"hoist the read out of the traced region (waive "
+                    f"with the reason if this helper is host-side-only)")

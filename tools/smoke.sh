@@ -111,8 +111,16 @@ spec.loader.exec_module(m)
 print("smoke: bench import ok")
 EOF
 
-# 4. the driver entry points compile on the virtual mesh
-python -c "
+# 3b. quick compiled-program contract gate (ISSUE 7): the cheap
+# allreduce artifacts only — bucket census + resharding-freedom at the
+# HLO level; the full artifact set runs in ci.sh's hloscan stage
+python -m tools.hloscan allreduce.bucket_dense allreduce.bucket_2bit \
+  allreduce.bucketed_step --verdicts --no-metrics
+echo "smoke: hloscan allreduce contracts ok"
+
+# 4. the driver entry points compile on the virtual mesh (the full
+# hloscan dryrun rider runs in ci.sh's dryrun stage, not here)
+MXTPU_DRYRUN_HLOSCAN=0 python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 print('smoke: dryrun_multichip(8) ok')
