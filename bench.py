@@ -536,6 +536,33 @@ def main():
             result["bert_flash_t2048_mfu"] = row["mfu_vs_197tf_bf16"]
         except Exception as e:
             result["bert_flash_error"] = str(e)[:200]
+    # layer-census rider (ISSUE 8): where the step's FLOPs live, layer by
+    # layer, with roofline bound classes — the top-5 sag summary rides in
+    # the same artifact line so a throughput regression points at a layer,
+    # not just a number.  Subprocess-isolated (the census captures on the
+    # 8-device virtual mesh, which must own backend init); cost-model-only,
+    # so it is cheap and deterministic.  BENCH_CENSUS_TIMEOUT=0 skips it.
+    census_timeout = float(os.environ.get("BENCH_CENSUS_TIMEOUT", "300"))
+    if census_timeout > 0:
+        try:
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       XLA_FLAGS="--xla_force_host_platform_device_count=8")
+            proc = subprocess.run(
+                [sys.executable, "-m", "tools.layerscope",
+                 "--entry", "fused_train_step_dp", "--format", "json",
+                 "--no-artifact", "--no-metrics"],
+                cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                timeout=census_timeout)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"layerscope rc={proc.returncode}: "
+                    f"{proc.stderr.strip()[-160:]}")
+            report = json.loads(proc.stdout)
+            result["layer_census_top_sag"] = \
+                report["entries"][0]["top_sag"]
+        except Exception as e:
+            result["layer_census_error"] = str(e)[:200]
     print(json.dumps(result))
 
 

@@ -246,13 +246,9 @@ def run_census():
             g = jax.jit(jax.grad(attn_loss, argnums=(0, 1, 2)))
             args_ = [jnp.ones((b, t, h, d), jnp.bfloat16)] * 3 + [
                 jax.random.key(0)]
-            ca = g.lower(*args_).compile().cost_analysis()
-            out["rows"].append({
-                "batch": b, "seq": t, "dropout": drop,
-                "flops": ca.get("flops", 0.0),
-                "bytes_accessed": ca.get("bytes accessed", 0.0),
-                "transcendentals": ca.get("transcendentals", 0.0),
-            })
+            from mxnet_tpu.analysis import compiled_cost_summary
+            cs = compiled_cost_summary(g.lower(*args_).compile())
+            out["rows"].append({"batch": b, "seq": t, "dropout": drop, **cs})
     print(json.dumps(out), flush=True)
     return out
 

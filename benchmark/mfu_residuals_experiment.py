@@ -182,16 +182,17 @@ def layer_analysis(variants):
             out, _nm, _nv = bn(x, g, b, 0.9, 1e-5, 1, mm, mv)
             return jnp.sum(out.astype(jnp.float32))
 
+        from mxnet_tpu.analysis import compiled_cost_summary
+
         comp = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(
             x, g, b).compile()
-        ca = comp.cost_analysis()
-        ca = ca[0] if isinstance(ca, list) else ca
+        cs = compiled_cost_summary(comp)
         hlo = comp.as_text()
         rows.append({
             "experiment": "bn_layer_fwd_bwd", "variant": name,
             "shape": [B, C, H, W],
-            "bytes_accessed": ca.get("bytes accessed"),
-            "flops": ca.get("flops"),
+            "bytes_accessed": cs["bytes_accessed"],
+            "flops": cs["flops"],
             "hlo_f32_big_buffers": sum(
                 1 for l in hlo.splitlines()
                 if f"f32[{B},{C}" in l.replace(" ", "")),

@@ -11,9 +11,22 @@ census, dtype policy, sharding promises).
 It deliberately knows nothing about rules or findings: the analyzer
 side lives in ``tools/hloscan`` and consumes the plain dict specs
 returned here, so the library keeps zero dependencies on tooling.
+
+``census`` builds on the same captures for the per-layer
+speed-of-light census (tools/layerscope): per-instruction cost
+modeling over the optimized HLO, name-stack layer bucketing, roofline
+bound classification, and MFU-floor contracts.
 """
 from .capture import (  # noqa: F401
+    build_dp_fused_step,
     capture_all,
     capture_one,
     entrypoint_names,
+)
+from .census import (  # noqa: F401
+    build_census,
+    census_entrypoint_names,
+    census_one,
+    compiled_cost_summary,
+    harvest_cost_analysis,
 )

@@ -84,12 +84,11 @@ def _capture_jit(jitted, args, name, kind, contract, meta=None):
 # --------------------------------------------------------------------------
 # fused SPMD train step
 # --------------------------------------------------------------------------
-@_entrypoint("fused_train_step.dp")
-def _capture_fused_train_step():
-    """FusedTrainStep(mesh=dp) on a small MLP: the single donated XLA
-    program a data-parallel training step dispatches.  The captured
-    program is built by FusedTrainStep._prepare itself — identical arg
-    treatment to a live step, not a reconstruction."""
+def build_dp_fused_step():
+    """The canonical dp-mesh FusedTrainStep (small MLP + loss on the
+    8-device mesh).  Shared by the hloscan capture below and the
+    layerscope census (`analysis/census.py`) so both fence the SAME
+    program.  Returns ``(fused, (x, y), batch_size, meta)``."""
     import numpy as onp
 
     import mxnet_tpu as mx
@@ -116,8 +115,17 @@ def _capture_fused_train_step():
     fused = FusedTrainStep(mod, tr, mesh=mesh)
     x = mx.np.array(rng.uniform(-1, 1, (16, 8)).astype(onp.float32))
     y = mx.np.array(rng.randint(0, 8, (16,)), dtype="int32")
+    return fused, (x, y), 16, {"mesh": "dp:8", "params": 4, "batch": 16}
 
-    traced = fused.trace(x, y, batch_size=16)
+
+@_entrypoint("fused_train_step.dp")
+def _capture_fused_train_step():
+    """FusedTrainStep(mesh=dp) on a small MLP: the single donated XLA
+    program a data-parallel training step dispatches.  The captured
+    program is built by FusedTrainStep._prepare itself — identical arg
+    treatment to a live step, not a reconstruction."""
+    fused, args, batch_size, _meta = build_dp_fused_step()
+    traced = fused.trace(*args, batch_size=batch_size)
     jaxpr, low, opt = _stage_texts(traced)
     # census: one gradient all-reduce per trainable tensor (4: two
     # weights + two biases; the per-sample loss output stays dp-sharded,

@@ -65,6 +65,7 @@ class Block:
         self._reg_params = {}
         self._forward_hooks = []
         self._forward_pre_hooks = []
+        self._scope_name = None
 
     # -- attribute registration (reference `__setattr__`, block.py) -------
     def __setattr__(self, name, value):
@@ -72,6 +73,11 @@ class Block:
             existing = self.__dict__.get("_children")
             if existing is not None:
                 existing[name] = value
+                # the attribute name IS the layer's identity everywhere
+                # else (param structure names, repr); stamp it as the
+                # name-scope too so HLO op metadata matches
+                # `collect_params` naming (tools/layerscope buckets by it)
+                value._scope_name = name
         elif isinstance(value, Parameter):
             existing = self.__dict__.get("_reg_params")
             if existing is not None:
@@ -98,6 +104,16 @@ class Block:
     @property
     def params(self):
         return dict(self._reg_params)
+
+    @property
+    def name(self):
+        """Scope name: the attribute name this block was registered under
+        in its parent (matching its parameter structure-name prefix), or
+        the class name for an unparented root.  This is the component
+        `jax.named_scope` pushes around ``forward`` so compiled-HLO op
+        metadata carries the block hierarchy (see
+        `mxnet_tpu/analysis/census.py`)."""
+        return self._scope_name or type(self).__name__
 
     @property
     def children(self):
@@ -214,11 +230,16 @@ class Block:
         # per-context copy through current_context(), so scope it to the
         # input's context (the reference dispatches kernels by data ctx)
         in_ctx = _first_ctx(args) or _first_ctx(kwargs.values())
-        if in_ctx is not None and in_ctx != current_context():
-            with in_ctx:
+        # name-scope the forward so ops traced inside land in HLO
+        # metadata as "<parent>/<name>/<op>" — the census
+        # (mxnet_tpu/analysis/census.py) buckets compiled cost by these
+        # paths.  Outside a trace this is a thread-local push/pop.
+        with jax.named_scope(self.name):
+            if in_ctx is not None and in_ctx != current_context():
+                with in_ctx:
+                    out = self.forward(*args, **kwargs)
+            else:
                 out = self.forward(*args, **kwargs)
-        else:
-            out = self.forward(*args, **kwargs)
         for hook in self._forward_hooks:
             hook(self, args, out)
         return out
@@ -386,7 +407,8 @@ class HybridBlock(Block):
         # buckets exist to prevent — count it and warn
         from .. import telemetry as _telemetry
         _telemetry.watchdog().observe(
-            jit_fn, name=f"{type(self).__name__}.hybrid_forward")
+            jit_fn, name=f"{type(self).__name__}.hybrid_forward",
+            scope_root=self.name)
         # write deferred aux updates (BatchNorm moving stats) back
         for p, v in zip(self._aux_param_holder, aux_vals):
             if p is not None:
@@ -498,7 +520,7 @@ def _scoped_forward(block, plist, param_datas, key, flat_inputs, treedef,
         training if backward is None else backward)
     try:
         with _param_override_scope(mapping), _rng.key_stream_scope(key), \
-                aux_update_scope() as aux:
+                aux_update_scope() as aux, jax.named_scope(block.name):
             out = block.forward(*args)
     finally:
         set_recording(prev_rec)

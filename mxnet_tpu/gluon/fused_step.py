@@ -176,17 +176,20 @@ class FusedTrainStep:
 
             (_lsum, (outs, auxs)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(train_ws)
-            new_ws, new_states = [], []
-            for j in range(len(train_idx)):
-                g = grads[j].astype(jnp.float32) * rescale
-                if clip is not None:
-                    g = jnp.clip(g, -clip, clip)
-                w = train_ws[j]
-                g = g.astype(w.dtype)
-                nw, nst = optimizer.update_math(
-                    w, g, states[j], lrs[j], wds[j], ts[j])
-                new_ws.append(nw)
-                new_states.append(nst)
+            # the optimizer is a census row of its own: scope the update
+            # math so its HLO cost never pollutes a layer's bucket
+            with jax.named_scope("optimizer"):
+                new_ws, new_states = [], []
+                for j in range(len(train_idx)):
+                    g = grads[j].astype(jnp.float32) * rescale
+                    if clip is not None:
+                        g = jnp.clip(g, -clip, clip)
+                    w = train_ws[j]
+                    g = g.astype(w.dtype)
+                    nw, nst = optimizer.update_math(
+                        w, g, states[j], lrs[j], wds[j], ts[j])
+                    new_ws.append(nw)
+                    new_states.append(nst)
             return outs, auxs, tuple(new_ws), tuple(new_states)
 
         return jax.jit(fused, donate_argnums=(0, 2),
@@ -279,7 +282,8 @@ class FusedTrainStep:
         with _telemetry.step_phase("fused-step"):
             outs, auxs, new_ws, new_states = self._jit(*call_args)
         _telemetry.watchdog().observe(
-            self._jit, name=f"FusedTrainStep[{type(self._block).__name__}]")
+            self._jit, name=f"FusedTrainStep[{type(self._block).__name__}]",
+            scope_root=self._block.name)
 
         for j, k in enumerate(self._train_idx):
             plist[k].data()._rebind(new_ws[j])

@@ -116,11 +116,16 @@ class RetraceWatchdog:
     def retrace_count(self, name):
         return self._retraces.labels(fn=name).value
 
-    def observe(self, fn, name, detail=None):
+    def observe(self, fn, name, detail=None, scope_root=None):
         """Record one completed call of ``fn`` (a ``jax.jit`` callable).
         Compares the trace-cache size against the last call; growth beyond
         the first compile counts as a retrace, and growth after
-        ``steady_after`` calls additionally warns."""
+        ``steady_after`` calls additionally warns.
+
+        ``scope_root`` is the entry point's name-stack root (the Gluon
+        block name whose `jax.named_scope` wraps the traced program) —
+        included in the WARNING so a retrace storm names the layer
+        hierarchy that recompiled, not just a cache size."""
         try:
             size = fn._cache_size()
         except Exception:       # not a PjitFunction (mocks, AOT wrappers)
@@ -145,13 +150,15 @@ class RetraceWatchdog:
             return
         self._retraces.labels(fn=name).inc(size - prev)
         if calls > self.steady_after:
+            extras = "".join(
+                [f" [name-stack root '{scope_root}']" if scope_root else "",
+                 f" [{detail}]" if detail else ""])
             _log.warning(
                 "retrace watchdog: %s recompiled at call %d (trace cache "
                 "%d -> %d)%s — a steady-state recompile usually means an "
                 "input shape/dtype or static argument is drifting "
                 "(unbucketed batch dim?); each one stalls the step for the "
-                "full XLA compile", name, calls, prev, size,
-                f" [{detail}]" if detail else "")
+                "full XLA compile", name, calls, prev, size, extras)
 
     def watch(self, fn, name=None):
         """Wrap a jitted callable so every call is observed.  Note: the

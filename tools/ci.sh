@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI entry (reference: ci/build.py + runtime_functions.sh stages).
-# Stages: lint | import | hloscan | smoke | test | perf | dryrun | all
-# (default: all).
+# Stages: lint | import | hloscan | census | smoke | test | perf | dryrun
+# | all (default: all).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -40,6 +40,14 @@ run_hloscan() {
   # the artifact's contract, grandfather with --update-baseline)
   python -m tools.hloscan --verdicts
 }
+run_census() {
+  # per-layer speed-of-light census gate (ISSUE 8): attributes each
+  # captured entry point's compiled FLOPs/bytes to Gluon layers and
+  # fences them with MFU-floor contracts — cost-model-only on the CPU
+  # mesh (docs/OBSERVABILITY.md "Layer census"; waive on the contract
+  # with a reason, grandfather with --update-baseline)
+  python -m tools.layerscope --verdicts
+}
 run_smoke()  { bash tools/smoke.sh; }
 run_test()   {
   # masked/dropout flash parity first (ISSUE 3): the kernel tier BERT
@@ -70,11 +78,12 @@ case "$stage" in
   lint)    run_lint ;;
   import)  run_import ;;
   hloscan) run_hloscan ;;
+  census)  run_census ;;
   smoke)   run_smoke ;;
   test)    run_test ;;
   perf)    run_perf ;;
   dryrun)  run_dryrun ;;
-  all)     run_lint; run_import; run_hloscan; run_smoke; run_test
-           run_perf; run_dryrun ;;
+  all)     run_lint; run_import; run_hloscan; run_census; run_smoke
+           run_test; run_perf; run_dryrun ;;
   *) echo "unknown stage $stage" >&2; exit 2 ;;
 esac

@@ -118,9 +118,30 @@ python -m tools.hloscan allreduce.bucket_dense allreduce.bucket_2bit \
   allreduce.bucketed_step --verdicts --no-metrics
 echo "smoke: hloscan allreduce contracts ok"
 
+# 3c. layer-census gate (ISSUE 8): the dp FusedTrainStep census artifact
+# must parse and attribute nonzero FLOPs to named Gluon layers — a
+# silently-empty census (name scopes stripped, metadata lost) can never
+# land.  The full contract gate runs in ci.sh's census stage.
+python - <<'EOF'
+import json
+from tools.layerscope import driver as layerscope
+
+docs = layerscope.census_docs(["fused_train_step_dp"])
+path = layerscope.write_artifact(docs[0])
+doc = json.loads(open(path).read())
+assert doc["schema"] == "mxtpu-layer-census-v1", doc.get("schema")
+named = sum(r["flops"] for r in doc["rows"]
+            if r["layer"] != "(unattributed)")
+assert named > 0, "census attributed zero FLOPs to named layers"
+assert doc["attributed_flops_fraction"] >= 0.9, \
+    doc["attributed_flops_fraction"]
+print(f"smoke: layer census ok ({doc['attributed_flops_fraction']:.1%} "
+      f"of {doc['totals']['flops']:.0f} FLOPs attributed)")
+EOF
+
 # 4. the driver entry points compile on the virtual mesh (the full
-# hloscan dryrun rider runs in ci.sh's dryrun stage, not here)
-MXTPU_DRYRUN_HLOSCAN=0 python -c "
+# hloscan + census dryrun riders run in ci.sh's dryrun stage, not here)
+MXTPU_DRYRUN_HLOSCAN=0 MXTPU_DRYRUN_CENSUS=0 python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 print('smoke: dryrun_multichip(8) ok')
