@@ -58,6 +58,7 @@ from . import amp
 from . import profiler
 from . import telemetry
 from . import serve
+from . import resilience
 from .runtime import Features, feature_list
 from . import callback
 from . import model

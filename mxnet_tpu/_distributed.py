@@ -54,8 +54,8 @@ def init_from_env():
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         try:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
-        except Exception:
-            pass  # newer jax: gloo is the default, the flag is gone
+        except Exception:  # mxlint: disable=swallowed-exception -- probing for an older-jax config flag; on newer jax gloo is already the default and the flag is gone
+            pass
 
     try:
         jax.distributed.initialize(coordinator_address=spec[0],

@@ -72,6 +72,20 @@ MXNET_KVSTORE_SPARSE_HOST_BOUND  row-sparse pushpull crossover: below
 MXNET_TPU_MODEL_REPO         colon-separated directories searched for
                              pretrained weight files (no network egress;
                              read at each ``get_model_file`` call)
+MXNET_FAULTLINE              chaos fault plan for ``resilience.faultline``:
+                             inline JSON (list of ``{site, kind, at,
+                             times}`` specs) or ``@/path/to/plan.json``;
+                             read once at the first instrumented-site
+                             arrival, so set it before training starts.
+                             Leave unset outside chaos runs
+MXNET_CHECKPOINT_KEEP        checkpoints retained by
+                             ``resilience.CheckpointManager.prune()``
+                             (default 3; read when a manager is created)
+MXNET_KVSTORE_RETRIES        transient-fault retry budget for KV reads,
+                             per-key pushpull, bucketed collectives, and
+                             the serve model call (default 3 retries =
+                             4 attempts; re-read per retry loop so it can
+                             be tuned mid-run)
 =========================== =================================================
 """
 from __future__ import annotations
@@ -141,5 +155,6 @@ def describe():
              "MXNET_ENGINE_DEBUG", "MXNET_DROPOUT_RNG",
              "MXNET_TELEMETRY_STEADY_STEPS", "MXNET_PROFILE_RANK",
              "MXNET_PROFILE_DIR", "MXNET_KVSTORE_SPARSE_HOST_BOUND",
-             "MXNET_TPU_MODEL_REPO"]
+             "MXNET_TPU_MODEL_REPO", "MXNET_FAULTLINE",
+             "MXNET_CHECKPOINT_KEEP", "MXNET_KVSTORE_RETRIES"]
     return [(n, os.environ.get(n), n in __doc__) for n in names]

@@ -18,6 +18,8 @@ import numpy as onp
 
 from .. import random as _rng
 from .. import telemetry as _telemetry
+from ..resilience import faultline as _faultline
+from ..resilience.policies import step_skip_counter as _step_skip_counter
 from ..ndarray.ndarray import NDArray
 from .block import _TREEDEFS, _intern_treedef, _is_nd, _scoped_forward
 
@@ -52,9 +54,17 @@ class FusedTrainStep:
     """
 
     def __init__(self, block, trainer, mesh=None, partition_rules=None,
-                 data_spec=None):
+                 data_spec=None, scaler=None):
         self._block = block
         self._trainer = trainer
+        # loss scaler (amp): scales the backward seed in-program, and the
+        # step-guard verdict ticks its window.  `amp.init_trainer` attaches
+        # one to the trainer; an explicit `scaler=` overrides.
+        self._scaler = scaler if scaler is not None else \
+            getattr(trainer, "_amp_loss_scaler", None)
+        # finite-grad verdict of the last dispatched step (device scalar;
+        # reading it as bool() syncs).  None until the first step.
+        self.last_step_finite = None
         self._mesh = mesh
         self._rules = partition_rules or []
         if mesh is not None and data_spec is None:
@@ -137,6 +147,8 @@ class FusedTrainStep:
         self._aux_holder = holder
 
         n_opt = len(self._opt_index)
+        idx_by_param = {id(p): k for k, p in enumerate(plist)}
+        tpos = {k: j for j, k in enumerate(train_idx)}
 
         def fused(train_ws, const_pd, states, root_key, flat_inputs, scal,
                   counter, clip, treedef_id):
@@ -152,10 +164,14 @@ class FusedTrainStep:
             # — a float bundle is not a lossless int channel.  The key
             # still folds IN-PROGRAM, so the per-step dispatch saving
             # stands, and the key is identical to host-side new_key().
+            # [lrs(n), wds(n), ts(n), rescale, loss_scale]: loss_scale
+            # multiplies the backward seed (amp f16 — small grads survive
+            # the wire), rescale already divides it back out.
             lrs = scal[:n_opt]
             wds = scal[n_opt:2 * n_opt]
             ts = scal[2 * n_opt:3 * n_opt]
             rescale = scal[3 * n_opt]
+            loss_scale = scal[3 * n_opt + 1]
             key = jax.random.fold_in(root_key, counter[0])
 
             def loss_fn(tws):
@@ -171,26 +187,56 @@ class FusedTrainStep:
                 aux_datas = [v._data if _is_nd(v) else v
                              for _a, v in aux.updates]
                 first = jax.tree_util.tree_leaves(out_datas)[0]
-                return jnp.sum(first.astype(jnp.float32)), \
+                return jnp.sum(first.astype(jnp.float32)) * loss_scale, \
                     (out_datas, aux_datas)
 
             (_lsum, (outs, auxs)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(train_ws)
+            # old values for aux updates (BN running stats), so the
+            # step-guard can hold them too: holder was filled at trace
+            # time by loss_fn, and aux params live in const_pd (or, for
+            # the odd trainable one, in train_ws)
+            aux_old = []
+            for pref in holder:
+                k = idx_by_param.get(id(pref)) if pref is not None else None
+                if k is None:
+                    aux_old.append(None)
+                else:
+                    aux_old.append(train_ws[tpos[k]] if k in tpos
+                                   else const_pd[k])
             # the optimizer is a census row of its own: scope the update
             # math so its HLO cost never pollutes a layer's bucket
             with jax.named_scope("optimizer"):
-                new_ws, new_states = [], []
+                # finite-grad step-guard: one verdict over ALL rescaled
+                # grads, computed BEFORE clipping (clip would launder an
+                # inf into a finite value and hide the overflow).  Pure
+                # elementwise+reduce — adds no collective, so hloscan's
+                # launch-count pin is untouched.  A non-finite step keeps
+                # weights, optimizer state, and aux stats bitwise intact.
+                gs = []
+                finite = jnp.bool_(True)
                 for j in range(len(train_idx)):
                     g = grads[j].astype(jnp.float32) * rescale
+                    finite = jnp.logical_and(
+                        finite, jnp.all(jnp.isfinite(g)))
                     if clip is not None:
                         g = jnp.clip(g, -clip, clip)
+                    gs.append(g)
+                new_ws, new_states = [], []
+                for j in range(len(train_idx)):
                     w = train_ws[j]
-                    g = g.astype(w.dtype)
+                    g = gs[j].astype(w.dtype)
                     nw, nst = optimizer.update_math(
                         w, g, states[j], lrs[j], wds[j], ts[j])
+                    nw = jnp.where(finite, nw, w)
+                    nst = tuple(jnp.where(finite, sn, so)
+                                for sn, so in zip(_as_tuple(nst),
+                                                  states[j]))
                     new_ws.append(nw)
                     new_states.append(nst)
-            return outs, auxs, tuple(new_ws), tuple(new_states)
+                auxs = [jnp.where(finite, v, old) if old is not None else v
+                        for v, old in zip(auxs, aux_old)]
+            return outs, auxs, tuple(new_ws), tuple(new_states), finite
 
         return jax.jit(fused, donate_argnums=(0, 2),
                        static_argnums=(7, 8))
@@ -243,13 +289,26 @@ class FusedTrainStep:
             for i in self._opt_index)
 
         n_opt = len(self._opt_index)
-        scal = onp.empty(3 * n_opt + 1, onp.float32)
+        scal = onp.empty(3 * n_opt + 2, onp.float32)
         for j, i in enumerate(self._opt_index):
             optimizer._update_count(i)
             scal[j] = optimizer._get_lr(i)
             scal[n_opt + j] = optimizer._get_wd(i)
             scal[2 * n_opt + j] = optimizer._index_update_count[i]
-        scal[3 * n_opt] = optimizer.rescale_grad
+        # amp: the backward seed is multiplied by loss_scale in-program;
+        # fold 1/loss_scale into rescale so the update sees true grads
+        loss_scale = float(self._scaler.loss_scale) \
+            if self._scaler is not None else 1.0
+        rescale = optimizer.rescale_grad / loss_scale
+        inject = _faultline.poll("train.grads")
+        if inject == "nan_grad":
+            # poison the rescale factor: every gradient goes NaN and the
+            # in-program step-guard must hold the update
+            rescale = float("nan")
+        elif inject is not None:
+            _faultline.raise_fault("train.grads", inject)
+        scal[3 * n_opt] = rescale
+        scal[3 * n_opt + 1] = loss_scale
         root, counter = _rng.root_and_counter()
         # separate int32 channel — never routed through float bits (the
         # NaN-canonicalization hazard; see _build)
@@ -280,7 +339,7 @@ class FusedTrainStep:
         trainer, plist = self._trainer, self._plist
         _telemetry.mark_step()
         with _telemetry.step_phase("fused-step"):
-            outs, auxs, new_ws, new_states = self._jit(*call_args)
+            outs, auxs, new_ws, new_states, finite = self._jit(*call_args)
         _telemetry.watchdog().observe(
             self._jit, name=f"FusedTrainStep[{type(self._block).__name__}]",
             scope_root=self._block.name)
@@ -294,6 +353,18 @@ class FusedTrainStep:
         for p, v in zip(self._aux_holder, auxs):
             if p is not None:
                 p.data()._rebind(v)
+
+        # the guard verdict stays on device (no sync) unless a scaler is
+        # attached — then one scalar pull per step drives the scale
+        # trajectory and the skip telemetry
+        self.last_step_finite = finite
+        scaler = self._scaler
+        if scaler is not None:
+            ok = bool(finite)
+            if not ok:
+                _step_skip_counter().inc()
+                _faultline.recovered("train.grads", "nan_grad")
+            scaler.update_scale(not ok)
 
         ctx = plist[0].list_ctx()[0] if plist else None
         return jax.tree_util.tree_map(

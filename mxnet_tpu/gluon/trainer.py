@@ -156,8 +156,23 @@ class Trainer:
         _telemetry.mark_step()
         with _telemetry.step_phase("allreduce"):
             self._allreduce_grads()
+        # finite-grad step-guard (eager path): when amp attached a loss
+        # scaler, consult it BEFORE the update — a poisoned step skips
+        # the optimizer entirely (params/states untouched) and only backs
+        # the scale off, mirroring the in-program guard in FusedTrainStep
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None and scaler.has_overflow(
+                [p for p in self._params if p.grad_req != "null"]):
+            from ..resilience import faultline as _faultline
+            from ..resilience.policies import step_skip_counter
+            step_skip_counter().inc()
+            _faultline.recovered("train.grads", "nan_grad")
+            scaler.update_scale(True)
+            return
         with _telemetry.step_phase("optimizer"):
             self._update(ignore_stale_grad)
+        if scaler is not None:
+            scaler.update_scale(False)
 
     def allreduce_grads(self):
         self._init_kvstore()

@@ -101,6 +101,9 @@ class DevicePrefetcher:
 
     # ------------------------------------------------------------------
     def _pull(self):
+        from ..resilience import faultline as _faultline
+
+        _faultline.check("data.iterator")
         src = self._source
         if isinstance(src, DataIter):
             if hasattr(src, "next_arrays"):
@@ -115,21 +118,24 @@ class DevicePrefetcher:
 
     def _feed(self):
         while not self._stop.is_set():
+            # the WHOLE batch production is under one handler — a dtype
+            # cast or device_put that throws must reach the consumer as
+            # the exception, not kill the thread and starve __next__
             try:
                 arrays = self._pull()
+                if self._dtypes is not None:
+                    arrays = tuple(
+                        a if dt is None else onp.asarray(a, dtype=dt)
+                        for a, dt in zip(arrays, self._dtypes))
+                # asynchronous: returns immediately with an in-flight
+                # buffer; the bounded queue caps in-flight transfers
+                bufs = tuple(self._put(a) for a in arrays)
             except StopIteration:
                 self._q.put(_STOP)
                 return
-            except Exception as exc:  # surfaced at the consumer
+            except Exception as exc:  # re-raised at the consumer's __next__
                 self._q.put(exc)
                 return
-            if self._dtypes is not None:
-                arrays = tuple(
-                    a if dt is None else onp.asarray(a, dtype=dt)
-                    for a, dt in zip(arrays, self._dtypes))
-            # asynchronous: returns immediately with an in-flight buffer;
-            # the bounded queue caps how many transfers ride the wire
-            bufs = tuple(self._put(a) for a in arrays)
             while not self._stop.is_set():
                 try:
                     self._q.put(bufs, timeout=0.1)
@@ -206,5 +212,5 @@ class DevicePrefetcher:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # mxlint: disable=swallowed-exception -- interpreter teardown: queue/thread modules may already be unloaded; nothing to report to
             pass

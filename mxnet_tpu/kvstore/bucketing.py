@@ -43,6 +43,7 @@ import numpy as onp
 
 from .. import telemetry as _telemetry
 from ..ndarray.ndarray import NDArray
+from ..resilience.policies import retry_transient as _retry_transient
 from ..telemetry import collective_span as _collective_span
 
 __all__ = ["GradBucketer", "bucketing_enabled", "bucket_bytes",
@@ -177,6 +178,7 @@ class GradBucketer:
             else DEFAULT_QUANTUM_BYTES
         self._plans = {}      # signature -> list[_Bucket]
         self._residuals = {}  # (signature, bucket_idx, copy_idx) -> jax.Array
+        self._pending_residuals = {}  # checkpoint-restored, pre-adoption
         self._inflight = None  # host-CPU platform: last dispatched psum
         # introspection for tests / benchmarks
         self.last_issue_keys = []
@@ -239,10 +241,19 @@ class GradBucketer:
             if compression is not None:
                 payload //= 4  # int8 levels ride the wire, not f32 words
             with _collective_span(op, payload):
-                self._issue_bucket(sig, bidx, b, items, compression)
+                # transient dispatch faults (injected or real deadline
+                # misses) retry with backoff; the faultline arrival is
+                # counted inside _issue_bucket, before any target mutates
+                _retry_transient(
+                    lambda: self._issue_bucket(sig, bidx, b, items,
+                                               compression),
+                    site="collective.dispatch")
             fill.labels(bucket=str(bidx)).set(b.fill_fraction)
 
     def _issue_bucket(self, sig, bidx, b, items, compression):
+        from ..resilience import faultline as _faultline
+
+        _faultline.check("collective.dispatch")
         devs = b.devices
         n = len(items[b.positions[0]][1])
         if len(b.positions) == 1:
@@ -389,7 +400,54 @@ class GradBucketer:
         rkey = (sig, bidx, j)
         res = self._residuals.get(rkey)
         if res is None:
+            res = self._adopt_pending(sig, bidx, j, flat)
+        if res is None:
             res = jnp.zeros_like(flat)
         lvl, res = _quantize_2bit(flat, res, thr)
         self._residuals[rkey] = res
         return lvl
+
+    # -- checkpoint I/O ----------------------------------------------------
+    # Residual keys embed the plan signature, which carries live jax
+    # device objects — meaningless across a restart.  Export maps each
+    # signature to a device-free DIGEST (keys, shapes, dtypes, copy
+    # count); import parks the restored arrays as *pending* until the
+    # next pushpull rebuilds the matching plan, at which point _quantize
+    # adopts them in place of a zero residual.  Error feedback therefore
+    # survives a preemption bit for bit (the quantization error carried
+    # in the residual is owed to the parameters — dropping it would
+    # silently break the compressed path's convergence contract).
+    @staticmethod
+    def _sig_digest(sig):
+        import hashlib
+
+        device_free = tuple(
+            (key, shape, dtype, len(devs))
+            for key, shape, dtype, devs in sig)
+        return hashlib.sha1(repr(device_free).encode()).hexdigest()
+
+    def export_residuals(self):
+        """``{(digest, bucket_idx, copy_idx): host ndarray}`` for every
+        live residual (checkpoint gather)."""
+        out = {}
+        for (sig, bidx, j), res in self._residuals.items():
+            out[(self._sig_digest(sig), bidx, j)] = onp.asarray(res)
+        return out
+
+    def import_residuals(self, entries):
+        """Park checkpoint-restored residuals for adoption at the next
+        pushpull (``entries`` keyed like :meth:`export_residuals`)."""
+        self._pending_residuals = dict(entries)
+
+    def _adopt_pending(self, sig, bidx, j, flat):
+        if not self._pending_residuals:
+            return None
+        pending = self._pending_residuals.pop(
+            (self._sig_digest(sig), bidx, j), None)
+        if pending is None:
+            return None
+        pending = onp.asarray(pending)
+        if pending.shape != tuple(flat.shape) or \
+                onp.dtype(pending.dtype) != onp.dtype(flat.dtype):
+            return None  # topology changed since the checkpoint: drop
+        return jnp.asarray(pending)

@@ -219,7 +219,7 @@ class NDArray:
     def __repr__(self):
         try:
             return f"{onp.asarray(self._data)!s}\n<NDArray {self.shape} @{self._ctx}>"
-        except Exception:
+        except Exception:  # mxlint: disable=swallowed-exception -- repr must never raise; a traced/aborted array falls back to the shape-only form
             return f"<NDArray {self.shape} {self.dtype} @{self._ctx} (traced)>"
 
     # ------------------------------------------------------------------
@@ -841,5 +841,6 @@ def waitall():
             # a deferred execution error (OOM, kernel failure) surfacing at
             # the drain point — the reference rethrows at WaitForAll too
             raise
+        # mxlint: disable=swallowed-exception -- best-effort wait on a backend without the alloc API; real execution errors re-raise above
         except Exception:  # pragma: no cover - backend without alloc
             pass

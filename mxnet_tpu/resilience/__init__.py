@@ -1,0 +1,50 @@
+"""Resilience: deterministic fault injection, elastic checkpoints, recovery.
+
+At pod scale preemptions and transient interconnect faults are the steady
+state, not the exception (MLPerf-pod experience; PAPERS.md arxiv
+1909.09756, 2011.03641).  This package turns every failure the stack can
+already *detect* — heartbeat death (`TPUICIStore.get_dead_nodes`), gradient
+overflow (`amp.LossScaler`), KV/collective timeouts — into a tested
+recovery path:
+
+* :mod:`~mxnet_tpu.resilience.faultline` — a deterministic, seeded
+  fault-injection layer.  A fault plan (``faultline.plan([...])`` or the
+  ``MXNET_FAULTLINE`` env var) names a *site* (``kvstore.pushpull``,
+  ``kvstore.kv``, ``collective.dispatch``, ``serve.model_call``,
+  ``data.iterator``, ``checkpoint.write``, ``train.grads``), a *kind*
+  (``timeout`` / ``error`` / ``preempt`` / ``nan_grad``) and the arrival
+  index at that site.  Hooks at each site consult the plan, so chaos runs
+  are reproducible bit for bit.
+* :mod:`~mxnet_tpu.resilience.checkpoint` — atomic (tmp + fsync + rename +
+  manifest-with-checksum) per-host sharded save/restore of the FULL
+  training state: params, optimizer ``_states`` and update counts,
+  ``LossScaler`` scale, step count, the ``mx.random`` stream, and the 2bit
+  error-feedback residuals (dropping residuals silently corrupts the
+  compressed-allreduce convergence contract).  Async background writer,
+  keep-last-K pruning, fallback to the previous checkpoint on corruption.
+* :mod:`~mxnet_tpu.resilience.policies` — bounded exponential-backoff
+  retry for transient faults, and abort-to-checkpoint when the heartbeat
+  declares a peer dead.
+
+See docs/RESILIENCE.md for the fault model and the recovery matrix.
+"""
+from __future__ import annotations
+
+from . import faultline
+from .checkpoint import (CheckpointCorrupt, CheckpointManager,
+                         gather_training_state, load_checkpoint,
+                         restore_training_state, save_checkpoint)
+from .faultline import (InjectedError, InjectedFault, InjectedPreemption,
+                        InjectedTimeout)
+from .policies import (DeadNodeError, TRANSIENT_EXCEPTIONS,
+                       abort_to_checkpoint, check_peers, retry_transient)
+
+__all__ = [
+    "faultline",
+    "InjectedFault", "InjectedTimeout", "InjectedError", "InjectedPreemption",
+    "CheckpointManager", "CheckpointCorrupt",
+    "save_checkpoint", "load_checkpoint",
+    "gather_training_state", "restore_training_state",
+    "retry_transient", "abort_to_checkpoint", "check_peers",
+    "DeadNodeError", "TRANSIENT_EXCEPTIONS",
+]

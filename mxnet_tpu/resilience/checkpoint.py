@@ -1,0 +1,602 @@
+"""Elastic checkpointing: atomic, per-host sharded, async, self-verifying.
+
+Layout (``<root>`` is the checkpoint directory)::
+
+    <root>/step-0000000042/host-00000/MANIFEST.json
+    <root>/step-0000000042/host-00000/arrays.npz
+
+Each host commits its own shard directory **atomically**: arrays and
+manifest are written into a hidden tmp directory, every file is
+fsync'd, the directory entry is fsync'd, and a single ``os.rename``
+publishes it.  The manifest carries a sha256 per file, so a torn write
+(power loss mid-rename never exposes one, but a corrupted disk block
+can) is *detected* at restore and the previous checkpoint is used
+instead — corruption degrades to "lose one checkpoint interval", never
+to "resume from garbage".
+
+What a training-state checkpoint holds (``gather_training_state``):
+params, optimizer ``_states`` + per-device update counts +
+``num_update``, ``LossScaler`` scale and window position, the
+``mx.random`` stream (root key data + counter), and the 2bit
+error-feedback residuals of both the per-key store and the
+``GradBucketer`` (dropping residuals silently corrupts the compressed
+allreduce's convergence contract — the quantization error they carry is
+*owed* to the parameters).
+
+:class:`CheckpointManager` adds the operational layer: an async
+background writer (the host snapshot is taken synchronously, the disk
+I/O happens off-thread; the worker is joined in ``close()``),
+keep-last-K pruning (``MXNET_CHECKPOINT_KEEP``), ``restore_latest``
+with automatic fallback, and ``mxtpu_checkpoint_*`` telemetry.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import queue
+import threading
+import time
+
+import numpy as onp
+
+from .. import telemetry as _telemetry
+from . import faultline
+
+__all__ = ["CheckpointManager", "CheckpointCorrupt",
+           "save_checkpoint", "load_checkpoint", "latest_step",
+           "list_steps", "gather_training_state", "restore_training_state"]
+
+SCHEMA = "mxtpu-ckpt-v1"
+_ARRAYS = "arrays.npz"
+_MANIFEST = "MANIFEST.json"
+
+# numpy-native dtype names; anything else (bfloat16, fp8) is stored as a
+# same-width unsigned view and restored through the dtype map below
+_NATIVE = frozenset(
+    ["bool", "int8", "int16", "int32", "int64", "uint8", "uint16",
+     "uint32", "uint64", "float16", "float32", "float64",
+     "complex64", "complex128"])
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A shard failed manifest/checksum validation."""
+
+
+def _counter(name, help, labelnames=()):
+    return _telemetry.counter(name, help, labelnames=labelnames)
+
+
+def _saves_counter():
+    return _counter(
+        "mxtpu_checkpoint_saves_total",
+        "Checkpoint shard writes, by outcome (written / failed)",
+        labelnames=("outcome",))
+
+
+def _restores_counter():
+    return _counter(
+        "mxtpu_checkpoint_restores_total",
+        "Checkpoint restore attempts, by outcome (ok / corrupt_fallback "
+        "/ none)",
+        labelnames=("outcome",))
+
+
+def _bytes_counter():
+    return _counter(
+        "mxtpu_checkpoint_bytes_total",
+        "Bytes committed to checkpoint shards (post-encoding, pre-"
+        "compression: the npz payload)")
+
+
+def _last_step_gauge():
+    return _telemetry.gauge(
+        "mxtpu_checkpoint_last_step",
+        "Step number of the most recently committed checkpoint shard")
+
+
+# --------------------------------------------------------------------------
+# dtype encoding: non-native dtypes ride as unsigned views
+# --------------------------------------------------------------------------
+def _nonnative_dtype(name):
+    import jax.numpy as jnp
+    try:
+        return onp.dtype(getattr(jnp, name))
+    except (AttributeError, TypeError):
+        import ml_dtypes
+        return onp.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_arrays(arrays):
+    enc, nonnative = {}, {}
+    for name, a in arrays.items():
+        a = onp.asarray(a)
+        if a.dtype.name not in _NATIVE:
+            nonnative[name] = a.dtype.name
+            width = {1: onp.uint8, 2: onp.uint16, 4: onp.uint32,
+                     8: onp.uint64}[a.dtype.itemsize]
+            a = a.view(width)
+        enc[name] = a
+    return enc, nonnative
+
+
+def _decode_arrays(npz, nonnative):
+    out = {}
+    for name in npz.files:
+        a = npz[name]
+        dt = nonnative.get(name)
+        # mxlint: disable=bits-as-float -- codec boundary: exact inverse of _encode_arrays' unsigned view; same itemsize, bits round-trip verbatim, never enters traced code
+        out[name] = a.view(_nonnative_dtype(dt)) if dt else a
+    return out
+
+
+def _sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path):
+    # directory-entry durability: rename is only durable once the parent
+    # directory's entry is flushed (POSIX leaves it to the fs otherwise)
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platforms without O_RDONLY dirs
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+# --------------------------------------------------------------------------
+# shard-level save / load
+# --------------------------------------------------------------------------
+def _step_dir(root, step):
+    return os.path.join(root, f"step-{int(step):010d}")
+
+
+def _host_dir(root, step, rank):
+    return os.path.join(_step_dir(root, step), f"host-{int(rank):05d}")
+
+
+def save_checkpoint(root, step, arrays, meta=None, rank=None):
+    """Atomically commit one host's shard for ``step``.  Returns the
+    committed shard directory path."""
+    import jax
+
+    if rank is None:
+        rank = jax.process_index()
+    faultline.check("checkpoint.write")
+    t0 = time.monotonic()
+    final = _host_dir(root, step, rank)
+    step_parent = os.path.dirname(final)
+    os.makedirs(step_parent, exist_ok=True)
+    tmp = os.path.join(
+        root, f".tmp-step-{int(step):010d}-host-{rank:05d}-{os.getpid()}")
+    try:
+        os.makedirs(tmp, exist_ok=True)
+        enc, nonnative = _encode_arrays(arrays)
+        arr_path = os.path.join(tmp, _ARRAYS)
+        with open(arr_path, "wb") as f:
+            onp.savez(f, **enc)
+            f.flush()
+            os.fsync(f.fileno())
+        manifest = {
+            "schema": SCHEMA,
+            "step": int(step),
+            "rank": int(rank),
+            "world": int(jax.process_count()),
+            "saved_unix": time.time(),
+            "nonnative_dtypes": nonnative,
+            "files": {_ARRAYS: {"sha256": _sha256(arr_path),
+                                "bytes": os.path.getsize(arr_path)}},
+            "meta": meta or {},
+        }
+        man_path = os.path.join(tmp, _MANIFEST)
+        with open(man_path, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp)
+        if os.path.isdir(final):  # re-save of the same step: replace
+            import shutil
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        _fsync_dir(step_parent)
+    except BaseException:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    _bytes_counter().inc(manifest["files"][_ARRAYS]["bytes"])
+    _last_step_gauge().set(int(step))
+    _telemetry.histogram(
+        "mxtpu_checkpoint_save_seconds",
+        "Wall time of one shard commit (encode + write + fsync + rename)"
+    ).observe(time.monotonic() - t0)
+    return final
+
+
+def _validate_shard(host_dir):
+    man_path = os.path.join(host_dir, _MANIFEST)
+    if not os.path.isfile(man_path):
+        raise CheckpointCorrupt(f"{host_dir}: no manifest")
+    try:
+        with open(man_path, "r", encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (ValueError, OSError) as e:
+        raise CheckpointCorrupt(f"{host_dir}: unreadable manifest: {e}")
+    if manifest.get("schema") != SCHEMA:
+        raise CheckpointCorrupt(
+            f"{host_dir}: schema {manifest.get('schema')!r} != {SCHEMA!r}")
+    for fname, info in manifest.get("files", {}).items():
+        fpath = os.path.join(host_dir, fname)
+        if not os.path.isfile(fpath):
+            raise CheckpointCorrupt(f"{host_dir}: missing {fname}")
+        digest = _sha256(fpath)
+        if digest != info.get("sha256"):
+            raise CheckpointCorrupt(
+                f"{host_dir}: {fname} checksum mismatch "
+                f"({digest[:12]} != {info.get('sha256', '')[:12]})")
+    return manifest
+
+
+def load_checkpoint(root, step=None, rank=None):
+    """Load one host's shard (validating checksums).  ``step=None`` loads
+    the newest step present.  Returns ``(step, arrays, meta)``.  Raises
+    :class:`CheckpointCorrupt` on validation failure, ``FileNotFoundError``
+    when nothing exists."""
+    import jax
+
+    if rank is None:
+        rank = jax.process_index()
+    if step is None:
+        steps = list_steps(root)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {root}")
+        step = steps[-1]
+    host_dir = _host_dir(root, step, rank)
+    manifest = _validate_shard(host_dir)
+    with onp.load(os.path.join(host_dir, _ARRAYS),
+                  allow_pickle=False) as npz:
+        arrays = _decode_arrays(npz, manifest.get("nonnative_dtypes", {}))
+    return int(manifest["step"]), arrays, manifest.get("meta", {})
+
+
+def list_steps(root):
+    """Committed step numbers, ascending (a step counts once any host
+    shard directory exists for it)."""
+    if not os.path.isdir(root):
+        return []
+    steps = []
+    for name in os.listdir(root):
+        if name.startswith("step-"):
+            try:
+                steps.append(int(name[len("step-"):]))
+            except ValueError:
+                continue
+    return sorted(steps)
+
+
+def latest_step(root):
+    steps = list_steps(root)
+    return steps[-1] if steps else None
+
+
+# --------------------------------------------------------------------------
+# training-state gather / restore
+# --------------------------------------------------------------------------
+def _as_tuple(x):
+    if x is None:
+        return ()
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,)
+
+
+def gather_training_state(trainer, step, scaler=None, include_rng=True):
+    """Snapshot the FULL training state to host numpy: ``(arrays, meta)``
+    ready for :func:`save_checkpoint`.  Must be called between steps (no
+    step in flight — donated buffers are rebound by then)."""
+    from .. import random as _rng
+
+    trainer._init_states()
+    arrays, meta = {}, {"step": int(step)}
+    # -- params (multi-device copies are kept in sync by the allreduce;
+    # shard 0 of each is the canonical value, exactly like save_states)
+    names = []
+    for i, p in enumerate(trainer._params):
+        names.append(p.name)
+        arrays[f"param/{i}"] = onp.asarray(p.list_data()[0]._data)
+    meta["param_names"] = names
+    # -- optimizer: per-param state tuples (+ one list entry per device
+    # copy), update counts per device, global num_update
+    opt = trainer._optimizer
+    opt_multi = {}
+    for i, entry in (trainer._states or {}).items():
+        if isinstance(entry, list):
+            opt_multi[str(i)] = len(entry)
+            for c, st in enumerate(entry):
+                for j, s in enumerate(_as_tuple(st)):
+                    arrays[f"opt/{i}/{c}/{j}"] = onp.asarray(s._data)
+        else:
+            opt_multi[str(i)] = 0  # single-device: no copy axis
+            for j, s in enumerate(_as_tuple(entry)):
+                arrays[f"opt/{i}/{j}"] = onp.asarray(s._data)
+    meta["opt_multi"] = opt_multi
+    meta["opt_update_counts"] = {
+        str(dev): {str(i): int(t) for i, t in counts.items()}
+        for dev, counts in opt._all_index_update_counts.items()}
+    meta["opt_num_update"] = int(opt.num_update)
+    # -- loss scaler
+    if scaler is not None:
+        meta["scaler"] = {"loss_scale": float(scaler.loss_scale),
+                          "unskipped": int(scaler._unskipped)}
+    # -- mx.random stream: root key data + counter reproduce every future
+    # new_key()/fold_in exactly
+    if include_rng:
+        import jax
+
+        arrays["rng/root"] = onp.asarray(
+            jax.random.key_data(_rng._state.root))
+        meta["rng_counter"] = int(_rng._state.counter)
+    # -- 2bit error-feedback residuals (owed to the params; see module
+    # docstring).  Store-level residuals are keyed (param_idx, copy).
+    store = trainer._kvstore
+    if store is not None and getattr(store, "_residuals", None):
+        for (key, c), res in store._residuals.items():
+            if isinstance(key, int):  # Trainer keys params by index
+                arrays[f"kvres/{key}/{c}"] = onp.asarray(res)
+    bucketer = getattr(store, "_bucketer", None) if store is not None \
+        else None
+    if bucketer is not None:
+        exported = bucketer.export_residuals()
+        meta["bucket_residuals"] = []
+        for n, ((digest, bidx, c), res) in enumerate(exported.items()):
+            arrays[f"bucketres/{n}"] = res
+            meta["bucket_residuals"].append(
+                {"digest": digest, "bucket": int(bidx), "copy": int(c),
+                 "index": n})
+    return arrays, meta
+
+
+def restore_training_state(arrays, meta, trainer, scaler=None):
+    """Inverse of :func:`gather_training_state`: rebind params, optimizer
+    states and counts, scaler, RNG stream, and residuals — bitwise.
+    Returns the checkpointed step number."""
+    import jax
+
+    from .. import random as _rng
+
+    trainer._init_states()
+    for i, p in enumerate(trainer._params):
+        a = arrays.get(f"param/{i}")
+        if a is None:
+            continue
+        for w in p.list_data():
+            dev = (list(w._data.devices())[0]
+                   if isinstance(w._data, jax.Array) else None)
+            w._rebind(jax.device_put(a, dev))
+    opt = trainer._optimizer
+    opt_multi = meta.get("opt_multi", {})
+    for i, entry in (trainer._states or {}).items():
+        ncopies = opt_multi.get(str(i))
+        if ncopies is None:
+            continue
+        if isinstance(entry, list):
+            for c, st in enumerate(entry):
+                src_c = c if ncopies else None
+                for j, s in enumerate(_as_tuple(st)):
+                    key = (f"opt/{i}/{src_c}/{j}" if src_c is not None
+                           else f"opt/{i}/{j}")
+                    if key in arrays:
+                        s._rebind(jax.device_put(
+                            arrays[key], _nd_device(s)))
+        else:
+            for j, s in enumerate(_as_tuple(entry)):
+                key = f"opt/{i}/0/{j}" if ncopies else f"opt/{i}/{j}"
+                if key in arrays:
+                    s._rebind(jax.device_put(arrays[key], _nd_device(s)))
+    counts = meta.get("opt_update_counts")
+    if counts is not None:
+        opt._all_index_update_counts = {
+            int(dev): {int(i): int(t) for i, t in c.items()}
+            for dev, c in counts.items()}
+        if 0 not in opt._all_index_update_counts:
+            opt._all_index_update_counts[0] = {}
+        opt._index_update_count = opt._all_index_update_counts[0]
+        opt.num_update = int(meta.get("opt_num_update", opt.num_update))
+    sc = meta.get("scaler")
+    if scaler is not None and sc is not None:
+        scaler.loss_scale = sc["loss_scale"]
+        scaler._unskipped = sc["unskipped"]
+    if "rng/root" in arrays:
+        _rng._state.root = jax.random.wrap_key_data(
+            onp.asarray(arrays["rng/root"]))
+        _rng._state.counter = int(meta.get("rng_counter", 0))
+    store = trainer._kvstore
+    if store is not None and hasattr(store, "_residuals"):
+        import jax.numpy as jnp
+
+        for name, a in arrays.items():
+            if name.startswith("kvres/"):
+                # uncommitted jnp arrays: `_residual_matches` only gates
+                # on shape/dtype for these, so the next compressed reduce
+                # adopts them wherever the copies live
+                _, key, c = name.split("/")
+                store._residuals[(int(key), int(c))] = jnp.asarray(a)
+    bucketer = getattr(store, "_bucketer", None) if store is not None \
+        else None
+    pending = meta.get("bucket_residuals")
+    if bucketer is not None and pending:
+        bucketer.import_residuals({
+            (e["digest"], e["bucket"], e["copy"]):
+                arrays[f"bucketres/{e['index']}"]
+            for e in pending})
+    return int(meta.get("step", 0))
+
+
+def _nd_device(nd):
+    import jax
+
+    return (list(nd._data.devices())[0]
+            if isinstance(nd._data, jax.Array) else None)
+
+
+# --------------------------------------------------------------------------
+# the manager: async writer, pruning, fallback restore
+# --------------------------------------------------------------------------
+class CheckpointManager:
+    """Operational wrapper around the shard writer.
+
+    >>> mgr = CheckpointManager("/ckpt", keep=3)
+    >>> mgr.save(step, *resilience.gather_training_state(trainer, step))
+    >>> ...
+    >>> restored = mgr.restore_latest()   # (step, arrays, meta) or None
+    >>> mgr.close()
+
+    ``async_write=True`` (default) moves the disk I/O to a background
+    worker; the host-side state snapshot happens in the CALLER
+    (``gather_training_state``), so by enqueue time nothing references
+    live device buffers and the training loop may immediately dispatch
+    the next step.  The worker is a daemon thread with an explicit join
+    path (``close()``/``wait()``); a write failure is re-raised at the
+    next ``save()``/``wait()``/``close()`` call, never swallowed.
+    """
+
+    def __init__(self, root, keep=None, async_write=True, rank=None):
+        import jax
+
+        self.root = str(root)
+        if keep is None:
+            # mxlint: disable=env-read-at-trace-time -- host-side read at manager construction; sizes the pruning window only
+            keep = int(os.environ.get("MXNET_CHECKPOINT_KEEP", "3"))
+        self.keep = max(1, int(keep))
+        self._rank = jax.process_index() if rank is None else int(rank)
+        self._async = bool(async_write)
+        self._q = None
+        self._worker = None
+        self._stop = threading.Event()
+        self._error = None
+        self._lock = threading.Lock()
+
+    # -- async plumbing ---------------------------------------------------
+    def _ensure_worker(self):
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._q = queue.Queue()
+        self._stop = threading.Event()
+        t = threading.Thread(target=self._drain, daemon=True,
+                             name="mxtpu-ckpt-writer")
+        t.start()
+        self._worker = t
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            step, arrays, meta = item
+            try:
+                self._commit(step, arrays, meta)
+            except BaseException as e:  # re-raised at the next call
+                with self._lock:
+                    self._error = e
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self):
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    # -- API --------------------------------------------------------------
+    def save(self, step, arrays, meta=None):
+        """Commit one shard (async by default).  ``arrays`` must already
+        be host numpy (gather_training_state guarantees that)."""
+        self._raise_pending()
+        if not self._async:
+            self._commit(step, arrays, meta)
+            return
+        self._ensure_worker()
+        self._q.put((int(step), arrays, meta))
+
+    def _commit(self, step, arrays, meta):
+        try:
+            save_checkpoint(self.root, step, arrays, meta, rank=self._rank)
+        except BaseException:
+            _saves_counter().labels(outcome="failed").inc()
+            raise
+        _saves_counter().labels(outcome="written").inc()
+        self.prune()
+
+    def wait(self):
+        """Block until every queued write is on disk; re-raise the first
+        writer error if one occurred."""
+        if self._q is not None:
+            self._q.join()
+        self._raise_pending()
+
+    def close(self):
+        """Flush pending writes and reap the worker thread."""
+        if self._worker is not None:
+            self._q.join()
+            self._q.put(None)  # wake + exit
+            self._worker.join(timeout=30)
+            self._worker = None
+        self._raise_pending()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_exc):
+        self.close()
+
+    def prune(self):
+        """Keep the newest ``keep`` steps, delete the rest (and any
+        leftover tmp dirs from crashed writers)."""
+        import shutil
+
+        steps = list_steps(self.root)
+        for s in steps[:-self.keep] if len(steps) > self.keep else []:
+            shutil.rmtree(_step_dir(self.root, s), ignore_errors=True)
+        if os.path.isdir(self.root):
+            for name in os.listdir(self.root):
+                if name.startswith(".tmp-"):
+                    shutil.rmtree(os.path.join(self.root, name),
+                                  ignore_errors=True)
+
+    def restore_latest(self):
+        """Newest valid shard for this rank: ``(step, arrays, meta)``.
+        A corrupt shard is logged, counted, and skipped — restore falls
+        back to the previous checkpoint; ``None`` when nothing valid
+        exists."""
+        import logging
+
+        for step in reversed(list_steps(self.root)):
+            try:
+                out = load_checkpoint(self.root, step, rank=self._rank)
+            except CheckpointCorrupt as e:
+                _restores_counter().labels(outcome="corrupt_fallback").inc()
+                logging.getLogger(__name__).warning(
+                    "checkpoint step %d corrupt (%s); falling back", step, e)
+                continue
+            except FileNotFoundError:
+                continue
+            _restores_counter().labels(outcome="ok").inc()
+            return out
+        _restores_counter().labels(outcome="none").inc()
+        return None

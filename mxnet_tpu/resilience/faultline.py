@@ -1,0 +1,287 @@
+"""faultline: deterministic, seeded fault injection.
+
+A *fault plan* is a list of specs; each spec names a **site** (an
+instrumented code location), a **kind**, and the 1-based **arrival
+index** at that site on which it fires (``at``; the ISSUE-era alias
+``step`` is accepted — for per-step sites like ``train.grads`` the
+arrival index IS the step number).  Sites re-count from 1 after
+``clear()``/``plan()``, so a chaos test is reproducible bit for bit.
+
+Sites (each has a hook in the named module):
+
+=================== ======================================================
+site                 hook location
+=================== ======================================================
+kvstore.kv           ``TPUICIStore._kv_try_get`` (coordination KV reads)
+kvstore.pushpull     ``TPUICIStore.pushpull`` (per-key collectives)
+collective.dispatch  ``GradBucketer._issue_bucket`` (bucketed collectives)
+serve.model_call     ``serve.Endpoint._execute`` (batched model call)
+data.iterator        ``io.DevicePrefetcher._pull`` (feeder thread)
+checkpoint.write     ``resilience.checkpoint`` shard writer
+train.grads          ``FusedTrainStep._prepare`` (gradient poisoning)
+=================== ======================================================
+
+Kinds: ``timeout`` (raises :class:`InjectedTimeout`, a ``TimeoutError`` —
+the transient class every retry policy handles), ``error``
+(:class:`InjectedError` — non-transient), ``preempt``
+(:class:`InjectedPreemption` — the "host died" class; chaos tests catch
+it where a real preemption would kill the process), and ``nan_grad``
+(only meaningful at ``train.grads``: the hook poisons the gradient
+rescale factor instead of raising, exercising the finite-grad
+step-guard end to end).
+
+Registration::
+
+    faultline.plan([{"site": "kvstore.pushpull", "kind": "timeout",
+                     "at": 3}])
+    # or, for whole-process chaos runs:
+    MXNET_FAULTLINE='[{"site": "kvstore.kv", "kind": "timeout"}]'
+    MXNET_FAULTLINE=@/path/to/plan.json
+
+``seeded_plan(seed, sites, n_faults, horizon)`` derives a deterministic
+random plan from a seed — same seed, same faults, every run.
+
+Every injection ticks ``mxtpu_faults_injected_total{site,kind}``;
+recovery code calls :func:`recovered` to tick
+``mxtpu_faults_recovered_total{site,kind}`` after surviving one.
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+from .. import telemetry as _telemetry
+
+__all__ = [
+    "SITES", "KINDS",
+    "InjectedFault", "InjectedTimeout", "InjectedError",
+    "InjectedPreemption",
+    "plan", "clear", "active_plan", "seeded_plan",
+    "check", "poll", "recovered", "arrivals", "raise_fault",
+]
+
+SITES = ("kvstore.kv", "kvstore.pushpull", "collective.dispatch",
+         "serve.model_call", "data.iterator", "checkpoint.write",
+         "train.grads")
+KINDS = ("timeout", "error", "preempt", "nan_grad")
+
+
+class InjectedFault(RuntimeError):
+    """Base class for every faultline-raised exception."""
+
+    def __init__(self, site, kind, arrival):
+        super().__init__(
+            f"faultline: injected {kind} at {site} (arrival #{arrival})")
+        self.site = site
+        self.kind = kind
+        self.arrival = arrival
+
+
+class InjectedTimeout(InjectedFault, TimeoutError):
+    """Transient: retry policies treat it like a real deadline miss."""
+
+
+class InjectedError(InjectedFault):
+    """Non-transient: must surface to the caller, not be retried away."""
+
+
+class InjectedPreemption(InjectedFault):
+    """The host-died class: a real one never returns; chaos tests catch
+    it at the training-loop boundary and resume from checkpoint."""
+
+
+_EXC_BY_KIND = {
+    "timeout": InjectedTimeout,
+    "error": InjectedError,
+    "preempt": InjectedPreemption,
+}
+
+
+class _Spec:
+    __slots__ = ("site", "kind", "at", "times", "fired")
+
+    def __init__(self, site, kind, at=None, times=1):
+        if site not in SITES:
+            raise ValueError(f"unknown faultline site {site!r}; "
+                             f"one of {SITES}")
+        if kind not in KINDS:
+            raise ValueError(f"unknown faultline kind {kind!r}; "
+                             f"one of {KINDS}")
+        self.site = site
+        self.kind = kind
+        # `at` is the 1-based arrival index at the site; None = next
+        # arrival.  `times` = how many consecutive arrivals fire
+        # (times=2 on a timeout exhausts a retry budget of 1, etc.)
+        self.at = None if at is None else int(at)
+        self.times = max(1, int(times))
+        self.fired = 0
+
+    def matches(self, arrival):
+        start = self.at if self.at is not None else 1
+        return self.fired < self.times and \
+            start <= arrival < start + self.times
+
+    def to_dict(self):
+        return {"site": self.site, "kind": self.kind,
+                "at": self.at, "times": self.times, "fired": self.fired}
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.specs = None       # None = env not consulted yet
+        self.counts = {}        # site -> arrivals seen
+
+
+_state = _State()
+
+
+def _injected_counter():
+    return _telemetry.counter(
+        "mxtpu_faults_injected_total",
+        "Faults deliberately injected by the faultline chaos layer, by "
+        "site and kind — nonzero outside a chaos run means a fault plan "
+        "leaked into production config",
+        labelnames=("site", "kind"))
+
+
+def _recovered_counter():
+    return _telemetry.counter(
+        "mxtpu_faults_recovered_total",
+        "Faults (injected or real) a recovery policy survived — retry "
+        "succeeded, step-guard skipped a poisoned update, serve request "
+        "re-executed — by site and kind",
+        labelnames=("site", "kind"))
+
+
+def _parse_plan(entries):
+    specs = []
+    for e in entries:
+        if isinstance(e, _Spec):
+            specs.append(_Spec(e.site, e.kind, e.at, e.times))
+            continue
+        at = e.get("at", e.get("step"))
+        specs.append(_Spec(e["site"], e["kind"], at, e.get("times", 1)))
+    return specs
+
+
+def _load_env_plan():
+    import os
+
+    # mxlint: disable=env-read-at-trace-time -- host-side read, once per process at the first hook arrival; chaos plans are process config, never traced
+    raw = os.environ.get("MXNET_FAULTLINE")
+    if not raw:
+        return []
+    if raw.startswith("@"):
+        with open(raw[1:], "r", encoding="utf-8") as f:
+            raw = f.read()
+    return _parse_plan(json.loads(raw))
+
+
+def plan(entries):
+    """Install a fault plan (replacing any active one) and reset every
+    site's arrival counter.  ``entries``: dicts with ``site``, ``kind``,
+    optional ``at``/``step`` (1-based arrival index) and ``times``."""
+    with _state.lock:
+        _state.specs = _parse_plan(entries)
+        _state.counts = {}
+
+
+def clear():
+    """Drop the active plan and arrival counters (also forgets the env
+    plan — it is re-read on the next hook arrival only if `plan()` is
+    never called)."""
+    with _state.lock:
+        _state.specs = []
+        _state.counts = {}
+
+
+def active_plan():
+    """The live specs as dicts (with their fired counts), for tests and
+    the dryrun verdict."""
+    with _state.lock:
+        specs = _state.specs or []
+        return [s.to_dict() for s in specs]
+
+
+def arrivals(site=None):
+    """Arrival counters, for assertions on hook coverage."""
+    with _state.lock:
+        if site is not None:
+            return _state.counts.get(site, 0)
+        return dict(_state.counts)
+
+
+def seeded_plan(seed, sites=("kvstore.pushpull", "kvstore.kv"),
+                n_faults=2, horizon=10, kinds=("timeout",)):
+    """Derive a deterministic plan from ``seed``: ``n_faults`` faults
+    spread over the first ``horizon`` arrivals of the given sites.  Same
+    seed -> identical plan, every process, every run."""
+    import numpy as onp
+
+    rng = onp.random.default_rng(int(seed))
+    entries = []
+    for _ in range(int(n_faults)):
+        entries.append({
+            "site": sites[int(rng.integers(len(sites)))],
+            "kind": kinds[int(rng.integers(len(kinds)))],
+            "at": int(rng.integers(1, max(2, int(horizon)))),
+        })
+    return entries
+
+
+def _arrive(site):
+    """Advance the site's arrival counter; return the matched spec or
+    None.  Lazily consults MXNET_FAULTLINE on the first arrival ever."""
+    with _state.lock:
+        if _state.specs is None:
+            _state.specs = _load_env_plan()
+        n = _state.counts.get(site, 0) + 1
+        _state.counts[site] = n
+        if not _state.specs:
+            return None
+        for s in _state.specs:
+            if s.site == site and s.matches(n):
+                s.fired += 1
+                return s
+        return None
+
+
+def poll(site):
+    """Non-raising hook: returns the matched kind (string) or None.
+    Used by sites that act on the fault themselves (``train.grads``
+    poisons the rescale factor instead of raising)."""
+    spec = _arrive(site)
+    if spec is None:
+        return None
+    _injected_counter().labels(site=site, kind=spec.kind).inc()
+    return spec.kind
+
+
+def check(site):
+    """Raising hook: no-op when no fault matches this arrival, else
+    raises the kind's exception class (``nan_grad`` never raises — it is
+    returned by :func:`poll` at the one site that understands it)."""
+    spec = _arrive(site)
+    if spec is None:
+        return
+    _injected_counter().labels(site=site, kind=spec.kind).inc()
+    exc = _EXC_BY_KIND.get(spec.kind)
+    if exc is not None:
+        raise exc(site, spec.kind, _state.counts[site])
+
+
+def raise_fault(site, kind, arrival=None):
+    """Raise the exception class for ``kind`` — for poll-style sites
+    that self-handle one kind (``train.grads`` + ``nan_grad``) but must
+    still surface the raising kinds like any other hook."""
+    exc = _EXC_BY_KIND.get(kind)
+    if exc is not None:
+        raise exc(site, kind,
+                  arrival if arrival is not None else arrivals(site))
+
+
+def recovered(site, kind):
+    """Tick ``mxtpu_faults_recovered_total`` — call after a recovery
+    policy survived a fault (injected or real) at ``site``."""
+    _recovered_counter().labels(site=site, kind=kind).inc()

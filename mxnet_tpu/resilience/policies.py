@@ -1,0 +1,136 @@
+"""Recovery policies: bounded retry, abort-to-checkpoint.
+
+Three failure classes, three policies (docs/RESILIENCE.md):
+
+* **Transient** (KV timeouts, collective deadline misses, injected
+  ``timeout`` faults): :func:`retry_transient` — capped exponential
+  backoff, ``MXNET_KVSTORE_RETRIES`` attempts, every survived fault
+  ticks ``mxtpu_faults_recovered_total``.
+* **Poisoned step** (inf/nan gradients after a loss blow-up): the
+  finite-grad step-guard inside ``FusedTrainStep``/``Trainer.step`` —
+  not here; it must live in-program to avoid a host sync.
+* **Fatal** (a peer's heartbeat went stale): :func:`check_peers` /
+  :func:`abort_to_checkpoint` — flush the checkpoint manager and raise
+  :class:`DeadNodeError` so the launcher can restart the job against
+  the surviving hosts; resumption costs one checkpoint interval, not
+  the run.
+"""
+from __future__ import annotations
+
+import time
+
+from ..base import MXNetError
+from . import faultline
+
+__all__ = ["TRANSIENT_EXCEPTIONS", "retry_transient", "DeadNodeError",
+           "check_peers", "abort_to_checkpoint", "kv_retries",
+           "step_skip_counter"]
+
+# the transient class: deadline misses and connection hiccups.  Real
+# XLA/jax execution errors are NOT here — retrying a poisoned program
+# re-poisons it; those surface immediately.
+TRANSIENT_EXCEPTIONS = (TimeoutError, ConnectionError)
+
+
+def kv_retries():
+    """Retry budget for transient KV/collective faults
+    (``MXNET_KVSTORE_RETRIES``, default 3 = up to 4 attempts total)."""
+    import os
+
+    # mxlint: disable=env-read-at-trace-time -- host-side knob read per retry loop so it can be tuned mid-run; never enters traced code
+    return int(os.environ.get("MXNET_KVSTORE_RETRIES", "3"))
+
+
+def _retries_counter():
+    from .. import telemetry as _telemetry
+
+    return _telemetry.counter(
+        "mxtpu_kvstore_retries_total",
+        "Transient-fault retries taken by the bounded-backoff policy, "
+        "by site — a steadily rising value means the coordination KV or "
+        "the interconnect is flapping",
+        labelnames=("site",))
+
+
+def step_skip_counter():
+    """Counter for steps the finite-grad step-guard held back: the
+    optimizer update was suppressed (params/states/aux bitwise intact)
+    because a gradient came back inf/nan — loss blow-up or an injected
+    ``nan_grad`` fault."""
+    from .. import telemetry as _telemetry
+
+    return _telemetry.counter(
+        "mxtpu_train_steps_skipped_total",
+        "Training steps whose optimizer update was skipped by the "
+        "finite-grad step-guard (non-finite gradients: loss overflow or "
+        "injected nan_grad); parameters and optimizer state were left "
+        "bitwise untouched and the loss scaler backed off")
+
+
+def retry_transient(fn, site, retries=None, base_delay=0.05, max_delay=2.0,
+                    retry_on=TRANSIENT_EXCEPTIONS, sleep=time.sleep):
+    """Call ``fn()``; on a transient exception retry up to ``retries``
+    times with capped exponential backoff (base, 2*base, 4*base, ...
+    capped at ``max_delay``).  A retry that then succeeds ticks
+    ``mxtpu_faults_recovered_total{site}``; exhausting the budget
+    re-raises the last exception."""
+    if retries is None:
+        retries = kv_retries()
+    attempt = 0
+    while True:
+        try:
+            out = fn()
+        except retry_on as e:
+            if attempt >= retries:
+                raise
+            delay = min(max_delay, base_delay * (2 ** attempt))
+            attempt += 1
+            _retries_counter().labels(site=site).inc()
+            last_kind = getattr(e, "kind", "timeout")
+            sleep(delay)
+            continue
+        if attempt:
+            faultline.recovered(site, last_kind)
+        return out
+
+
+class DeadNodeError(MXNetError):
+    """A peer's heartbeat went stale past tolerance; the job must fall
+    back to its last checkpoint (``.ranks`` names the dead peers,
+    ``.checkpoint_step`` the committed step to resume from)."""
+
+    def __init__(self, ranks, checkpoint_step=None):
+        ranks = sorted(ranks)
+        super().__init__(
+            f"dead nodes detected (ranks {ranks}); "
+            + (f"resume from checkpoint step {checkpoint_step}"
+               if checkpoint_step is not None
+               else "no checkpoint committed yet"))
+        self.ranks = ranks
+        self.checkpoint_step = checkpoint_step
+
+
+def check_peers(store, manager=None, timeout=60):
+    """Poll ``store.get_dead_nodes`` and, when it fires, abort to the
+    last checkpoint: flush ``manager``'s queued writes and raise
+    :class:`DeadNodeError`.  Returns ``[]`` when all peers are live —
+    cheap enough to call every N steps from a training loop."""
+    dead = store.get_dead_nodes(timeout=timeout)
+    if not dead:
+        return []
+    abort_to_checkpoint(dead, manager)
+
+
+def abort_to_checkpoint(dead_ranks, manager=None):
+    """Flush the checkpoint manager (the last snapshot must actually be
+    on disk before the process gives up) and raise
+    :class:`DeadNodeError` for the launcher to act on."""
+    from .checkpoint import latest_step
+
+    step = None
+    if manager is not None:
+        try:
+            manager.wait()
+        finally:
+            step = latest_step(manager.root)
+    raise DeadNodeError(dead_ranks, checkpoint_step=step)

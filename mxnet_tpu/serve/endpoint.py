@@ -436,14 +436,26 @@ class Endpoint:
 
         from .. import telemetry as _telemetry
 
+        from ..resilience import faultline as _faultline
+        from ..resilience.policies import retry_transient as _retry_transient
+
+        def model_call():
+            # fault hook fires BEFORE the device call, so a retried
+            # injection never re-dispatches against donated buffers
+            _faultline.check("serve.model_call")
+            o = self._cache(padded, donate=self.donate)
+            return jax.block_until_ready(o)
+
         t0 = time.perf_counter()
         # step-trace span: a profiling dump shows each batch dispatch on
         # the same timeline as op events / step phases / collectives
         with _telemetry.span(f"serve/{self.name}/batch", cat="serve",
                              args={"rows": rows, "bucket": bucket,
                                    "requests": len(group)}):
-            out = self._cache(padded, donate=self.donate)
-            out = jax.block_until_ready(out)
+            # one transient retry: a deadline miss on the transport gets
+            # a second chance instead of failing the whole batch
+            out = _retry_transient(model_call, site="serve.model_call",
+                                   retries=1)
         latency = time.perf_counter() - t0
 
         self.metrics.observe_batch(rows, bucket)

@@ -128,7 +128,7 @@ class RetraceWatchdog:
         hierarchy that recompiled, not just a cache size."""
         try:
             size = fn._cache_size()
-        except Exception:       # not a PjitFunction (mocks, AOT wrappers)
+        except Exception:  # mxlint: disable=swallowed-exception -- not a PjitFunction (mocks, AOT wrappers): nothing to track, observing is optional
             return
         with self._lock:
             ent = self._tracked.get(id(fn))

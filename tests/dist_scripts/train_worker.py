@@ -217,6 +217,9 @@ def check_failure_detection(rank):
         except Exception:
             pass
         c.key_value_set("mxtpu/heartbeat/0", repr(time.time() - 10_000))
+        # two consecutive stale observations declare death (one missed
+        # stamp is tolerated by the suspicion counter)
+        store.get_dead_nodes(timeout=60)
         assert 0 in store.get_dead_nodes(timeout=60)
     store.close()
     print(f"rank {rank} LIVENESS OK", flush=True)

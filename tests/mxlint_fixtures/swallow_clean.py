@@ -1,0 +1,56 @@
+"""Clean counterparts for swallowed-exception: narrow catches, broad
+handlers that re-raise, propagate the object, log, print, or tick
+telemetry."""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def narrow(fn):
+    try:
+        return fn()
+    except (ValueError, KeyError):
+        return None
+
+
+def reraises(fn):
+    try:
+        return fn()
+    except Exception:
+        raise
+
+
+def wraps_and_raises(fn):
+    try:
+        return fn()
+    except Exception as exc:
+        raise RuntimeError("probe failed") from exc
+
+
+def propagates_object(fn, q):
+    try:
+        return fn()
+    except Exception as exc:
+        q.put(exc)
+
+
+def logs(fn):
+    try:
+        return fn()
+    except Exception:
+        log.warning("probe failed; using fallback")
+        return None
+
+
+def prints(fn):
+    try:
+        return fn()
+    except Exception:
+        print("probe failed")
+
+
+def ticks_telemetry(fn, counter):
+    try:
+        return fn()
+    except Exception:
+        counter.inc()

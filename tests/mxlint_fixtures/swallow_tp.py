@@ -1,0 +1,33 @@
+"""True positives for swallowed-exception: broad handlers where the
+fault provably goes nowhere."""
+
+
+def bare_pass(fn):
+    try:
+        return fn()
+    except:  # noqa: E722
+        pass
+
+
+def broad_return(fn):
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
+def bound_but_unused(fn):
+    try:
+        return fn()
+    except Exception as e:
+        return None
+
+
+def broad_in_tuple(fns):
+    out = []
+    for f in fns:
+        try:
+            out.append(f())
+        except (ValueError, BaseException):
+            continue
+    return out

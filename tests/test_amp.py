@@ -192,3 +192,89 @@ def test_amp_hybridized_resnet_block_hlo_dtypes():
                        text=True, env=env, timeout=600)
     assert r.returncode == 0, (r.stdout[-800:], r.stderr[-1500:])
     assert "AMP_HLO_OK" in r.stdout
+
+
+# -- LossScaler guard coverage (ISSUE 9) -------------------------------------
+
+def test_loss_scaler_overflow_detection():
+    import numpy as onp
+    from mxnet_tpu import autograd
+    from mxnet_tpu.amp.loss_scaler import LossScaler
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    x = mx.np.ones((4, 3))
+    with autograd.record():
+        net(x).sum().backward()
+    params = list(net.collect_params().values())
+    ls = LossScaler()
+    assert not ls.has_overflow(params)
+    poisoned = net.weight.grad().asnumpy().copy()
+    poisoned[0, 0] = onp.nan
+    net.weight.list_grad()[0]._rebind(jnp.asarray(poisoned))
+    assert ls.has_overflow(params)
+    poisoned[0, 0] = onp.inf
+    net.weight.list_grad()[0]._rebind(jnp.asarray(poisoned))
+    assert ls.has_overflow(params)
+
+
+def test_loss_scaler_scale_trajectory_floor_and_window():
+    from mxnet_tpu.amp.loss_scaler import LossScaler
+
+    ls = LossScaler(init_scale=4.0, scale_factor=2.0, scale_window=3)
+    for _ in range(8):          # halving floors at 1.0, never 0
+        ls.update_scale(True)
+    assert ls.loss_scale == 1.0
+    ls.update_scale(False)
+    ls.update_scale(False)
+    ls.update_scale(True)       # overflow resets the clean-step window
+    assert ls.loss_scale == 1.0
+    ls.update_scale(False)
+    ls.update_scale(False)
+    assert ls.loss_scale == 1.0  # only 2 clean since reset
+    ls.update_scale(False)
+    assert ls.loss_scale == 2.0  # 3rd clean step doubles
+
+
+def test_trainer_step_guard_skips_overflowed_update():
+    """Eager-path fused skip: an overflowed step leaves params bitwise
+    unchanged, backs the scale off, and ticks the skip counter."""
+    import numpy as onp
+    from mxnet_tpu import autograd, gluon, telemetry
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    amp.init_trainer(trainer)
+    # the bf16 default is a static scaler; the guard needs the dynamic one
+    from mxnet_tpu.amp.loss_scaler import LossScaler
+    trainer._amp_loss_scaler = LossScaler(dynamic=True, init_scale=2.0)
+    scaler = trainer._amp_loss_scaler
+    x = mx.np.ones((4, 3))
+
+    def backward(scale):
+        scaler.loss_scale = scale
+        with autograd.record():
+            out = net(x).sum()
+            with amp.scale_loss(out, trainer) as scaled:
+                autograd.backward(scaled)
+
+    reg = telemetry.default_registry()
+    skip0 = reg.get_sample_value("mxtpu_train_steps_skipped_total") or 0.0
+    backward(3.0e38)            # f32 overflow: grads go inf
+    w0 = {k: onp.asarray(p.data()._data).copy()
+          for k, p in net.collect_params().items()}
+    trainer.step(4)
+    for k, p in net.collect_params().items():
+        assert onp.asarray(p.data()._data).tobytes() == w0[k].tobytes(), k
+    assert scaler.loss_scale == 1.5e38   # halved
+    assert (reg.get_sample_value("mxtpu_train_steps_skipped_total")
+            or 0.0) == skip0 + 1
+
+    backward(2.0)               # clean step trains again
+    trainer.step(4)
+    assert any(onp.asarray(p.data()._data).tobytes() != w0[k].tobytes()
+               for k, p in net.collect_params().items())

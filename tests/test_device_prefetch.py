@@ -101,3 +101,31 @@ def test_dataloader_prefetch_to_device():
         onp.testing.assert_allclose(got_x, x, rtol=1e-6)
         # second epoch works (generator re-created)
         assert len(list(dl)) == 4
+
+
+def test_prefetcher_midstream_poison_reraises_not_hangs():
+    """Regression (ISSUE 9): a source that dies MID-stream must surface
+    its exception at ``__next__`` — the old feeder died silently and the
+    consumer hung forever on an empty queue."""
+    def gen():
+        yield (onp.zeros((2, 2), onp.float32),)
+        yield (onp.ones((2, 2), onp.float32),)
+        raise RuntimeError("source died mid-stream")
+
+    pf = DevicePrefetcher(gen(), depth=1)
+    assert next(pf)[0].asnumpy().max() == 0.0
+    assert next(pf)[0].asnumpy().max() == 1.0
+    with pytest.raises(RuntimeError, match="mid-stream"):
+        next(pf)
+    pf.close()   # joins the feeder; must not hang
+
+
+def test_prefetcher_cast_failure_propagates():
+    """The dtype cast and device transfer run on the feeder thread; a
+    failing cast must propagate, not kill the feeder silently (the bug
+    that motivated the swallowed-exception lint rule)."""
+    batches = iter([(onp.array(["a", "b"], dtype=object),)])
+    pf = DevicePrefetcher(batches, depth=1, dtypes=(onp.float32,))
+    with pytest.raises((TypeError, ValueError)):
+        next(pf)
+    pf.close()

@@ -376,13 +376,26 @@ def test_dead_nodes_startup_grace(monkeypatch):
     fake.key_value_set("mxtpu/heartbeat/0", repr(_time.time()))
     # ranks 1,2 never heartbeat, but the store just started: grace applies
     assert kv.get_dead_nodes(timeout=60) == []
-    # after the grace window they are dead
+    # after the grace window: the first stale observation only ARMS
+    # suspicion (one missed/torn stamp is tolerated — a coordinator
+    # hiccup must not kill a rank), the second consecutive one declares
+    # death (ISSUE 9 flake-proofing)
     kv._started_at = _time.time() - 120
+    assert kv.get_dead_nodes(timeout=60) == []
     assert kv.get_dead_nodes(timeout=60) == [1, 2]
-    # a stale stamp is dead regardless of grace
+    # a stale stamp is dead regardless of grace — again on the second
+    # consecutive stale observation
     fake.key_value_set("mxtpu/heartbeat/1", repr(_time.time() - 999))
     kv._started_at = _time.time()
+    kv._stale_counts.clear()
+    assert kv.get_dead_nodes(timeout=60) == []
     assert kv.get_dead_nodes(timeout=60) == [1]
+    # a fresh stamp clears suspicion: rank 1 recovers, no false kill
+    fake.key_value_set("mxtpu/heartbeat/1", repr(_time.time()))
+    fake.key_value_set("mxtpu/heartbeat/2", repr(_time.time() - 999))
+    assert kv.get_dead_nodes(timeout=60) == []       # arms 2, clears 1
+    fake.key_value_set("mxtpu/heartbeat/2", repr(_time.time()))
+    assert kv.get_dead_nodes(timeout=60) == []       # 2 recovered too
 
 
 def test_launcher_profile_rank(tmp_path):

@@ -44,6 +44,7 @@ def _unwaived(findings):
     ("bits-as-float", "bits_tp.py", "bits_clean.py", 2),
     ("daemon-thread-no-shutdown", "thread_tp.py", "thread_clean.py", 1),
     ("nondeterministic-trace", "nondet_tp.py", "nondet_clean.py", 4),
+    ("swallowed-exception", "swallow_tp.py", "swallow_clean.py", 4),
 ])
 def test_rule_fixture_pair(rule, tp, clean, n_expected):
     hits = _unwaived(_lint(tp, rule))
@@ -61,7 +62,7 @@ def test_rule_names_unique_and_documented():
     names = [r.name for r in rules]
     assert len(set(names)) == len(names)
     assert all(r.description for r in rules)
-    assert len(rules) == 7
+    assert len(rules) == 8
 
 
 # -- waivers ---------------------------------------------------------------
@@ -221,7 +222,8 @@ def test_cli_list_rules():
     assert r.returncode == 0
     for name in ("env-read-at-trace-time", "env-var-undocumented",
                  "lock-discipline", "host-sync-in-jit", "bits-as-float",
-                 "daemon-thread-no-shutdown", "nondeterministic-trace"):
+                 "daemon-thread-no-shutdown", "nondeterministic-trace",
+                 "swallowed-exception"):
         assert name in r.stdout
 
 

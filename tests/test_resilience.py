@@ -1,0 +1,549 @@
+"""Resilience (ISSUE 9): faultline injection, elastic checkpoint/resume,
+recovery policies.
+
+The acceptance fences live here: the chaos resume-parity test (an
+injected preemption at step k, resume from checkpoint, bitwise parity
+with the fault-free trajectory), KV-timeout and nan-grad faults that
+recover without killing the process (visible in
+``mxtpu_faults_recovered_total``), and the atomic-checkpoint corruption
+fallback.
+"""
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, kvstore, telemetry
+from mxnet_tpu.amp import LossScaler
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.kvstore import bucketing
+from mxnet_tpu.resilience import (CheckpointCorrupt, CheckpointManager,
+                                  DeadNodeError, check_peers, faultline,
+                                  gather_training_state,
+                                  restore_training_state, retry_transient)
+from mxnet_tpu.resilience import checkpoint as ckpt
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faultline.clear()
+    yield
+    faultline.clear()
+
+
+def _sample(name, labels=None):
+    v = telemetry.default_registry().get_sample_value(name, labels)
+    return 0.0 if v is None else v
+
+
+# -- faultline semantics ------------------------------------------------------
+
+def test_plan_at_and_times_matching():
+    faultline.plan([{"site": "kvstore.kv", "kind": "timeout",
+                     "at": 2, "times": 2}])
+    faultline.check("kvstore.kv")                      # arrival 1: clean
+    with pytest.raises(faultline.InjectedTimeout):     # arrival 2
+        faultline.check("kvstore.kv")
+    with pytest.raises(faultline.InjectedTimeout):     # arrival 3 (times=2)
+        faultline.check("kvstore.kv")
+    faultline.check("kvstore.kv")                      # arrival 4: spent
+    assert faultline.arrivals("kvstore.kv") == 4
+
+
+def test_plan_resets_arrival_counters():
+    faultline.plan([])
+    for _ in range(5):
+        faultline.check("kvstore.kv")
+    assert faultline.arrivals("kvstore.kv") == 5
+    # `at: 1` after a fresh plan() means THE NEXT arrival, regardless of
+    # history -- the property every chaos test in this file leans on
+    faultline.plan([{"site": "kvstore.kv", "kind": "error", "at": 1}])
+    assert faultline.arrivals("kvstore.kv") == 0
+    with pytest.raises(faultline.InjectedError):
+        faultline.check("kvstore.kv")
+
+
+def test_step_alias_and_kind_classes():
+    faultline.plan([{"site": "train.grads", "kind": "preempt", "step": 1}])
+    assert faultline.active_plan()[0]["at"] == 1
+    # timeout is a TimeoutError (the transient class), preempt/error are not
+    assert issubclass(faultline.InjectedTimeout, TimeoutError)
+    assert not issubclass(faultline.InjectedError, TimeoutError)
+    assert not issubclass(faultline.InjectedPreemption, TimeoutError)
+    for k in ("timeout", "error", "preempt"):
+        assert issubclass(faultline._EXC_BY_KIND[k], faultline.InjectedFault)
+
+
+def test_unknown_site_or_kind_rejected():
+    with pytest.raises(ValueError):
+        faultline.plan([{"site": "nope.nope", "kind": "timeout"}])
+    with pytest.raises(ValueError):
+        faultline.plan([{"site": "kvstore.kv", "kind": "gremlin"}])
+
+
+def test_poll_returns_kind_and_ticks_injected_counter():
+    before = _sample("mxtpu_faults_injected_total",
+                     {"site": "train.grads", "kind": "nan_grad"})
+    faultline.plan([{"site": "train.grads", "kind": "nan_grad", "at": 1}])
+    assert faultline.poll("train.grads") == "nan_grad"
+    assert faultline.poll("train.grads") is None
+    after = _sample("mxtpu_faults_injected_total",
+                    {"site": "train.grads", "kind": "nan_grad"})
+    assert after == before + 1
+
+
+def test_raise_fault_maps_kinds():
+    with pytest.raises(faultline.InjectedPreemption):
+        faultline.raise_fault("train.grads", "preempt")
+    faultline.raise_fault("train.grads", "nan_grad")  # no exception class
+
+
+def test_seeded_plan_deterministic():
+    a = faultline.seeded_plan(1234, n_faults=4, horizon=20)
+    b = faultline.seeded_plan(1234, n_faults=4, horizon=20)
+    c = faultline.seeded_plan(1235, n_faults=4, horizon=20)
+    assert a == b
+    assert a != c
+    for e in a:
+        assert e["site"] in faultline.SITES and e["kind"] in faultline.KINDS
+        assert 1 <= e["at"] < 20
+    faultline.plan(a)   # a seeded plan is a valid plan
+
+
+def test_env_plan_loaded_lazily(tmp_path, monkeypatch):
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(json.dumps(
+        [{"site": "data.iterator", "kind": "error", "at": 1}]))
+    monkeypatch.setenv("MXNET_FAULTLINE", "@" + str(plan_file))
+    faultline._state.specs = None    # simulate a fresh process
+    with pytest.raises(faultline.InjectedError):
+        faultline.check("data.iterator")
+    faultline.clear()
+
+
+# -- retry policy -------------------------------------------------------------
+
+def test_retry_transient_recovers_and_ticks():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TimeoutError("deadline")
+        return "ok"
+
+    before = _sample("mxtpu_faults_recovered_total",
+                     {"site": "kvstore.kv", "kind": "timeout"})
+    out = retry_transient(flaky, site="kvstore.kv", retries=3,
+                          sleep=lambda _t: None)
+    assert out == "ok" and calls["n"] == 3
+    after = _sample("mxtpu_faults_recovered_total",
+                    {"site": "kvstore.kv", "kind": "timeout"})
+    assert after == before + 1
+
+
+def test_retry_transient_budget_exhaustion_reraises():
+    def always():
+        raise TimeoutError("still down")
+
+    with pytest.raises(TimeoutError):
+        retry_transient(always, site="kvstore.kv", retries=2,
+                        sleep=lambda _t: None)
+
+
+def test_retry_transient_does_not_retry_nontransient():
+    calls = {"n": 0}
+
+    def poisoned():
+        calls["n"] += 1
+        raise ValueError("bad program")
+
+    with pytest.raises(ValueError):
+        retry_transient(poisoned, site="kvstore.kv", retries=5,
+                        sleep=lambda _t: None)
+    assert calls["n"] == 1
+
+
+def test_retry_backoff_is_capped_exponential():
+    delays = []
+
+    def always():
+        raise TimeoutError()
+
+    with pytest.raises(TimeoutError):
+        retry_transient(always, site="kvstore.kv", retries=7,
+                        base_delay=0.05, max_delay=0.2, sleep=delays.append)
+    assert delays[:3] == [0.05, 0.1, 0.2]
+    assert all(d == 0.2 for d in delays[2:])
+    assert len(delays) == 7
+
+
+# -- shard-level checkpoint io ------------------------------------------------
+
+def test_save_load_roundtrip_bitwise_including_bf16(tmp_path):
+    import jax.numpy as jnp
+
+    rs = onp.random.RandomState(0)
+    bf = onp.asarray(jnp.asarray(rs.randn(16), jnp.bfloat16))
+    arrays = {"w": rs.randn(4, 3).astype(onp.float32),
+              "b": bf,
+              "n": onp.arange(5, dtype=onp.int64)}
+    ckpt.save_checkpoint(str(tmp_path), 7, arrays, {"tag": "x"}, rank=0)
+    step, got, meta = ckpt.load_checkpoint(str(tmp_path), rank=0)
+    assert step == 7 and meta["tag"] == "x"
+    assert sorted(got) == sorted(arrays)
+    for k in arrays:
+        assert got[k].dtype == arrays[k].dtype, k
+        # bitwise, not allclose: compare the raw bytes
+        assert got[k].tobytes() == arrays[k].tobytes(), k
+
+
+def test_checksum_corruption_detected(tmp_path):
+    ckpt.save_checkpoint(str(tmp_path), 1, {"w": onp.arange(8.)}, rank=0)
+    shard = tmp_path / "step-0000000001" / "host-00000"
+    blob = bytearray((shard / "arrays.npz").read_bytes())
+    blob[len(blob) // 2] ^= 0xFF    # flip one payload bit
+    (shard / "arrays.npz").write_bytes(bytes(blob))
+    with pytest.raises(CheckpointCorrupt):
+        ckpt.load_checkpoint(str(tmp_path), 1, rank=0)
+
+
+def test_restore_latest_falls_back_past_corruption(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep=5, async_write=False, rank=0)
+    mgr.save(1, {"w": onp.full(4, 1.0)}, {"step": 1})
+    mgr.save(2, {"w": onp.full(4, 2.0)}, {"step": 2})
+    (tmp_path / "step-0000000002" / "host-00000"
+     / "arrays.npz").write_bytes(b"garbage")
+    before = _sample("mxtpu_checkpoint_restores_total",
+                     {"outcome": "corrupt_fallback"})
+    step, arrays, _meta = mgr.restore_latest()
+    assert step == 1
+    assert arrays["w"].tolist() == [1.0] * 4
+    after = _sample("mxtpu_checkpoint_restores_total",
+                    {"outcome": "corrupt_fallback"})
+    assert after == before + 1
+    mgr.close()
+
+
+def test_manager_prunes_to_keep_and_sweeps_tmp(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep=2, async_write=False, rank=0)
+    leftover = tmp_path / ".tmp-step-0000000099-host-00000-123"
+    leftover.mkdir()
+    for s in (1, 2, 3, 4):
+        mgr.save(s, {"w": onp.arange(3.) + s}, {})
+    assert ckpt.list_steps(str(tmp_path)) == [3, 4]
+    assert not leftover.exists()
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp-")]
+    mgr.close()
+
+
+def test_injected_write_fault_leaves_no_partial_state(tmp_path):
+    faultline.plan([{"site": "checkpoint.write", "kind": "error", "at": 1}])
+    with pytest.raises(faultline.InjectedError):
+        ckpt.save_checkpoint(str(tmp_path), 5, {"w": onp.zeros(2)}, rank=0)
+    assert ckpt.list_steps(str(tmp_path)) == []
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp-")]
+
+
+def test_async_writer_error_surfaces_at_wait_then_recovers(tmp_path):
+    mgr = CheckpointManager(tmp_path, async_write=True, rank=0)
+    faultline.plan([{"site": "checkpoint.write", "kind": "error", "at": 1}])
+    mgr.save(1, {"w": onp.zeros(2)}, {})
+    with pytest.raises(faultline.InjectedError):
+        mgr.wait()
+    faultline.clear()
+    # the manager is not wedged: the next save commits
+    mgr.save(2, {"w": onp.ones(2)}, {})
+    mgr.wait()
+    assert ckpt.latest_step(str(tmp_path)) == 2
+    mgr.close()
+
+
+# -- training-state gather / restore -----------------------------------------
+
+def _build(seed):
+    """Fresh net + sgd-momentum trainer + fused step (deterministic in
+    ``seed``)."""
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    fstep = gluon.FusedTrainStep(net, trainer)
+    return net, trainer, fstep
+
+
+def _batch(t):
+    rs = onp.random.RandomState(100 + t)
+    return mx.np.array(rs.randn(4, 16).astype(onp.float32))
+
+
+def _params_np(net):
+    return {k: onp.asarray(p.data()._data)
+            for k, p in net.collect_params().items()}
+
+
+def _opt_states_np(trainer):
+    out = {}
+    for i, entry in (trainer._states or {}).items():
+        sts = entry if isinstance(entry, list) else [entry]
+        for c, st in enumerate(sts):
+            st = st if isinstance(st, (tuple, list)) else (st,)
+            for j, s in enumerate(st):
+                if s is not None:
+                    out[(i, c, j)] = onp.asarray(s._data)
+    return out
+
+
+def test_gather_restore_training_state_bitwise(tmp_path):
+    net, trainer, fstep = _build(seed=3)
+    for t in range(2):
+        fstep.step(_batch(t), batch_size=4)
+    scaler = LossScaler(dynamic=True, init_scale=64.0)
+    scaler._unskipped = 17
+    arrays, meta = gather_training_state(trainer, step=2, scaler=scaler)
+    want_params = _params_np(net)
+    want_states = _opt_states_np(trainer)
+
+    # a different seed and an extra step: everything diverges...
+    net2, trainer2, fstep2 = _build(seed=55)
+    fstep2.step(_batch(9), batch_size=4)
+    scaler2 = LossScaler(dynamic=True, init_scale=2.0)
+    assert any(not onp.array_equal(a, b) for a, b in
+               zip(_params_np(net2).values(), want_params.values()))
+    # ...until restore rebinds it all, bitwise
+    step = restore_training_state(arrays, meta, trainer2, scaler=scaler2)
+    assert step == 2
+    for k, a in _params_np(net2).items():
+        assert a.tobytes() == want_params[k].tobytes(), k
+    got_states = _opt_states_np(trainer2)
+    assert sorted(got_states) == sorted(want_states)
+    for k, a in got_states.items():
+        assert a.tobytes() == want_states[k].tobytes(), k
+    assert scaler2.loss_scale == 64.0 and scaler2._unskipped == 17
+    assert trainer2._optimizer.num_update == trainer._optimizer.num_update
+
+
+def test_resume_parity_after_injected_preemption(tmp_path):
+    """THE chaos fence: preempt the run at step 3, resume from the step-2
+    checkpoint in a fresh 'process', and the 3-step trajectory matches
+    the fault-free run bitwise."""
+    # fault-free reference trajectory
+    net_a, _tr_a, st_a = _build(seed=7)
+    for t in range(3):
+        st_a.step(_batch(t), batch_size=4)
+    ref = _params_np(net_a)
+
+    # chaos run: checkpoint after step 2, preempted during step 3
+    net_b, tr_b, st_b = _build(seed=7)
+    for t in range(2):
+        st_b.step(_batch(t), batch_size=4)
+    mgr = CheckpointManager(tmp_path / "ckpt", async_write=False, rank=0)
+    arrays, meta = gather_training_state(tr_b, step=2)
+    mgr.save(2, arrays, meta)
+    faultline.plan([{"site": "train.grads", "kind": "preempt", "at": 1}])
+    with pytest.raises(faultline.InjectedPreemption):
+        st_b.step(_batch(2), batch_size=4)
+    faultline.clear()
+
+    # 'restarted process': different init seed proves restore wins
+    net_c, tr_c, st_c = _build(seed=99)
+    net_c._ensure_shapes(_batch(0))
+    step, arrays_r, meta_r = mgr.restore_latest()
+    assert step == 2
+    assert restore_training_state(arrays_r, meta_r, tr_c) == 2
+    # restore itself is bitwise: params match the saved shard exactly
+    for i, p in enumerate(tr_c._params):
+        assert onp.asarray(p.data()._data).tobytes() == \
+            arrays_r[f"param/{i}"].tobytes()
+    st_c.step(_batch(2), batch_size=4)
+    got = _params_np(net_c)
+    for k in ref:
+        assert got[k].tobytes() == ref[k].tobytes(), k
+    mgr.close()
+
+
+def test_kv_residuals_survive_checkpoint_roundtrip():
+    """2bit error-feedback residuals ride the checkpoint: a restored
+    store continues the compressed reduce exactly like the original."""
+    def _compressed_store():
+        kv = kvstore.create("tpu_ici")
+        kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+        return kv
+
+    def _vals():
+        return [mx.np.array(onp.array([2.5, -0.4, 0.1, -3.0], onp.float32),
+                            ctx=mx.cpu(c)) for c in range(2)]
+
+    kv1 = _compressed_store()
+    kv1.pushpull(0, _vals())
+    assert kv1._residuals       # error feedback accumulated
+
+    net, trainer, fstep = _build(seed=2)
+    fstep.step(_batch(0), batch_size=4)
+    trainer._kvstore = kv1
+    arrays, meta = gather_training_state(trainer, step=1)
+    assert any(k.startswith("kvres/") for k in arrays)
+
+    net2, trainer2, fstep2 = _build(seed=2)
+    fstep2.step(_batch(0), batch_size=4)
+    kv2 = _compressed_store()
+    trainer2._kvstore = kv2
+    restore_training_state(arrays, meta, trainer2)
+    assert set(kv2._residuals) == set(kv1._residuals)
+    for k in kv1._residuals:
+        assert onp.asarray(kv2._residuals[k]).tobytes() == \
+            onp.asarray(kv1._residuals[k]).tobytes()
+    # next compressed round: continuing vs restored are bit-identical
+    a1, a2 = _vals(), _vals()
+    kv1.pushpull(0, a1)
+    kv2.pushpull(0, a2)
+    for x, y in zip(a1, a2):
+        assert onp.array_equal(x.asnumpy(), y.asnumpy())
+
+
+def test_bucketer_residual_export_import_roundtrip():
+    def _pairs():
+        return [(k, [mx.np.array(onp.array([0.6, -0.7, 0.1, 0.0],
+                                           onp.float32) + k,
+                                 ctx=mx.cpu(c)) for c in range(2)])
+                for k in range(2)]
+
+    comp = {"threshold": 1.0}
+    b_cont, b_orig = bucketing.GradBucketer(), bucketing.GradBucketer()
+    b_cont.pushpull(_pairs(), compression=comp)
+    b_orig.pushpull(_pairs(), compression=comp)   # same state as b_cont
+    exported = b_orig.export_residuals()
+    assert exported
+    for (digest, bidx, c), res in exported.items():
+        assert isinstance(digest, str) and isinstance(res, onp.ndarray)
+
+    b_rest = bucketing.GradBucketer()             # fresh 'process'
+    b_rest.import_residuals(exported)
+    p_cont, p_rest = _pairs(), _pairs()
+    b_cont.pushpull(p_cont, compression=comp)
+    b_rest.pushpull(p_rest, compression=comp)     # adopts pending residuals
+    for (_, vc), (_, vr) in zip(p_cont, p_rest):
+        for x, y in zip(vc, vr):
+            assert onp.array_equal(x.asnumpy(), y.asnumpy())
+
+
+# -- end-to-end fault recovery (the acceptance scenarios) --------------------
+
+def test_kv_timeout_fault_recovers_in_pushpull():
+    kv = kvstore.create("tpu_ici")
+    vals = [mx.np.array(onp.array([1.0, 2.0], onp.float32), ctx=mx.cpu(c))
+            for c in range(2)]
+    before = _sample("mxtpu_faults_recovered_total",
+                     {"site": "kvstore.pushpull", "kind": "timeout"})
+    faultline.plan([{"site": "kvstore.pushpull", "kind": "timeout",
+                     "at": 1}])
+    kv.pushpull("k", vals)        # retried inside the store; no raise
+    exp = onp.array([2.0, 4.0], onp.float32)
+    for v in vals:
+        onp.testing.assert_allclose(v.asnumpy(), exp)
+    after = _sample("mxtpu_faults_recovered_total",
+                    {"site": "kvstore.pushpull", "kind": "timeout"})
+    assert after == before + 1
+
+
+def test_kv_timeout_exhausts_retry_budget(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_RETRIES", "2")
+    kv = kvstore.create("tpu_ici")
+    vals = [mx.np.array(onp.array([1.0], onp.float32), ctx=mx.cpu(c))
+            for c in range(2)]
+    # 3 consecutive timeouts > budget of 2 retries (3 attempts total)
+    faultline.plan([{"site": "kvstore.pushpull", "kind": "timeout",
+                     "at": 1, "times": 3}])
+    with pytest.raises(TimeoutError):
+        kv.pushpull("k", vals)
+
+
+def test_nan_grad_fault_skips_step_and_recovers():
+    net, trainer, _ = _build(seed=5)
+    trainer._amp_loss_scaler = LossScaler(dynamic=True, init_scale=8.0)
+    fstep = gluon.FusedTrainStep(net, trainer)
+    fstep.step(_batch(0), batch_size=4)   # warm: compiled + states alive
+    w_before = _params_np(net)
+    s_before = _opt_states_np(trainer)
+    rec0 = _sample("mxtpu_faults_recovered_total",
+                   {"site": "train.grads", "kind": "nan_grad"})
+    skip0 = _sample("mxtpu_train_steps_skipped_total")
+
+    faultline.plan([{"site": "train.grads", "kind": "nan_grad", "at": 1}])
+    fstep.step(_batch(1), batch_size=4)   # survives: guard holds the step
+    assert fstep.last_step_finite is not None
+    assert not bool(fstep.last_step_finite)
+    for k, a in _params_np(net).items():
+        assert a.tobytes() == w_before[k].tobytes(), k
+    for k, a in _opt_states_np(trainer).items():
+        assert a.tobytes() == s_before[k].tobytes(), k
+    assert trainer._amp_loss_scaler.loss_scale == 4.0   # backed off
+    assert _sample("mxtpu_faults_recovered_total",
+                   {"site": "train.grads", "kind": "nan_grad"}) == rec0 + 1
+    assert _sample("mxtpu_train_steps_skipped_total") == skip0 + 1
+
+    faultline.clear()
+    fstep.step(_batch(2), batch_size=4)   # clean step trains again
+    assert bool(fstep.last_step_finite)
+    assert any(a.tobytes() != w_before[k].tobytes()
+               for k, a in _params_np(net).items())
+
+
+def test_serve_model_call_timeout_recovers():
+    from mxnet_tpu.serve import Endpoint
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    net(mx.np.zeros((1, 8)))
+    x = onp.random.RandomState(0).randn(2, 8).astype(onp.float32)
+    before = _sample("mxtpu_faults_recovered_total",
+                     {"site": "serve.model_call", "kind": "timeout"})
+    with Endpoint(net, max_batch_size=8, max_latency_ms=20) as ep:
+        ep.warmup(onp.zeros((1, 8), onp.float32))
+        # plan AFTER warmup; plan() resets counters so at=1 is next call
+        faultline.plan([{"site": "serve.model_call", "kind": "timeout",
+                         "at": 1}])
+        out = ep.submit(x).result(timeout=60)
+    assert out.shape == (2, 4)
+    after = _sample("mxtpu_faults_recovered_total",
+                    {"site": "serve.model_call", "kind": "timeout"})
+    assert after == before + 1
+
+
+def test_dead_node_aborts_to_checkpoint(tmp_path):
+    class FakeStore:
+        def __init__(self, dead):
+            self._dead = dead
+
+        def get_dead_nodes(self, timeout=60):
+            return list(self._dead)
+
+    mgr = CheckpointManager(tmp_path, async_write=True, rank=0)
+    mgr.save(4, {"w": onp.zeros(2)}, {})
+    assert check_peers(FakeStore([]), mgr) == []
+    with pytest.raises(DeadNodeError) as ei:
+        check_peers(FakeStore([1, 3]), mgr)
+    assert ei.value.ranks == [1, 3]
+    # abort flushed the async writer first: the step is on disk and named
+    assert ei.value.checkpoint_step == 4
+    assert ckpt.latest_step(str(tmp_path)) == 4
+    mgr.close()
+
+
+def test_data_iterator_fault_reraises_at_next():
+    from mxnet_tpu.io import DevicePrefetcher
+
+    batches = [(onp.full((2, 3), float(i), onp.float32),) for i in range(8)]
+    faultline.plan([{"site": "data.iterator", "kind": "error", "at": 3}])
+    pf = DevicePrefetcher(iter(batches), depth=1)
+    with pytest.raises(faultline.InjectedError):
+        for _ in range(8):
+            next(pf)
+    pf.close()

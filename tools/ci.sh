@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI entry (reference: ci/build.py + runtime_functions.sh stages).
-# Stages: lint | import | hloscan | census | smoke | test | perf | dryrun
-# | all (default: all).
+# Stages: lint | import | hloscan | census | smoke | test | chaos | perf
+# | dryrun | all (default: all).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -64,6 +64,33 @@ run_test()   {
   python -m pytest tests/test_kvstore_bucketing.py -q
   python -m pytest tests/ -q -x
 }
+run_chaos()  {
+  # chaos gate (ISSUE 9): deterministic fault injection + recovery — the
+  # resume-parity fence, the retry/step-guard policies, and the atomic
+  # checkpoint round-trip must all survive without process death
+  # (docs/RESILIENCE.md)
+  python -m pytest tests/test_resilience.py -q
+  # whole-process path: a fault plan injected via MXNET_FAULTLINE (not
+  # plan()) must fire in a fresh interpreter and be retried away, visible
+  # in mxtpu_faults_recovered_total
+  MXNET_FAULTLINE='[{"site": "kvstore.pushpull", "kind": "timeout", "at": 1}]' \
+  python - <<'EOF'
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore, telemetry
+
+kv = kvstore.create("tpu_ici")
+vals = [mx.np.array(onp.array([1.0, 2.0], onp.float32), ctx=mx.cpu(c))
+        for c in range(2)]
+kv.pushpull("k", vals)
+assert vals[0].asnumpy().tolist() == [2.0, 4.0]
+rec = telemetry.default_registry().get_sample_value(
+    "mxtpu_faults_recovered_total",
+    {"site": "kvstore.pushpull", "kind": "timeout"})
+assert rec == 1, rec
+print("ci: env-plan KV timeout injected and recovered")
+EOF
+}
 run_perf()   { python benchmark/opperf/opperf.py --smoke; }
 run_dryrun() {
   # pytest already runs the 4-process launcher test; skip it inside the
@@ -81,9 +108,10 @@ case "$stage" in
   census)  run_census ;;
   smoke)   run_smoke ;;
   test)    run_test ;;
+  chaos)   run_chaos ;;
   perf)    run_perf ;;
   dryrun)  run_dryrun ;;
   all)     run_lint; run_import; run_hloscan; run_census; run_smoke
-           run_test; run_perf; run_dryrun ;;
+           run_test; run_chaos; run_perf; run_dryrun ;;
   *) echo "unknown stage $stage" >&2; exit 2 ;;
 esac

@@ -212,7 +212,7 @@ def run(names=None, device=None, baseline_path=None,
             from mxnet_tpu.analysis import census
             for doc in docs:
                 census.publish_metrics(doc)
-        except Exception:
+        except Exception:  # mxlint: disable=swallowed-exception -- metrics mirroring is best-effort; the report itself still prints below
             pass
 
     if fmt == "json":

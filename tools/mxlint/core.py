@@ -268,7 +268,7 @@ def apply_waivers(findings, ctx):
 def unparse(node):
     try:
         return ast.unparse(node)
-    except Exception:
+    except Exception:  # mxlint: disable=swallowed-exception -- display-only helper; an unparseable synthetic node renders as empty, never fails a lint run
         return ""
 
 

@@ -31,6 +31,7 @@ def all_rules():
     from .host_sync import HostSyncInJit
     from .locks import LockDiscipline
     from .nondet_trace import NondeterministicTrace
+    from .swallow import SwallowedException
     from .threads import DaemonThreadNoShutdown
     return [
         EnvReadAtTraceTime(),
@@ -40,4 +41,5 @@ def all_rules():
         NondeterministicTrace(),
         BitsAsFloat(),
         DaemonThreadNoShutdown(),
+        SwallowedException(),
     ]

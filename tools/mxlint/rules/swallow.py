@@ -1,0 +1,116 @@
+"""swallowed-exception: broad handlers that eat the error and tell no one.
+
+Ancestor bug: ``DevicePrefetcher._feed`` caught the source's exception
+but the dtype cast and ``device_put`` ran OUTSIDE the try — an error
+there killed the feeder thread silently and the consumer hung on an
+empty queue until its liveness timeout.  The general failure mode: a
+``try: ... except Exception: pass`` (or ``return None``) turns a real
+fault into a mystery three layers later — the exact opposite of what a
+resilience layer needs, which is faults SURFACING at a recovery point.
+
+Heuristic: an ``except`` handler fires when ALL of
+
+* the caught type is broad — bare ``except:``, ``Exception``, or
+  ``BaseException`` (alone or in a tuple);
+* the body never re-raises (no ``raise`` anywhere in it);
+* the bound name (``as e``) is never used in the body — so the error
+  object provably doesn't travel anywhere (futures, queues, wrappers);
+* nothing in the body looks like reporting: no logging-style call
+  (``log.warning``/``.error``/``.exception``/...), no ``warnings.warn``,
+  no ``print``, and no telemetry tick (``.inc``/``.observe``/``.set``
+  on a metric).
+
+Handlers that genuinely must eat everything (``__del__`` during
+interpreter teardown, best-effort probes where absence is the normal
+case) carry a waiver saying so.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import Rule
+
+_BROAD = {"Exception", "BaseException"}
+
+_REPORTING_ATTRS = {
+    # logging-ish
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+    "log",
+    # telemetry-ish: ticking a counter/gauge/histogram IS reporting
+    "inc", "observe",
+}
+_REPORTING_NAMES = {"print"}
+
+
+def _name_of(node):
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _is_broad(handler):
+    """Bare ``except:`` or a type (or tuple member) named Exception/
+    BaseException."""
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(_name_of(e) in _BROAD for e in types)
+
+
+def _uses_name(body, name):
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Name) and sub.id == name:
+                return True
+    return False
+
+
+def _reports(body):
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if isinstance(f, ast.Name) and f.id in _REPORTING_NAMES:
+                return True
+            if isinstance(f, ast.Attribute) and f.attr in _REPORTING_ATTRS:
+                return True
+    return False
+
+
+def _reraises(body):
+    return any(isinstance(sub, ast.Raise)
+               for stmt in body for sub in ast.walk(stmt))
+
+
+class SwallowedException(Rule):
+    name = "swallowed-exception"
+    description = ("broad except handler (bare/Exception/BaseException) "
+                   "that neither re-raises, uses the bound exception, "
+                   "logs, prints, nor ticks telemetry — the fault "
+                   "vanishes (the DevicePrefetcher silent-feeder-death "
+                   "class)")
+
+    def check_file(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _reraises(node.body):
+                continue
+            if node.name and _uses_name(node.body, node.name):
+                continue
+            if _reports(node.body):
+                continue
+            yield ctx.finding(
+                self.name, node,
+                "broad exception handler swallows the error: no raise, "
+                "the exception object is unused, and nothing logs or "
+                "ticks a counter — a real fault here dies silently and "
+                "resurfaces as a hang or wrong answer far away; "
+                "re-raise, propagate the object (queue/future), log it, "
+                "or waive with the reason absence-is-normal")

@@ -45,6 +45,29 @@ trainer.step(2)
 assert onp.isfinite(loss.asnumpy()).all()
 print("smoke: train step ok")
 
+# 1b. resilience gate (ISSUE 9): the full-state checkpoint round-trip —
+# a snapshot of the trainer we just stepped must commit atomically and
+# restore bitwise into a FRESH net+trainer (docs/RESILIENCE.md)
+import tempfile
+from mxnet_tpu.resilience import (CheckpointManager, gather_training_state,
+                                  restore_training_state)
+with tempfile.TemporaryDirectory() as _root:
+    with CheckpointManager(_root, async_write=False, rank=0) as _mgr:
+        _arrays, _meta = gather_training_state(trainer, step=1)
+        _mgr.save(1, _arrays, _meta)
+        _net2 = mx.gluon.nn.Dense(4)
+        _net2.initialize()
+        _net2(x)  # materialize deferred shapes
+        _tr2 = mx.gluon.Trainer(_net2.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        _step, _arrays_r, _meta_r = _mgr.restore_latest()
+        assert _step == 1, _step
+        restore_training_state(_arrays_r, _meta_r, _tr2)
+        for _p, _q in zip(trainer._params, _tr2._params):
+            assert _p.data().asnumpy().tobytes() == \
+                _q.data().asnumpy().tobytes(), _p.name
+print("smoke: checkpoint round-trip ok")
+
 # 2. the serving subsystem answers one request end to end
 ep = mx.serve.Endpoint(net, max_batch_size=4, max_latency_ms=2)
 out = ep.predict(x)
@@ -141,7 +164,8 @@ EOF
 
 # 4. the driver entry points compile on the virtual mesh (the full
 # hloscan + census dryrun riders run in ci.sh's dryrun stage, not here)
-MXTPU_DRYRUN_HLOSCAN=0 MXTPU_DRYRUN_CENSUS=0 python -c "
+MXTPU_DRYRUN_HLOSCAN=0 MXTPU_DRYRUN_CENSUS=0 MXTPU_DRYRUN_RESILIENCE=0 \
+  python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 print('smoke: dryrun_multichip(8) ok')
