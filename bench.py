@@ -58,6 +58,30 @@ def _net_with_loss_classes():
     return NetWithLoss, RecNetWithLoss
 
 
+def _augmented_net_with_loss():
+    """The ISSUE-10 prologue: uint8 NHWC canvas in, random crop/flip +
+    normalize + bf16 NCHW all INSIDE the fused program (DeviceAugment) —
+    the host never touches float pixels."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.gluon.data import DeviceAugment
+
+    class AugNetWithLoss(HybridBlock):
+        def __init__(self, net, loss_fn):
+            super().__init__()
+            self.net = net
+            self.loss_fn = loss_fn
+            self.aug = DeviceAugment(
+                (224, 224), rand_crop=True, rand_mirror=True,
+                mean=(123.68, 116.779, 103.939),
+                std=(58.393, 57.12, 57.375), dtype="bfloat16")
+
+        def forward(self, x_u8, y):
+            return self.loss_fn(self.net(self.aug(x_u8)), y)
+
+    return AugNetWithLoss
+
+
 def _bench_at_batch(batch):
     import mxnet_tpu as mx
     from mxnet_tpu.gluon import loss as gloss
@@ -258,6 +282,173 @@ def _bench_recordio(batch):
     }
 
 
+def _bench_sharded(batch):
+    """ISSUE-10 rider: the three-stage pipeline end to end — sharded
+    parallel readers (decode pool) -> compact uint8 canvas over the wire
+    exactly once (``parallel.shard_put`` per-device puts) -> crop/flip/
+    normalize INSIDE the fused dp program (``DeviceAugment``) -> train
+    step on a dp mesh over all local devices.
+
+    Reports each stage's own rate (decode pool, wire, chip) so the
+    end-to-end number can be judged against max(decode, wire, chip), and
+    proves the zero-host-replication law from the telemetry transfer
+    counters: over the steady windows, ``kind="shard_put"`` bytes grow by
+    ~one batch per step while ``kind="device_put"`` bytes stay flat (the
+    fused step's place() passes pre-sharded globals through)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import env as menv, parallel
+    from mxnet_tpu import telemetry as tm
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    rec = _ensure_bench_rec()
+    side = 256  # ship the full canvas; the 224-crop happens on device
+
+    def reader(threads):
+        return mx.io.ImageRecordIter(
+            path_imgrec=rec, batch_size=batch, data_shape=(3, side, side),
+            shuffle=True, seed=7, preprocess_threads=threads)
+
+    def decode_rate(threads, iters=ITERS):
+        it = reader(threads)
+        it.next_arrays()  # first pop waits out the ring fill
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            it.next_arrays()
+        r = batch * iters / (time.perf_counter() - t0)
+        it.close()
+        return r
+
+    single_rate = decode_rate(1)
+    pool_threads = menv.decode_threads()
+    pool_rate = decode_rate(pool_threads)
+
+    mesh = parallel.make_mesh({"dp": -1})
+    sh = parallel.data_sharding(mesh)
+
+    AugNetWithLoss = _augmented_net_with_loss()
+    net = vision.resnet50_v1()
+    net.initialize(init=mx.init.Xavier())
+    net.cast("bfloat16")
+    mod = AugNetWithLoss(net, gloss.SoftmaxCrossEntropyLoss())
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1, "momentum": 0.9},
+                               kvstore="device")
+    fused = mx.gluon.FusedTrainStep(mod, trainer, mesh=mesh)
+
+    # wire rate through the sharded path itself: K pipelined shard_puts,
+    # readback of the last (same tunnel-honest methodology as the
+    # recordio rider; each byte crosses once regardless of dp degree)
+    it2 = reader(pool_threads)
+    probe_data, _ = it2.next_arrays()
+    it2.close()
+    mb = probe_data.nbytes / 2 ** 20
+    buf = parallel.shard_put(probe_data, sh)
+    onp.asarray(buf[0, 0, 0, 0])
+    t_rtt = min(_timeit(lambda: onp.asarray(buf[0, 0, 0, 0]))
+                for _ in range(3))
+
+    def wire_probe(K=4):
+        t0 = time.perf_counter()
+        bufs = [parallel.shard_put(probe_data, sh) for _ in range(K)]
+        onp.asarray(bufs[-1][0, 0, 0, 0])
+        return max(time.perf_counter() - t0 - t_rtt, 1e-9) / K
+
+    t_wire = wire_probe()
+
+    it = reader(pool_threads)
+    pf = mx.io.DevicePrefetcher(it, sharding=sh, transfer_threads=4,
+                                dtypes=(None, onp.int32))
+
+    def step():
+        x, y = next(pf)
+        return fused(x, y, batch_size=batch)
+
+    for _ in range(WARMUP):
+        loss = step()
+    loss.wait_to_read()
+    mx.waitall()
+
+    # chip-only: re-step one pre-sharded device-resident batch
+    x0, y0 = next(pf)
+    for _ in range(2):
+        fused(x0, y0, batch_size=batch)
+    mx.waitall()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        fused(x0, y0, batch_size=batch)
+    mx.waitall()
+    chip_rate = batch * ITERS / (time.perf_counter() - t0)
+
+    reg = tm.default_registry() if callable(
+        getattr(tm, "default_registry", None)) else tm.registry
+
+    def tbytes(kind):
+        v = reg.get_sample_value("mxtpu_mesh_transfer_bytes_total",
+                                 {"kind": kind})
+        return 0.0 if v is None else v
+
+    sp0, dput0 = tbytes("shard_put"), tbytes("device_put")
+    windows = []
+    for _window in range(2):
+        t0 = time.perf_counter()
+        for _ in range(RITERS):
+            step()
+        mx.waitall()
+        windows.append(batch * RITERS / (time.perf_counter() - t0))
+    sp1, dput1 = tbytes("shard_put"), tbytes("device_put")
+    t_wire = min(t_wire, wire_probe())
+    wire_rate = batch / t_wire
+    pf.close()
+    it.close()
+
+    steps = 2 * RITERS
+    sp_per_step = (sp1 - sp0) / steps
+    dput_per_step = (dput1 - dput0) / steps
+    batch_bytes = probe_data.nbytes + batch * 4  # + int32 labels
+    # the feeder rides up to `depth` batches ahead, so shard_put may land
+    # a few extra batches inside the window; 1.25x bounds that slack
+    zero_rep = dput_per_step < 4096 and sp_per_step <= 1.25 * batch_bytes
+    bound = min(pool_rate, wire_rate, chip_rate)
+    return windows, {
+        "decode_single_img_per_s": round(single_rate, 2),
+        "decode_pool_img_per_s": round(pool_rate, 2),
+        "decode_pool_threads": pool_threads,
+        "decode_pool_scaling": round(pool_rate / single_rate, 2),
+        "wire_mb_per_s": round(mb / t_wire, 2),
+        "wire_img_per_s": round(wire_rate, 2),
+        "chip_only_img_per_s": round(chip_rate, 2),
+        "overlap_bound_img_per_s": round(bound, 2),
+        "dp_devices": int(mesh.devices.size),
+        "shard_put_bytes_per_step": int(sp_per_step),
+        "device_put_bytes_per_step": int(dput_per_step),
+        "batch_bytes": int(batch_bytes),
+        "zero_host_replication": bool(zero_rep),
+    }
+
+
+def _attempt_sharded(batch):
+    try:
+        windows, comp = _bench_sharded(batch)
+    except Exception as e:
+        if "RESOURCE_EXHAUSTED" in str(e):
+            sys.exit(42)
+        raise
+    img_per_s = max(windows)
+    print(json.dumps({
+        "metric": "resnet50_train_bf16_sharded_recordio_img_per_s",
+        "value": round(img_per_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_per_s / BASELINE_IMG_PER_S, 3),
+        "vs_overlap_bound": round(
+            img_per_s / comp["overlap_bound_img_per_s"], 3),
+        "batch": batch,
+        "window_img_per_s": [round(w, 2) for w in windows],
+        "host_cpus": os.cpu_count(),
+        **comp,
+    }))
+
+
 AB_ITERS = 20
 AB_ROUNDS = 4
 
@@ -424,9 +615,13 @@ def main():
     recordio_mode = "--recordio" in sys.argv or \
         os.environ.get("BENCH_MODE") == "recordio"
     ab_mode = "--ab" in sys.argv or os.environ.get("BENCH_MODE") == "ab"
+    sharded_mode = "--sharded" in sys.argv or \
+        os.environ.get("BENCH_MODE") == "sharded"
     if os.environ.get("BENCH_BATCH"):
         if ab_mode:
             _attempt_ab(int(os.environ["BENCH_BATCH"]))
+        elif sharded_mode:
+            _attempt_sharded(int(os.environ["BENCH_BATCH"]))
         elif recordio_mode:
             _attempt_recordio(int(os.environ["BENCH_BATCH"]))
         else:
@@ -440,7 +635,7 @@ def main():
     def run_mode(mode, timeout=None):
         for batch in BATCHES:
             env = dict(os.environ, BENCH_BATCH=str(batch))
-            if mode in ("recordio", "ab"):
+            if mode in ("recordio", "ab", "sharded"):
                 env["BENCH_MODE"] = mode
             else:
                 env.pop("BENCH_MODE", None)
@@ -464,6 +659,9 @@ def main():
     if ab_mode:
         print(json.dumps(run_mode("ab")))
         return
+    if sharded_mode:
+        print(json.dumps(run_mode("sharded")))
+        return
     result = run_mode("synthetic")
     # the real-data number rides along in the same line (VERDICT r2 #1):
     # recordio_* keys give end-to-end RecordIO-fed training plus the
@@ -482,6 +680,26 @@ def main():
                 result[k] = rec[k]
         except Exception as e:  # the headline must not die with the rider
             result["recordio_error"] = str(e)[:200]
+    # ISSUE-10 rider: the sharded global-array pipeline (decode pool ->
+    # one-wire-crossing uint8 canvas via per-device shard puts -> device
+    # augment inside the program) with per-stage rates and the telemetry
+    # zero-replication proof.  BENCH_SHARDED_TIMEOUT=0 skips it.
+    sharded_timeout = float(os.environ.get("BENCH_SHARDED_TIMEOUT", "600"))
+    if sharded_timeout > 0:
+        try:
+            shd = run_mode("sharded", timeout=sharded_timeout)
+            result["sharded_recordio_img_per_s"] = shd["value"]
+            result["sharded_vs_overlap_bound"] = shd["vs_overlap_bound"]
+            for k in ("decode_single_img_per_s", "decode_pool_img_per_s",
+                      "decode_pool_threads", "decode_pool_scaling",
+                      "wire_mb_per_s", "wire_img_per_s",
+                      "chip_only_img_per_s", "overlap_bound_img_per_s",
+                      "dp_devices", "shard_put_bytes_per_step",
+                      "device_put_bytes_per_step", "batch_bytes",
+                      "zero_host_replication"):
+                result["sharded_" + k] = shd[k]
+        except Exception as e:
+            result["sharded_error"] = str(e)[:200]
     # same-window A/B rider (r3 verdict weak #1): the synthetic step and
     # the recordio-prologue step interleaved in ONE process, so the
     # chip-rate comparison is drift-free.  BENCH_AB_TIMEOUT=0 skips it.

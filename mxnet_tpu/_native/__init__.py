@@ -308,9 +308,14 @@ def img_lib():
         L.imgpipe_create.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int]
         L.imgpipe_num_records.restype = ctypes.c_int64
         L.imgpipe_num_records.argtypes = [ctypes.c_void_p]
+        L.imgpipe_part_records.restype = ctypes.c_int64
+        L.imgpipe_part_records.argtypes = [ctypes.c_void_p]
+        L.imgpipe_ready_batches.restype = ctypes.c_int
+        L.imgpipe_ready_batches.argtypes = [ctypes.c_void_p]
         L.imgpipe_decode_errors.restype = ctypes.c_int64
         L.imgpipe_decode_errors.argtypes = [ctypes.c_void_p]
         L.imgpipe_next.restype = ctypes.c_int

@@ -86,13 +86,28 @@ MXNET_KVSTORE_RETRIES        transient-fault retry budget for KV reads,
                              the serve model call (default 3 retries =
                              4 attempts; re-read per retry loop so it can
                              be tuned mid-run)
+MXNET_DECODE_THREADS         decode-pool width for the native image
+                             pipeline (``ImageRecordIter``); default
+                             falls back to MXNET_CPU_WORKER_NTHREADS
+                             (read when an iterator is constructed)
+MXNET_PREFETCH_DEPTH         ``DevicePrefetcher`` ring depth — batches
+                             resident on device ahead of compute
+                             (default 2; read when a prefetcher is
+                             constructed, including the DataLoader
+                             ``prefetch_to_device`` path)
+MXNET_IO_ERROR_TOLERANCE     decode-error fraction per window of records
+                             above which ``ImageRecordIter`` logs a
+                             WARNING and keeps ticking
+                             ``mxtpu_io_decode_errors_total`` (default
+                             0.01; read at iterator construction)
 =========================== =================================================
 """
 from __future__ import annotations
 
 import os
 
-__all__ = ["apply", "describe", "is_naive_engine", "cpu_worker_nthreads"]
+__all__ = ["apply", "describe", "is_naive_engine", "cpu_worker_nthreads",
+           "decode_threads", "prefetch_depth", "io_error_tolerance"]
 
 _naive_engine = False
 
@@ -106,6 +121,29 @@ def cpu_worker_nthreads(default=None):
     if v is None:
         return default if default is not None else (os.cpu_count() or 1)
     return max(1, int(v))
+
+
+def decode_threads(default=None):
+    """Decode-pool width for the native image pipeline; falls back to
+    the general worker knob when MXNET_DECODE_THREADS is unset."""
+    v = os.environ.get("MXNET_DECODE_THREADS")
+    if v is None:
+        return cpu_worker_nthreads(default)
+    return max(1, int(v))
+
+
+def prefetch_depth(default=2):
+    v = os.environ.get("MXNET_PREFETCH_DEPTH")
+    if v is None:
+        return default
+    return max(1, int(v))
+
+
+def io_error_tolerance(default=0.01):
+    v = os.environ.get("MXNET_IO_ERROR_TOLERANCE")
+    if v is None:
+        return default
+    return max(0.0, float(v))
 
 
 def apply():
@@ -156,5 +194,7 @@ def describe():
              "MXNET_TELEMETRY_STEADY_STEPS", "MXNET_PROFILE_RANK",
              "MXNET_PROFILE_DIR", "MXNET_KVSTORE_SPARSE_HOST_BOUND",
              "MXNET_TPU_MODEL_REPO", "MXNET_FAULTLINE",
-             "MXNET_CHECKPOINT_KEEP", "MXNET_KVSTORE_RETRIES"]
+             "MXNET_CHECKPOINT_KEEP", "MXNET_KVSTORE_RETRIES",
+             "MXNET_DECODE_THREADS", "MXNET_PREFETCH_DEPTH",
+             "MXNET_IO_ERROR_TOLERANCE"]
     return [(n, os.environ.get(n), n in __doc__) for n in names]

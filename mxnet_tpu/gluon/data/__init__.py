@@ -3,10 +3,11 @@ from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
 from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
                       IntervalSampler, FilterSampler)
 from .dataloader import DataLoader, default_batchify_fn
+from .augment import DeviceAugment
 from . import batchify
 from . import vision
 
 __all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
            "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
-           "IntervalSampler", "FilterSampler", "DataLoader",
+           "IntervalSampler", "FilterSampler", "DataLoader", "DeviceAugment",
            "default_batchify_fn", "batchify", "vision"]

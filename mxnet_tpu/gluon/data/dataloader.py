@@ -48,27 +48,38 @@ def _as_device_batch(batch):
     return batch
 
 
-def _lookahead_device(host_batches, depth):
-    """Issue async H2D transfers ``depth`` batches ahead of the consumer.
+def _prefetched_device_batches(host_batches, depth, sharding=None):
+    """Ride ``DevicePrefetcher``: a feeder thread issues async H2D
+    transfers (per-device shard puts under a ``sharding``) ``depth``
+    batches ahead of the consumer, so the wire rides concurrently with
+    device compute (reference role: `src/io/iter_prefetcher.h:1`,
+    DataLoader ``pin_memory``).
 
-    ``jax.device_put`` returns immediately with an in-flight buffer, so
-    converting batch N+1..N+depth *before* yielding batch N lets the wire
-    transfer ride concurrently with the consumer's device compute
-    (reference role: `src/io/iter_prefetcher.h:1`, DataLoader
-    ``pin_memory``)."""
+    Host batches are arbitrary pytrees (list of data/label, nested
+    tuples); each is flattened to a leaf tuple for the prefetcher and
+    reassembled in FIFO order.  The ``with`` block guarantees the feeder
+    thread never outlives an exception in the consuming loop — if the
+    user's step raises, this generator is closed and the prefetcher's
+    ``__exit__`` joins the feeder."""
+    import jax
     from collections import deque
-    q = deque()
-    it = iter(host_batches)
-    exhausted = False
-    while True:
-        while not exhausted and len(q) <= depth:
-            try:
-                q.append(_as_device_batch(next(it)))
-            except StopIteration:
-                exhausted = True
-        if not q:
-            return
-        yield q.popleft()
+
+    from ...io.prefetch import DevicePrefetcher
+
+    treedefs = deque()
+
+    def leaves():
+        for b in host_batches:
+            flat, td = jax.tree_util.tree_flatten(
+                b, is_leaf=lambda x: isinstance(x, NDArray))
+            treedefs.append(td)
+            yield tuple(f._data if isinstance(f, NDArray) else f
+                        for f in flat)
+
+    with DevicePrefetcher(leaves(), depth=depth, sharding=sharding) as pf:
+        for arrs in pf:
+            yield jax.tree_util.tree_unflatten(treedefs.popleft(),
+                                               list(arrs))
 
 
 class _Worker:
@@ -87,9 +98,16 @@ class DataLoader:
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=True, timeout=120,
-                 try_nopython=None, device=None, prefetch_to_device=False):
+                 try_nopython=None, device=None, prefetch_to_device=False,
+                 sharding=None):
         self._dataset = dataset
         self._device = device
+        # NamedSharding: the prefetcher builds dp global batches via
+        # per-device shard puts (zero host-side replication); implies
+        # the prefetch-to-device path even if not requested explicitly
+        self._sharding = sharding
+        if sharding is not None and not prefetch_to_device:
+            prefetch_to_device = True
         self._pin_memory = pin_memory  # PjRt stages host transfers itself
         # int = lookahead depth, True = 2 (double buffering)
         self._prefetch_to_device = int(prefetch_to_device) * (
@@ -135,8 +153,9 @@ class DataLoader:
         from ... import telemetry as _telemetry
 
         if self._prefetch_to_device:
-            inner = _lookahead_device(self._host_batches(),
-                                      self._prefetch_to_device)
+            inner = _prefetched_device_batches(self._host_batches(),
+                                               self._prefetch_to_device,
+                                               self._sharding)
         else:
             inner = (_as_device_batch(b) for b in self._host_batches())
         # time each batch production as the "data-wait" step phase: with

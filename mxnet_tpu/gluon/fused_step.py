@@ -271,9 +271,18 @@ class FusedTrainStep:
                     return d
                 if d.shape[0] >= self._dp_size and \
                         d.shape[0] % self._dp_size == 0:
-                    return self._global_put(
-                        d, self._data_shardings[min(d.ndim, 8) - 1])
-                return self._global_put(d, self._rep)
+                    target = self._data_shardings[min(d.ndim, 8) - 1]
+                else:
+                    target = self._rep
+                # the sharded feed path (parallel.shard_put via
+                # DevicePrefetcher/DataLoader) delivers global arrays
+                # already laid out per-device — re-placing them would
+                # re-replicate through the host, so equivalently-sharded
+                # inputs pass through untouched
+                cur = getattr(d, "sharding", None)
+                if cur is not None and cur.is_equivalent_to(target, d.ndim):
+                    return d
+                return self._global_put(d, target)
             flat = [place(d) for d in flat]
         treedef_id = _intern_treedef(treedef)
         if self._jit is None:

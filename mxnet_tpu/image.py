@@ -435,10 +435,13 @@ class ImageIter:
     def __init__(self, batch_size, data_shape, path_imgrec=None,
                  path_imglist=None, path_root="", shuffle=False,
                  aug_list=None, label_width=1, data_name="data",
-                 label_name="softmax_label", last_batch_handle="pad"):
+                 label_name="softmax_label", last_batch_handle="pad",
+                 num_parts=1, part_index=0, seed=0):
         assert (path_imgrec is None) != (path_imglist is None), \
             "pass exactly one of path_imgrec / path_imglist"
         assert len(data_shape) == 3 and data_shape[0] in (1, 3)
+        if num_parts < 1 or not 0 <= part_index < num_parts:
+            raise ValueError("need 0 <= part_index < num_parts")
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
         self.aug_list = aug_list if aug_list is not None else \
@@ -462,6 +465,10 @@ class ImageIter:
                     self._items.append((parts[-1], label))
             self._keys = list(range(len(self._items)))
         self.shuffle = shuffle
+        self.num_parts = num_parts
+        self.part_index = part_index
+        self.seed = seed
+        self._epoch = 0
         if last_batch_handle not in ("pad", "discard"):
             raise NotImplementedError(
                 f"last_batch_handle={last_batch_handle!r}: ImageIter "
@@ -479,9 +486,17 @@ class ImageIter:
         return self
 
     def reset(self):
-        self._order = list(range(len(self._keys)))
+        # same sharding law as the native pipeline: shuffle the GLOBAL
+        # index list with a (seed, epoch) generator, then take this
+        # part's strided slice — deterministic per (seed, epoch, part)
+        # and an exact partition across parts
+        order = onp.arange(len(self._keys))
         if self.shuffle:
-            onp.random.shuffle(self._order)
+            rng = onp.random.default_rng(
+                (self.seed, self._epoch) if self.seed else None)
+            rng.shuffle(order)
+        self._order = list(order[self.part_index::self.num_parts])
+        self._epoch += 1
         self._cursor = 0
 
     def _read_one(self, i):

@@ -9,15 +9,26 @@ decode JPEG (libjpeg-turbo, DCT-domain downscale) and augment entirely
 outside the GIL into a ring of batch slots; Python pops completed
 batches.
 
+Per-host sharding (`num_parts`/`part_index`, reference
+ImageRecParserParam) gives each host a strided slice of the epoch's
+GLOBAL shuffle permutation, so every part's sample order is a pure
+function of (seed, epoch, part) and the union over parts is an exact
+partition of the record file — the pod-scale input treatment from the
+MLPerf TPU work.
+
 Output is NHWC uint8 batches (the TPU-preferred layout); mean/std
 normalization and dtype casting belong on device, fused by XLA into the
 first conv — do NOT normalize on host.  ``layout='NCHW'`` transposes on
-device for reference-parity consumers.
+device for reference-parity consumers.  For train-time crop/flip on
+device (host ships the pre-crop canvas), see
+``gluon.data.DeviceAugment``.
 """
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
+import time
 
 import numpy as onp
 
@@ -27,18 +38,42 @@ from .io import DataBatch, DataDesc, DataIter
 __all__ = ["ImageRecordIter"]
 
 
+def _io_metrics():
+    from .. import telemetry as _tm
+
+    return (
+        _tm.counter("mxtpu_io_decode_errors_total",
+                    "Records the native image pipeline failed to decode "
+                    "(zero-filled and counted, never dropped)"),
+        _tm.counter("mxtpu_io_batches_total",
+                    "Batches popped from the native decode ring"),
+        _tm.gauge("mxtpu_io_ring_ready",
+                  "Completed batches waiting in the decode ring at the "
+                  "last pop (0 while compute waits = decode-bound)"),
+        _tm.histogram("mxtpu_io_next_wait_seconds",
+                      "Consumer wait for the next completed batch"),
+    )
+
+
 class ImageRecordIter(DataIter):
     """Reference-parity constructor args (`io/iter_image_recordio_2.cc`
     ImageRecordParam/ImageRecParserParam subset that is meaningful here).
 
     data_shape is channel-first (C, H, W) as in the reference; delivery is
     NHWC unless ``layout='NCHW'``.
+
+    ``num_parts``/``part_index`` shard the file across hosts: part ``p``
+    reads ``perm[p::num_parts]`` of each epoch's global permutation —
+    bit-deterministic per (seed, epoch, part), exact partition by
+    construction.  ``preprocess_threads`` defaults to
+    ``MXNET_DECODE_THREADS`` (then ``MXNET_CPU_WORKER_NTHREADS``).
     """
 
     def __init__(self, path_imgrec, batch_size, data_shape=(3, 224, 224),
                  resize=0, rand_crop=False, rand_mirror=False,
                  shuffle=False, preprocess_threads=None, prefetch_buffer=3,
-                 seed=0, layout="NHWC", round_batch=True, **_compat):
+                 seed=0, num_parts=1, part_index=0, layout="NHWC",
+                 round_batch=True, **_compat):
         from .._native import img_lib
 
         super().__init__(batch_size=batch_size)
@@ -50,8 +85,10 @@ class ImageRecordIter(DataIter):
         c, h, w = data_shape
         assert c == 3, "pipeline decodes RGB"
         if preprocess_threads is None:
-            from ..env import cpu_worker_nthreads
-            preprocess_threads = cpu_worker_nthreads()  # MXNET_CPU_WORKER_NTHREADS
+            from ..env import decode_threads
+            preprocess_threads = decode_threads()  # MXNET_DECODE_THREADS
+        from ..env import io_error_tolerance
+        self._err_tolerance = io_error_tolerance()
         self._lib = L
         self._h, self._w = h, w
         self._layout = layout
@@ -59,12 +96,22 @@ class ImageRecordIter(DataIter):
             path_imgrec.encode(), batch_size, h, w, int(resize),
             int(preprocess_threads), int(prefetch_buffer),
             int(bool(rand_crop)), int(bool(rand_mirror)),
-            int(bool(shuffle)), int(seed))
+            int(bool(shuffle)), int(seed), int(num_parts), int(part_index))
         if not self._handle:
             raise IOError(L.imgpipe_last_error().decode())
         self._num_records = L.imgpipe_num_records(self._handle)
-        self._batches_per_epoch = self._num_records // batch_size
+        self._part_records = L.imgpipe_part_records(self._handle)
+        self._batches_per_epoch = self._part_records // batch_size
+        if self._batches_per_epoch == 0:
+            # tiny shard: still deliver one (wrapping) batch per epoch
+            self._batches_per_epoch = 1
         self._cursor = 0
+        # decode-error watermark for the per-window WARNING
+        self._err_seen = 0
+        self._err_window_base = 0
+        self._err_window_records = 0
+        self._err_ctr, self._batch_ctr, self._ring_gauge, self._wait_hist = \
+            _io_metrics()
         shape = (batch_size, c, h, w) if layout == "NCHW" else \
             (batch_size, h, w, c)
         self.provide_data = [DataDesc("data", shape, onp.uint8)]
@@ -76,8 +123,43 @@ class ImageRecordIter(DataIter):
         return self._num_records
 
     @property
+    def part_records(self):
+        """Records owned by this (num_parts, part_index) shard."""
+        return self._part_records
+
+    @property
     def decode_errors(self):
         return self._lib.imgpipe_decode_errors(self._handle)
+
+    @property
+    def ready_batches(self):
+        """Completed batches waiting in the decode ring (occupancy)."""
+        return self._lib.imgpipe_ready_batches(self._handle)
+
+    def _account_errors(self):
+        """Tick the error counter by delta and WARN when the fraction of
+        the current window exceeds MXNET_IO_ERROR_TOLERANCE.  Windows are
+        one epoch's worth of records (cheap, and a corrupt file region is
+        revisited every epoch so the warning re-fires)."""
+        errs = self.decode_errors
+        delta = errs - self._err_seen
+        if delta > 0:
+            self._err_ctr.inc(delta)
+            self._err_seen = errs
+        self._err_window_records += self.batch_size
+        window = max(self._part_records, self.batch_size)
+        if self._err_window_records >= window:
+            frac = (errs - self._err_window_base) / \
+                max(1, self._err_window_records)
+            if frac > self._err_tolerance:
+                logging.getLogger("mxnet_tpu.io").warning(
+                    "ImageRecordIter: %.2f%% of the last %d records failed "
+                    "to decode (tolerance %.2f%%) — corrupt records are "
+                    "zero-filled, check the .rec file",
+                    100.0 * frac, self._err_window_records,
+                    100.0 * self._err_tolerance)
+            self._err_window_base = errs
+            self._err_window_records = 0
 
     def next_arrays(self):
         """One batch as host numpy (NHWC uint8, f32 labels) — the
@@ -85,10 +167,15 @@ class ImageRecordIter(DataIter):
         n = self.batch_size
         data = onp.empty((n, self._h, self._w, 3), onp.uint8)
         labels = onp.empty((n,), onp.float32)
+        t0 = time.perf_counter()
         self._lib.imgpipe_next(
             self._handle,
             data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        self._wait_hist.observe(time.perf_counter() - t0)
+        self._batch_ctr.inc()
+        self._ring_gauge.set(self.ready_batches)
+        self._account_errors()
         return data, labels
 
     def next(self):

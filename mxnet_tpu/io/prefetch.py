@@ -12,6 +12,14 @@ transfer — the transfer and the previous step's compute proceed
 concurrently and the step-time law becomes ``max(feed, compute)`` instead
 of ``feed + compute``.
 
+With ``sharding=`` the feeder builds GLOBAL dp batches: each device gets
+its shard by one direct ``device_put`` (`parallel.shard_put`), so the wire
+carries each byte exactly once and the fused step consumes the array with
+zero host-side replication (its ``place()`` passes equivalently-sharded
+inputs through).  This replaces the old chunk-and-concatenate
+multi-stream path, which burned a device concat kernel and still
+replicated under a mesh.
+
 Two entry points:
 
 - :class:`DevicePrefetcher` — wraps any source yielding tuples of host
@@ -27,7 +35,6 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 
 import jax
-import jax.numpy as jnp
 import numpy as onp
 
 from ..context import Context, current_context
@@ -37,6 +44,20 @@ from .io import DataBatch, DataIter
 __all__ = ["DevicePrefetcher"]
 
 _STOP = object()
+
+
+def _prefetch_metrics():
+    from .. import telemetry as _tm
+
+    return (
+        _tm.counter("mxtpu_prefetch_batches_total",
+                    "Batches delivered by DevicePrefetcher"),
+        _tm.gauge("mxtpu_prefetch_ring_occupancy",
+                  "Transferred batches queued ahead of the consumer at "
+                  "the last pop (0 while compute waits = feed-bound)"),
+        _tm.histogram("mxtpu_prefetch_wait_seconds",
+                      "Consumer wait for the next device-resident batch"),
+    )
 
 
 class DevicePrefetcher:
@@ -49,39 +70,72 @@ class DevicePrefetcher:
         consumed through ``next_arrays()`` when available (zero-copy host
         path), else ``next()``.  A callable is invoked per batch.
     ctx : Context, optional
-        Target device (default: current context).
-    depth : int
-        Ring depth — how many batches may be in flight (decoded + queued on
-        the wire) ahead of the consumer.  2 suffices for steady state
-        (double buffering); 3 absorbs decode jitter.
+        Target device (default: current context).  Ignored when
+        ``sharding`` is given.
+    depth : int, optional
+        Ring depth — how many batches may be in flight (decoded + queued
+        on the wire) ahead of the consumer.  Default
+        ``MXNET_PREFETCH_DEPTH`` (2): double buffering suffices for
+        steady state; 3 absorbs decode jitter.
     dtypes : tuple, optional
         Per-element dtype casts applied host-side before transfer (cheap on
         host; avoids an on-device cast dispatch for e.g. f32->i32 labels).
+    sharding : jax.sharding.NamedSharding, optional
+        Build dp GLOBAL arrays: the spec is truncated to each array's
+        rank (a rank-2 data spec still places rank-1 labels), arrays
+        whose leading dim does not divide over the mesh replicate.  The
+        per-device shard puts run concurrently on ``transfer_threads``.
+    transfer_threads : int
+        Pool width for the concurrent per-shard puts of the sharded
+        path (default 1 = sequential; use ~device count).  Without
+        ``sharding`` the single ``device_put`` needs no pool.
+    chunk_threshold : int, optional
+        Deprecated, ignored — the chunk-and-concatenate multi-stream
+        path is gone (it burned a device concat kernel; the sharded
+        path places per-device shards instead).
 
     Iteration yields tuples of device-resident NDArrays.  The transfer for
     a yielded batch may still be on the wire — PjRt serializes any compute
     consuming it after the transfer completes, which is exactly the overlap
     contract.  StopIteration from the source ends the stream; call
     ``reset()`` to rearm (source must support reset) or ``close()`` to
-    reclaim the feeder thread.
+    reclaim the feeder thread.  Use as a context manager so the feeder
+    can never outlive an exception in the consuming loop:
+
+    >>> with DevicePrefetcher(src, sharding=parallel.data_sharding(mesh)) as pf:
+    ...     for x, y in pf:
+    ...         step(x, y)
     """
 
-    def __init__(self, source, ctx=None, depth=2, dtypes=None,
-                 transfer_threads=1, chunk_threshold=1 << 20):
+    def __init__(self, source, ctx=None, depth=None, dtypes=None,
+                 sharding=None, transfer_threads=1, chunk_threshold=None):
+        if depth is None:
+            from ..env import prefetch_depth
+            depth = prefetch_depth()  # MXNET_PREFETCH_DEPTH
         self._ctx = Context(ctx) if ctx is not None else current_context()
         self._dev = self._ctx.jax_device()
         self._depth = max(1, int(depth))
         self._dtypes = dtypes
         self._source = source
-        # transfer_threads > 1 splits big arrays along axis 0, puts the
-        # chunks from a pool, and concatenates on device — worth trying on
-        # transports that multiplex concurrent streams; on the shared axon
-        # tunnel A/B runs showed no consistent win, so default is 1
+        self._sharding = sharding
         self._tthreads = max(1, int(transfer_threads))
-        self._chunk_threshold = chunk_threshold
         self._pool = (ThreadPoolExecutor(self._tthreads,
                                          thread_name_prefix="mxtpu-h2d")
                       if self._tthreads > 1 else None)
+        if sharding is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            mesh, spec = sharding.mesh, sharding.spec
+            self._rep = NamedSharding(mesh, PartitionSpec())
+            self._rank_shardings = [
+                NamedSharding(mesh, PartitionSpec(*spec[:r]))
+                for r in range(1, 9)]
+            lead = spec[0] if len(spec) else None
+            self._dp_size = 1
+            for name in ((lead,) if isinstance(lead, str) else (lead or ())):
+                self._dp_size *= mesh.shape[name]
+        self._batch_ctr, self._ring_gauge, self._wait_hist = \
+            _prefetch_metrics()
         self._q = queue.Queue(maxsize=self._depth)
         self._stop = threading.Event()
         self._thread = None
@@ -89,15 +143,17 @@ class DevicePrefetcher:
         self._start()
 
     def _put(self, a):
-        """One array to device: chunked multi-stream put when large."""
-        if (self._pool is None or a.nbytes < self._chunk_threshold
-                or a.ndim == 0 or a.shape[0] < 2):
+        """One array to device: per-shard global placement under a
+        sharding, plain async device_put otherwise."""
+        if self._sharding is None:
             return jax.device_put(a, self._dev)
-        n = min(self._tthreads, a.shape[0])
-        chunks = onp.array_split(a, n, axis=0)
-        parts = list(self._pool.map(
-            lambda c: jax.device_put(c, self._dev), chunks))
-        return jnp.concatenate(parts, axis=0)
+        from ..parallel.mesh import shard_put
+
+        if (a.ndim == 0 or a.shape[0] < self._dp_size
+                or a.shape[0] % self._dp_size):
+            return shard_put(a, self._rep, pool=self._pool)
+        return shard_put(a, self._rank_shardings[min(a.ndim, 8) - 1],
+                         pool=self._pool)
 
     # ------------------------------------------------------------------
     def _pull(self):
@@ -153,8 +209,11 @@ class DevicePrefetcher:
         return self
 
     def __next__(self):
+        import time as _time
+
         if self._done:
             raise StopIteration
+        t0 = _time.perf_counter()
         while True:
             try:
                 item = self._q.get(timeout=1.0)
@@ -171,6 +230,9 @@ class DevicePrefetcher:
         if isinstance(item, Exception):
             self._done = True
             raise item
+        self._wait_hist.observe(_time.perf_counter() - t0)
+        self._batch_ctr.inc()
+        self._ring_gauge.set(self._q.qsize())
         return tuple(NDArray(b, ctx=self._ctx) for b in item)
 
     next = __next__
@@ -208,6 +270,15 @@ class DevicePrefetcher:
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *_exc):
+        # the feeder must never outlive an exception in the consuming
+        # loop: close() drains and joins unconditionally
+        self.close()
+        return False
 
     def __del__(self):
         try:

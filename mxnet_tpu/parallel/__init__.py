@@ -8,7 +8,7 @@ collectives (psum/all-gather/reduce-scatter) and schedules them over ICI.
 from .mesh import (
     make_mesh, current_mesh, mesh_scope, data_sharding, replicated_sharding,
     match_partition_rules, shard_parameters, constrain, global_put,
-    init_distributed,
+    shard_put, init_distributed,
 )
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
@@ -19,7 +19,7 @@ from .layers import MoEFFN, GPipeMLP
 __all__ = [
     "make_mesh", "current_mesh", "mesh_scope", "data_sharding",
     "replicated_sharding", "match_partition_rules", "shard_parameters",
-    "global_put",
+    "global_put", "shard_put",
     "constrain", "ring_attention", "ulysses_attention", "init_distributed",
     "pipeline_apply", "moe_ffn", "init_moe_params", "moe_partition_specs",
     "shard_moe_params", "MoEFFN", "GPipeMLP",

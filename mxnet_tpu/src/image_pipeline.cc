@@ -107,6 +107,12 @@ struct Pipeline {
   bool rand_crop = false, rand_mirror = false, shuffle = false;
   uint64_t seed = 0;
   int depth = 3;
+  // per-host sharding: this reader owns the strided slice
+  // perm[part_index::num_parts] of each epoch's GLOBAL permutation, so
+  // every part's order is a pure function of (seed, epoch, part) and the
+  // union over parts is an exact partition of the record file
+  int num_parts = 1, part_index = 0;
+  uint64_t part_n = 0;        // records owned by this part
 
   // epoch order cache (shared_ptr snapshots: a worker holds its epoch's
   // permutation by refcount, so regeneration for a later epoch can never
@@ -261,7 +267,6 @@ struct Pipeline {
 
   void worker(int wid) {
     std::mt19937_64 rng(seed ^ (0xabcdef12345678ull + wid));
-    const uint64_t n = recs.size();
     while (!stop.load(std::memory_order_relaxed)) {
       uint64_t i = next_index.fetch_add(1);
       uint64_t batch_no = i / batch;
@@ -274,8 +279,11 @@ struct Pipeline {
         });
       }
       if (stop.load(std::memory_order_relaxed)) break;
-      uint64_t epoch = i / n;
-      uint32_t rec = (*epoch_order(epoch))[i % n];
+      // i counts PART-LOCAL samples; map to the part's strided view of
+      // the epoch's global permutation
+      uint64_t epoch = i / part_n;
+      uint64_t j = uint64_t(part_index) + (i % part_n) * uint64_t(num_parts);
+      uint32_t rec = (*epoch_order(epoch))[j];
       uint8_t *out = s.data.data() + size_t(i % batch) * H * W * C;
       float label = -1.f;
       bool ok = decode_one(base + recs[rec].first, recs[rec].second, out,
@@ -290,6 +298,17 @@ struct Pipeline {
         s.cv_ready.notify_all();
       }
     }
+  }
+
+  int ready_batches() const {
+    // gauge only (racy reads are fine): completed slots the consumer has
+    // not yet popped — 0 while compute waits means the decode pool, not
+    // the chip, bounds the run
+    int n = 0;
+    for (const auto &s : slots) {
+      if (s->completed.load(std::memory_order_relaxed) == batch) ++n;
+    }
+    return n;
   }
 
   int next(uint8_t *out_data, float *out_labels) {
@@ -341,7 +360,7 @@ const char *imgpipe_last_error() { return g_err.c_str(); }
 void *imgpipe_create(const char *path, int batch, int h, int w,
                      int resize_short, int nthreads, int depth,
                      int rand_crop, int rand_mirror, int shuffle,
-                     uint64_t seed) {
+                     uint64_t seed, int num_parts, int part_index) {
   auto p = std::make_unique<Pipeline>();
   p->fd = open(path, O_RDONLY);
   if (p->fd < 0) {
@@ -373,6 +392,21 @@ void *imgpipe_create(const char *path, int batch, int h, int w,
   p->rand_mirror = rand_mirror != 0;
   p->shuffle = shuffle != 0;
   p->seed = seed;
+  if (num_parts < 1 || part_index < 0 || part_index >= num_parts) {
+    g_err = "invalid shard: need 0 <= part_index < num_parts";
+    return nullptr;
+  }
+  p->num_parts = num_parts;
+  p->part_index = part_index;
+  {
+    uint64_t n = p->recs.size();
+    uint64_t pi = uint64_t(part_index), np = uint64_t(num_parts);
+    p->part_n = n > pi ? (n - pi + np - 1) / np : 0;
+  }
+  if (p->part_n == 0) {
+    g_err = "shard owns no records (num_parts exceeds record count?)";
+    return nullptr;
+  }
   p->depth = depth < 2 ? 2 : depth;
   if (nthreads < 1) nthreads = 1;
   for (int i = 0; i < p->depth; ++i) {
@@ -390,6 +424,15 @@ void *imgpipe_create(const char *path, int batch, int h, int w,
 
 int64_t imgpipe_num_records(void *h) {
   return int64_t(static_cast<Pipeline *>(h)->recs.size());
+}
+
+int64_t imgpipe_part_records(void *h) {
+  return int64_t(static_cast<Pipeline *>(h)->part_n);
+}
+
+// Completed batches waiting in the ring (occupancy gauge for telemetry).
+int imgpipe_ready_batches(void *h) {
+  return static_cast<Pipeline *>(h)->ready_batches();
 }
 
 int64_t imgpipe_decode_errors(void *h) {

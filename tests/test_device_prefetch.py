@@ -45,13 +45,69 @@ def test_prefetcher_dtype_cast_and_callable():
     pf.close()
 
 
-def test_prefetcher_chunked_transfer_matches():
+def test_prefetcher_transfer_threads_compat():
+    """transfer_threads now sizes the sharded path's put pool; without a
+    sharding it must still round-trip (the old chunk-and-concatenate
+    path is gone)."""
     data = onp.random.randint(0, 255, (8, 16, 16, 3), onp.uint8)
     pf = DevicePrefetcher(iter([(data,)]), transfer_threads=4,
-                          chunk_threshold=1)  # force the chunked path
+                          chunk_threshold=1)  # deprecated arg, ignored
     (x,) = next(pf)
     onp.testing.assert_array_equal(x.asnumpy(), data)
     pf.close()
+
+
+def test_prefetcher_context_manager_joins_feeder():
+    """ISSUE 10 satellite: the feeder thread must not outlive an
+    exception raised in the consuming loop."""
+    import threading
+
+    before = {t.name for t in threading.enumerate()}
+    with pytest.raises(RuntimeError, match="user code blew up"):
+        with DevicePrefetcher(iter([(onp.zeros((2, 2), onp.float32),)] * 8),
+                              depth=2) as pf:
+            next(pf)
+            raise RuntimeError("user code blew up")
+    live = [t for t in threading.enumerate()
+            if t.name.startswith("mxtpu-device-prefetch")
+            and t.name not in before and t.is_alive()]
+    assert not live, f"feeder threads leaked: {live}"
+
+
+def test_prefetcher_depth_env_default(monkeypatch):
+    monkeypatch.setenv("MXNET_PREFETCH_DEPTH", "5")
+    pf = DevicePrefetcher(iter([]))
+    assert pf._depth == 5
+    pf.close()
+
+
+def test_prefetcher_sharded_global_batches():
+    """sharding= builds dp global arrays by per-device shard puts; rank-1
+    labels place under the truncated spec, indivisible extras replicate."""
+    import jax
+
+    from mxnet_tpu import parallel
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device (virtual) mesh")
+    mesh = parallel.make_mesh({"dp": -1})
+    sh = parallel.data_sharding(mesh)
+    dp = len(jax.devices())
+    batches = [(onp.full((2 * dp, 3), i, onp.float32),
+                onp.arange(2 * dp, dtype=onp.float32),
+                onp.ones((3,), onp.float32))  # indivisible -> replicated
+               for i in range(3)]
+    with DevicePrefetcher(iter(batches), sharding=sh,
+                          transfer_threads=4) as pf:
+        seen = list(pf)
+    assert len(seen) == 3
+    for i, (x, y, z) in enumerate(seen):
+        onp.testing.assert_array_equal(x.asnumpy(), batches[i][0])
+        onp.testing.assert_array_equal(y.asnumpy(), batches[i][1])
+        onp.testing.assert_array_equal(z.asnumpy(), batches[i][2])
+        assert x._data.sharding.is_equivalent_to(sh, 2)
+        assert y._data.sharding.is_equivalent_to(sh, 1)
+        assert z._data.sharding.is_fully_replicated
 
 
 def test_prefetcher_dataiter_source_and_reset():

@@ -128,3 +128,110 @@ def test_throughput_floor(rec_file):
     rate = n / (time.perf_counter() - t0)
     it.close()
     assert rate > 200, f"native pipeline too slow: {rate:.0f} img/s"
+
+def _part_order(path, num_parts, part_index, seed, batches=6, **kw):
+    it = _iter(path, batch_size=4, shuffle=True, seed=seed,
+               num_parts=num_parts, part_index=part_index, **kw)
+    labs = []
+    for _ in range(batches):
+        _d, l = it.next_arrays()
+        labs.extend(int(x) for x in l)
+    it.close()
+    return labs
+
+
+def test_sharded_epoch_determinism(rec_file):
+    """Same (seed, num_parts, part_index) -> bit-identical sample order
+    across two FRESH constructions (ISSUE 10 satellite)."""
+    path, _ = rec_file
+    assert _part_order(path, 2, 0, seed=7) == _part_order(path, 2, 0, seed=7)
+    assert _part_order(path, 2, 1, seed=7) == _part_order(path, 2, 1, seed=7)
+    # seed changes the order
+    assert _part_order(path, 2, 0, seed=7) != _part_order(path, 2, 0, seed=8)
+
+
+def test_sharded_parts_exact_partition(rec_file):
+    """Union of the parts' first epochs is the record file, exactly once
+    each — the strided-slice sharding law."""
+    path, _ = rec_file
+    for num_parts in (2, 3):
+        per_epoch = 48 // num_parts // 4  # batches of 4
+        union = []
+        for p in range(num_parts):
+            it = _iter(path, batch_size=4, shuffle=True, seed=11,
+                       num_parts=num_parts, part_index=p)
+            assert it.part_records == 48 // num_parts
+            for _ in range(per_epoch):
+                _d, l = it.next_arrays()
+                union.extend(int(x) for x in l)
+            it.close()
+        assert sorted(union) == list(range(48))
+
+
+def test_sharded_decode_pool_parity(rec_file):
+    """A multi-thread decode pool must deliver the same per-part order as
+    a single worker (order is owned by the slot protocol, not by thread
+    scheduling)."""
+    path, _ = rec_file
+    assert _part_order(path, 2, 1, seed=9, preprocess_threads=1) == \
+        _part_order(path, 2, 1, seed=9, preprocess_threads=4)
+
+
+def test_shard_validation(rec_file):
+    path, _ = rec_file
+    with pytest.raises(IOError, match="part_index"):
+        _iter(path, num_parts=2, part_index=2)
+    with pytest.raises(IOError, match="part_index"):
+        _iter(path, num_parts=0)
+
+
+def test_ready_batches_gauge(rec_file):
+    path, _ = rec_file
+    it = _iter(path, prefetch_buffer=3)
+    it.next_arrays()
+    assert 0 <= it.ready_batches <= 3
+    it.close()
+
+
+@pytest.fixture()
+def corrupt_rec_file(tmp_path):
+    """20 records: every other one is valid JPEG, the rest garbage bytes
+    behind a valid IRHeader (decode fails, record survives framing)."""
+    path = str(tmp_path / "corrupt.rec")
+    w = recordio.MXRecordIO(path, "w")
+    rs = onp.random.RandomState(1)
+    for i in range(20):
+        if i % 2 == 0:
+            buf = pio.BytesIO()
+            PIL.fromarray(rs.randint(0, 255, (64, 64, 3), dtype=onp.uint8)
+                          ).save(buf, "JPEG")
+            payload = buf.getvalue()
+        else:
+            payload = b"\xff\xd8not-a-jpeg" + bytes(rs.randint(
+                0, 255, 500, dtype=onp.uint8))
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0), payload))
+    w.close()
+    return path
+
+
+def test_decode_error_warning_and_counter(corrupt_rec_file, caplog):
+    """ISSUE 10 satellite: a corrupt-record fraction above
+    MXNET_IO_ERROR_TOLERANCE logs a WARNING and ticks
+    mxtpu_io_decode_errors_total (errors used to accumulate silently)."""
+    import logging
+
+    from mxnet_tpu import telemetry as tm
+
+    reg = tm.default_registry() if callable(
+        getattr(tm, "default_registry", None)) else tm.registry
+    before = reg.get_sample_value("mxtpu_io_decode_errors_total") or 0.0
+    it = mx.io.ImageRecordIter(corrupt_rec_file, batch_size=4,
+                               data_shape=(3, 32, 32), preprocess_threads=1)
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.io"):
+        for _ in range(5):  # one full pass over the 20 records
+            it.next_arrays()
+    assert it.decode_errors == 10  # the 10 garbage records, zero-filled
+    after = reg.get_sample_value("mxtpu_io_decode_errors_total")
+    assert after - before == 10
+    assert any("failed to decode" in r.message for r in caplog.records)
+    it.close()

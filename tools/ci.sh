@@ -62,6 +62,9 @@ run_test()   {
   # bucketed collectives (ISSUE 4): the allreduce path every multi-device
   # trainer step rides — bit-parity vs per-key must fail fast
   python -m pytest tests/test_kvstore_bucketing.py -q
+  # input pipeline (ISSUE 10): sharded readers, device augment, and the
+  # sharded global-array feed — the path every real-data bench rides
+  python -m pytest tests/test_image_record.py tests/test_input_pipeline.py -q
   python -m pytest tests/ -q -x
 }
 run_chaos()  {
