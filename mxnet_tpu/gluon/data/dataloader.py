@@ -109,9 +109,10 @@ class DataLoader:
         if sharding is not None and not prefetch_to_device:
             prefetch_to_device = True
         self._pin_memory = pin_memory  # PjRt stages host transfers itself
-        # int = lookahead depth, True = 2 (double buffering)
-        self._prefetch_to_device = int(prefetch_to_device) * (
-            2 if prefetch_to_device is True else 1)
+        # int = explicit lookahead depth; True (incl. implied by
+        # sharding=) defers to MXNET_PREFETCH_DEPTH via
+        # DevicePrefetcher(depth=None)
+        self._prefetch_to_device = prefetch_to_device
 
         if batch_sampler is None:
             if batch_size is None:
@@ -153,9 +154,10 @@ class DataLoader:
         from ... import telemetry as _telemetry
 
         if self._prefetch_to_device:
+            depth = (None if self._prefetch_to_device is True
+                     else int(self._prefetch_to_device))
             inner = _prefetched_device_batches(self._host_batches(),
-                                               self._prefetch_to_device,
-                                               self._sharding)
+                                               depth, self._sharding)
         else:
             inner = (_as_device_batch(b) for b in self._host_batches())
         # time each batch production as the "data-wait" step phase: with

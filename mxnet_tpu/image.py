@@ -492,8 +492,11 @@ class ImageIter:
         # and an exact partition across parts
         order = onp.arange(len(self._keys))
         if self.shuffle:
-            rng = onp.random.default_rng(
-                (self.seed, self._epoch) if self.seed else None)
+            # seed=0 is a VALID deterministic seed (matching epoch_order()
+            # in image_pipeline.cc) — never fall through to OS entropy, or
+            # each part would draw a different global permutation and the
+            # strided slices would stop being a partition
+            rng = onp.random.default_rng((self.seed, self._epoch))
             rng.shuffle(order)
         self._order = list(order[self.part_index::self.num_parts])
         self._epoch += 1

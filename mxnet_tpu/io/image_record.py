@@ -101,7 +101,15 @@ class ImageRecordIter(DataIter):
             raise IOError(L.imgpipe_last_error().decode())
         self._num_records = L.imgpipe_num_records(self._handle)
         self._part_records = L.imgpipe_part_records(self._handle)
-        self._batches_per_epoch = self._part_records // batch_size
+        # all parts must deliver the SAME number of batches per epoch:
+        # part sizes differ by up to one record (perm[p::num_parts]), and
+        # in lockstep SPMD a per-host batch-count mismatch desyncs the
+        # hosts at the epoch boundary — collectives mismatch or hang.
+        # Derive the count from the minimum part size floor(n/num_parts);
+        # the native stream wraps, so a larger part's surplus records
+        # simply roll into its next epoch.
+        self._batches_per_epoch = \
+            (self._num_records // int(num_parts)) // batch_size
         if self._batches_per_epoch == 0:
             # tiny shard: still deliver one (wrapping) batch per epoch
             self._batches_per_epoch = 1

@@ -156,9 +156,12 @@ def shard_put(value, sharding, pool=None):
     which is the input-feed law the prefetcher needs.
 
     Falls back to :func:`global_put` when the shape does not tile under
-    the sharding (indivisible leading dim, scalar).  Bytes are counted
-    once under ``kind="shard_put"`` — a bench asserting zero host-side
-    replication diffs this series against batch bytes.
+    the sharding (indivisible leading dim, scalar).  The
+    ``kind="shard_put"`` bytes series counts what the wire actually
+    carried — sum of per-shard bytes, so a tiled placement reads 1x the
+    host bytes and a replicated one reads num_devices x; a bench
+    asserting zero host-side replication diffs this series against batch
+    bytes.
     """
     host = onp.asarray(value)
     try:
@@ -179,7 +182,12 @@ def shard_put(value, sharding, pool=None):
     else:
         shards = [jax.device_put(host[idx], d) for d, idx in items]
     total.labels(kind="shard_put").inc()
-    bytes_.labels(kind="shard_put").inc(int(host.nbytes))
+    # sum the bytes each put actually carried: a tiled sharding counts
+    # host.nbytes exactly once, a replicated placement (rank-0 / leading
+    # dim that does not divide the mesh) shows num_devices x — the
+    # telemetry must expose replication, not assume it away
+    bytes_.labels(kind="shard_put").inc(
+        sum(int(s.nbytes) for s in shards))
     return jax.make_array_from_single_device_arrays(
         host.shape, sharding, shards)
 

@@ -159,6 +159,34 @@ def test_dataloader_prefetch_to_device():
         assert len(list(dl)) == 4
 
 
+def test_dataloader_prefetch_depth_env(monkeypatch):
+    """REVIEW fix: prefetch_to_device=True must defer the ring depth to
+    MXNET_PREFETCH_DEPTH (env.py documents the var as covering this
+    path); an explicit integer still wins."""
+    import mxnet_tpu.io.prefetch as pf_mod
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    depths = []
+    real = pf_mod.DevicePrefetcher
+
+    class Spy(real):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            depths.append(self._depth)
+
+    monkeypatch.setattr(pf_mod, "DevicePrefetcher", Spy)
+    monkeypatch.setenv("MXNET_PREFETCH_DEPTH", "4")
+    x = onp.random.uniform(size=(8, 3)).astype(onp.float32)
+    y = onp.arange(8, dtype=onp.float32)
+    ds = ArrayDataset(x, y)
+    assert len(list(DataLoader(ds, batch_size=4,
+                               prefetch_to_device=True))) == 2
+    assert depths == [4]
+    assert len(list(DataLoader(ds, batch_size=4,
+                               prefetch_to_device=3))) == 2
+    assert depths[-1] == 3
+
+
 def test_prefetcher_midstream_poison_reraises_not_hangs():
     """Regression (ISSUE 9): a source that dies MID-stream must surface
     its exception at ``__next__`` — the old feeder died silently and the

@@ -168,6 +168,23 @@ def test_sharded_parts_exact_partition(rec_file):
         assert sorted(union) == list(range(48))
 
 
+def test_sharded_equal_batches_per_epoch(rec_file):
+    """REVIEW fix: when num_parts does not divide the record count, part
+    sizes differ by one — every part must still report the SAME number of
+    batches per epoch (floor(n/num_parts)//batch_size), or lockstep SPMD
+    hosts desync at the epoch boundary."""
+    path, _ = rec_file
+    # 48 records over 5 parts: sizes 10,10,10,9,9; batch 5 would give
+    # 2,2,2,1,1 batches if derived from part_records
+    counts = []
+    for p in range(5):
+        it = _iter(path, batch_size=5, shuffle=True, seed=11,
+                   num_parts=5, part_index=p)
+        counts.append(sum(1 for _ in it))
+        it.close()
+    assert counts == [(48 // 5) // 5] * 5, counts
+
+
 def test_sharded_decode_pool_parity(rec_file):
     """A multi-thread decode pool must deliver the same per-part order as
     a single worker (order is owned by the slot protocol, not by thread
