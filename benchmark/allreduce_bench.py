@@ -2,8 +2,9 @@
 
 Sweeps tensor-count x size-distribution x bucket-bytes over the 8-device
 virtual mesh (the same dryrun substrate as `__graft_entry__`), per-key vs
-bucketed, dense vs 2bit, and prints one JSON line per config plus a
-summary speedup table.  Verdict: `benchmark/COLLECTIVES_ANALYSIS.md`.
+bucketed, across {dense, 2bit, int8, fp8} compression modes, and prints
+one JSON line per config plus a summary speedup table.  Verdict:
+`benchmark/COLLECTIVES_ANALYSIS.md`.
 
 The headline distribution is ResNet-50-like: 160 gradient tensors whose
 median is 256 floats (1 KB — BN gamma/beta and biases), with a small
@@ -70,30 +71,32 @@ def build_pairs(sizes, seed=0):
     return pairs
 
 
-def make_store(compressed, bucket_bytes=None):
+def make_store(mode, bucket_bytes=None):
     from mxnet_tpu import kvstore
     from mxnet_tpu.kvstore.bucketing import GradBucketer
 
     kv = kvstore.create("tpu_ici")
-    if compressed:
+    if mode == "2bit":
         kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    elif mode != "dense":
+        kv.set_gradient_compression({"type": mode})
     if bucket_bytes is not None:
         kv._bucketer = GradBucketer(bucket_bytes=bucket_bytes)
     return kv
 
 
 def run_config(dist, impl, mode, iters, warmup):
-    """One (distribution, implementation, dense|2bit) config; returns the
-    JSON row.  ``impl`` is "perkey" or a bucket-bytes int."""
+    """One (distribution, implementation, mode) config; returns the JSON
+    row.  ``impl`` is "perkey" or a bucket-bytes int; ``mode`` is dense,
+    2bit, int8, or fp8."""
     import mxnet_tpu as mx
     from mxnet_tpu import telemetry
 
     sizes = DISTRIBUTIONS[dist]
     pairs = build_pairs(sizes)
     issue = list(reversed(pairs))  # the Trainer's reverse-registration order
-    compressed = mode == "2bit"
     bucketed = impl != "perkey"
-    kv = make_store(compressed, bucket_bytes=impl if bucketed else None)
+    kv = make_store(mode, bucket_bytes=impl if bucketed else None)
 
     def step():
         if bucketed:
@@ -139,7 +142,8 @@ def main():
     ap.add_argument("--dists", nargs="*", default=list(DISTRIBUTIONS))
     ap.add_argument("--bucket-bytes", nargs="*", type=int,
                     default=[1 << 20, 4 << 20, 16 << 20])
-    ap.add_argument("--modes", nargs="*", default=["dense", "2bit"])
+    ap.add_argument("--modes", nargs="*",
+                    default=["dense", "2bit", "int8", "fp8"])
     args = ap.parse_args()
 
     rows = []

@@ -207,6 +207,59 @@ def _capture_allreduce_2bit():
               "threshold": 0.01, "devices": 8})
 
 
+def _capture_allreduce_blockwise(qtype):
+    """Shared capture for the block-scaled quantized bucket reduce: the
+    fused quantize -> pmax(scale) -> psum(payload) -> dequantize program
+    from `_blockwise_allreduce_fn`, taking the stacked gradient AND
+    residual shards.  TWO all-reduce ops in the HLO is the honest,
+    pinned census: the ~1/256-sized scale-agreement pmax and the
+    widened narrow-payload psum both live in ONE compiled launch."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.kvstore.tpu_ici import (DEFAULT_QBLOCK,
+                                           _blockwise_allreduce_fn)
+
+    devices = _ici_devices()
+    numel = 16384
+    allreduce, sharding, _mesh = _blockwise_allreduce_fn(
+        devices, numel, "float32", qtype, DEFAULT_QBLOCK)
+    spec = jax.ShapeDtypeStruct((len(devices), numel), jnp.float32,
+                                sharding=sharding)
+    # the third operand is the (n_dev, 1) launch-chain token that orders
+    # consecutive blockwise launches without a host fence — pure
+    # scheduling, no collective of its own
+    tok_spec = jax.ShapeDtypeStruct((len(devices), 1), jnp.float32,
+                                    sharding=sharding)
+    wire = "int8->int16" if qtype == "int8" else "float8_e4m3->bfloat16"
+    return _capture_jit(
+        allreduce, (spec, spec, tok_spec), f"allreduce.bucket_{qtype}",
+        "allreduce",
+        contract={
+            # pmax (scale agreement) + psum (payload): both collectives
+            # of the fused program, still one launch per bucket
+            "expected_collectives": {"all-reduce": 2},
+            "resharding_free": True,
+        },
+        meta={"numel": numel, "dtype": f"float32->{wire}",
+              "block": DEFAULT_QBLOCK, "devices": 8})
+
+
+@_entrypoint("allreduce.bucket_int8")
+def _capture_allreduce_int8():
+    """Block-scaled int8 bucket reduce (see
+    `_capture_allreduce_blockwise`): int8 payload, int16 accumulator."""
+    return _capture_allreduce_blockwise("int8")
+
+
+@_entrypoint("allreduce.bucket_fp8")
+def _capture_allreduce_fp8():
+    """Block-scaled fp8 bucket reduce (see
+    `_capture_allreduce_blockwise`): float8_e4m3 payload, bfloat16
+    accumulator."""
+    return _capture_allreduce_blockwise("fp8")
+
+
 class _PlanVal:
     """Shape/dtype stand-in for a gradient copy: exactly what
     GradBucketer's planner reads (``._data.dtype``, ``.shape``,
@@ -283,6 +336,73 @@ def _capture_bucketed_step():
               "n_tensors": len(RESNET50_PROFILE),
               "n_buckets": len(capacities),
               "bucket_bytes": BUCKETED_STEP_BUCKET_BYTES,
+              "capacities": capacities})
+
+
+@_entrypoint("allreduce.bucketed_step_int8")
+def _capture_bucketed_step_int8():
+    """The quantized twin of `allreduce.bucketed_step`: the SAME
+    GradBucketer plan over the resnet50 profile, but each bucket runs
+    the real `_blockwise_shard_body` int8 math instead of a bare psum.
+    The census pins 2 all-reduce ops per bucket (scale pmax + payload
+    psum) while the *launch* count the trainer sees stays one per
+    bucket — still 4 for the 160-tensor profile, which the dryrun
+    `dp_collective_launches_per_step` rider measures at runtime."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu._compat import shard_map
+    from mxnet_tpu.kvstore.tpu_ici import (DEFAULT_QBLOCK,
+                                           _blockwise_shard_body)
+
+    capacities = bucketed_step_plan()
+    devices = tuple(jax.local_devices()[:8])
+    mesh = Mesh(onp.asarray(devices), ("dev",))
+    sharding = NamedSharding(mesh, P("dev"))
+    bodies = [_blockwise_shard_body(cap, onp.dtype(onp.float32), "int8",
+                                    DEFAULT_QBLOCK, len(devices))
+              for cap in capacities]
+
+    def step(*bufs):
+        # bufs = grads then residuals (one of each per bucket), then the
+        # launch-chain token, threaded bucket to bucket exactly as the
+        # runtime chains consecutive launches
+        n = len(capacities)
+        tok = bufs[2 * n]
+        flat = []
+        for body, g, r in zip(bodies, bufs[:n], bufs[n:2 * n]):
+            out, new_res, tok = body(g, r, tok)
+            flat += [out, new_res]
+        return tuple(flat) + (tok,)
+
+    n_arg = 2 * len(capacities) + 1
+    reduce_all = shard_map(step, mesh,
+                           in_specs=(P("dev"),) * n_arg,
+                           out_specs=(P("dev"),) * n_arg)
+    jitted = jax.jit(
+        reduce_all,
+        in_shardings=(sharding,) * n_arg,
+        out_shardings=(sharding,) * n_arg)
+    specs = tuple(
+        jax.ShapeDtypeStruct((len(devices), cap), jnp.float32,
+                             sharding=sharding)
+        for cap in capacities) * 2 + (
+        jax.ShapeDtypeStruct((len(devices), 1), jnp.float32,
+                             sharding=sharding),)
+    return _capture_jit(
+        jitted, specs, "allreduce.bucketed_step_int8", "allreduce",
+        contract={
+            "expected_collectives": {"all-reduce": 2 * len(capacities)},
+            "resharding_free": True,
+        },
+        meta={"profile": "resnet50",
+              "n_tensors": len(RESNET50_PROFILE),
+              "n_buckets": len(capacities),
+              "bucket_bytes": BUCKETED_STEP_BUCKET_BYTES,
+              "block": DEFAULT_QBLOCK,
+              "mode": "int8",
               "capacities": capacities})
 
 

@@ -701,6 +701,9 @@ CONTRACTS = {
     "fused_train_step_dp": {
         "min_attributed_flops": 0.90,
     },
+    "quantized_allreduce": {
+        "min_attributed_flops": 0.90,
+    },
     "resnet_profile": {
         "min_attributed_flops": 0.90,
         "mfu_floors": {"stem": 0.50, "bn@bwd": 0.10},
@@ -791,8 +794,44 @@ def _census_resnet_profile():
     }
 
 
+def _census_quantized_allreduce():
+    """The block-scaled int8 bucket reduce, attributed to its three
+    named scopes (``quantize``/``allreduce``/``dequantize``) so the
+    compression overhead is a roofline-classified line item: the
+    quantize/dequantize elementwise cost must stay a small, HBM-bound
+    tax next to the payload collective it shrinks."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import capture as _capture
+    _capture._ensure_virtual_mesh()
+
+    from mxnet_tpu.kvstore.tpu_ici import (DEFAULT_QBLOCK,
+                                           _blockwise_allreduce_fn)
+
+    devices = tuple(jax.local_devices()[:8])
+    numel = 16384
+    allreduce, sharding, _mesh = _blockwise_allreduce_fn(
+        devices, numel, "float32", "int8", DEFAULT_QBLOCK)
+    spec = jax.ShapeDtypeStruct((len(devices), numel), jnp.float32,
+                                sharding=sharding)
+    tok_spec = jax.ShapeDtypeStruct((len(devices), 1), jnp.float32,
+                                    sharding=sharding)
+    compiled = allreduce.lower(spec, spec, tok_spec).compile()
+    return {
+        "entry": "quantized_allreduce",
+        "optimized": compiled.as_text(),
+        "cost_analysis": harvest_cost_analysis(compiled.cost_analysis()),
+        "layers": ("quantize", "allreduce", "dequantize"),
+        "contract": CONTRACTS["quantized_allreduce"],
+        "meta": {"numel": numel, "mode": "int8",
+                 "block": DEFAULT_QBLOCK, "devices": 8},
+    }
+
+
 _CENSUS_ENTRYPOINTS = {
     "fused_train_step_dp": _census_fused_train_step_dp,
+    "quantized_allreduce": _census_quantized_allreduce,
     "resnet_profile": _census_resnet_profile,
 }
 

@@ -86,6 +86,13 @@ MXNET_KVSTORE_RETRIES        transient-fault retry budget for KV reads,
                              the serve model call (default 3 retries =
                              4 attempts; re-read per retry loop so it can
                              be tuned mid-run)
+MXNET_KVSTORE_QBLOCK         scale-block size (elements) for the
+                             block-scaled int8/fp8 quantized allreduce
+                             (default 256; read when
+                             ``set_gradient_compression`` is called, and
+                             ``compression_params['block']`` overrides it
+                             per store); see docs/DESIGN.md
+                             "Block-scaled quantized allreduce"
 MXNET_DECODE_THREADS         decode-pool width for the native image
                              pipeline (``ImageRecordIter``); default
                              falls back to MXNET_CPU_WORKER_NTHREADS
@@ -195,6 +202,6 @@ def describe():
              "MXNET_PROFILE_DIR", "MXNET_KVSTORE_SPARSE_HOST_BOUND",
              "MXNET_TPU_MODEL_REPO", "MXNET_FAULTLINE",
              "MXNET_CHECKPOINT_KEEP", "MXNET_KVSTORE_RETRIES",
-             "MXNET_DECODE_THREADS", "MXNET_PREFETCH_DEPTH",
-             "MXNET_IO_ERROR_TOLERANCE"]
+             "MXNET_KVSTORE_QBLOCK", "MXNET_DECODE_THREADS",
+             "MXNET_PREFETCH_DEPTH", "MXNET_IO_ERROR_TOLERANCE"]
     return [(n, os.environ.get(n), n in __doc__) for n in names]

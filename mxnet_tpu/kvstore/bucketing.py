@@ -29,8 +29,13 @@ engine priority queue to honor it for us (docs/DESIGN.md).
 
 Bucket capacities are padded up to a quantum (64 KB) so the allreduce
 jit cache is keyed by O(#distinct capacities) across models instead of
-O(#shapes).  The 2-bit compressed path composes per-bucket: the packed
-flat buffer is quantized with one launch and carries one residual per
+O(#shapes).  The compressed paths compose per-bucket: 2bit quantizes
+the packed flat buffer with one launch before the psum, block-scaled
+int8/fp8 fuse quantize -> scale-agreement pmax -> payload psum ->
+dequantize -> residual update into ONE compiled launch per bucket
+(`tpu_ici._blockwise_allreduce_fn`; scale blocks of
+``MXNET_KVSTORE_QBLOCK`` elements ride the 64 KB capacity quantum, so
+the padding tail never splits a block).  Either way one residual per
 (bucket, copy) instead of one per (key, copy).
 """
 from __future__ import annotations
@@ -180,6 +185,10 @@ class GradBucketer:
         self._residuals = {}  # (signature, bucket_idx, copy_idx) -> jax.Array
         self._pending_residuals = {}  # checkpoint-restored, pre-adoption
         self._inflight = None  # host-CPU platform: last dispatched psum
+        # device-ring -> live launch-chain token for the blockwise path
+        # (tpu_ici._fresh_chain_token); chained launches order through
+        # the token instead of the host fence
+        self._chain_tokens = {}
         # introspection for tests / benchmarks
         self.last_issue_keys = []
         self.last_num_buckets = 0
@@ -236,10 +245,16 @@ class GradBucketer:
         for bidx, b in enumerate(plan):
             n_copies = len(items[b.positions[0]][1])
             payload = b.used_bytes * n_copies
-            op = "allreduce_bucket" if compression is None \
-                else "allreduce_2bit_bucket"
-            if compression is not None:
+            ctype = None if compression is None \
+                else compression.get("type", "2bit")
+            op = "allreduce_bucket" if ctype is None \
+                else f"allreduce_{ctype}_bucket"
+            if ctype == "2bit":
                 payload //= 4  # int8 levels ride the wire, not f32 words
+            elif ctype is not None:
+                # 2-byte int16/bf16 partials ride the wire: half of f32;
+                # bf16 buckets honestly keep their width (no win there)
+                payload = payload * 2 // b.dtype.itemsize
             with _collective_span(op, payload):
                 # transient dispatch faults (injected or real deadline
                 # misses) retry with backoff; the faultline arrival is
@@ -305,6 +320,18 @@ class GradBucketer:
             return
         from .tpu_ici import _allreduce_fn, _compressed_allreduce_fn
 
+        ctype = None if compression is None \
+            else compression.get("type", "2bit")
+        if ctype in ("int8", "fp8"):
+            # fused flat program on the tensor's own element count (no
+            # pack/unpack, same as the dense single-key short-circuit)
+            flats = [a.reshape(-1) for a in arrs]
+            out_flats = self._reduce_flat_blockwise_ring(
+                sig, bidx, devs, dtype, int(b.sizes[0]), flats,
+                compression)
+            for j, v in enumerate(vals):
+                NDArray(out_flats[j].reshape(shape), ctx=v.ctx).copyto(v)
+            return
         if compression is not None:
             thr = compression["threshold"]
             levels = [self._quantize(sig, bidx, j, arrs[j], thr)
@@ -340,17 +367,68 @@ class GradBucketer:
         if on_cpu and self._inflight is not None:
             jax.block_until_ready(self._inflight)
             self._inflight = None
+        # a live token chain would NOT order against this non-chained
+        # launch — break the chains so the next blockwise dispatch
+        # re-fences and re-seeds instead of overlapping with this psum
+        self._chain_tokens.clear()
         summed = allreduce(stacked)
         if on_cpu:
             self._inflight = summed
         return summed
 
+    def _dispatch_blockwise(self, devices, sharding, allreduce, gs, rs):
+        """Dispatch one bucket's fused block-scaled launch, ordered by
+        the launch-chain token instead of the host fence: every device's
+        sub-execution of launch i+1 consumes the (1, 1) token shard that
+        launch i produced, so chained collectives execute strictly in
+        issue order per device — the no-interleaved-rendezvous guarantee
+        `_dispatch_allreduce` gets by blocking the host — while the host
+        thread keeps packing, staging and unpacking other buckets around
+        the draining chain (the async issue-order overlap bucketing
+        exists to create, which the blocking fence forfeits).  The fence
+        still guards both boundaries with non-chained collectives: a
+        chain only starts once the previous non-chained psum completes,
+        and `self._inflight` tracks the chain tail so a later dense/2bit
+        dispatch blocks on the whole chain."""
+        from .tpu_ici import _fresh_chain_token
+
+        on_cpu = devices and devices[0] is not None \
+            and devices[0].platform == "cpu"
+        entry = self._chain_tokens.get(devices)
+        if entry is None:
+            if on_cpu and self._inflight is not None:
+                jax.block_until_ready(self._inflight)
+                self._inflight = None
+            tok = _fresh_chain_token(devices, sharding)
+        else:
+            older, tok = entry
+            # depth-2 window: launch k waits (on the HOST, cheaply — the
+            # token is n x 1 floats) for launch k-2, so one collective
+            # executes while the next is staged and queued, and the
+            # pipeline never runs away (unbounded runahead measurably
+            # loses to the fence: queued buffers and pack programs
+            # contend with the draining chain for the same cores)
+            jax.block_until_ready(older)
+        summed, new_res, tok_out = allreduce(gs, rs, tok)
+        self._chain_tokens[devices] = (tok, tok_out)
+        if on_cpu:
+            self._inflight = summed
+        return summed, new_res
+
     def _reduce_flat_ring(self, sig, bidx, b, packed, compression):
         """One compiled sharded psum over the copies' own devices — the
-        exact `_allreduce_fn` shard_map shape, (n, capacity) flat."""
+        exact `_allreduce_fn` shard_map shape, (n, capacity) flat.  The
+        block-scaled variants instead dispatch the fused
+        quantize+pmax+psum+dequantize program, which also returns the
+        new per-(bucket, copy) residual shards."""
         from .tpu_ici import _allreduce_fn, _compressed_allreduce_fn
 
         devs, n, cap = b.devices, len(packed), b.capacity
+        ctype = None if compression is None \
+            else compression.get("type", "2bit")
+        if ctype in ("int8", "fp8"):
+            return self._reduce_flat_blockwise_ring(
+                sig, bidx, devs, b.dtype, cap, packed, compression)
         if compression is not None:
             thr = compression["threshold"]
             levels = [self._quantize(sig, bidx, j, flat, thr)
@@ -370,11 +448,77 @@ class GradBucketer:
         by_dev = {s.device: s.data for s in summed.addressable_shards}
         return [by_dev[devs[j]].reshape((cap,)) for j in range(n)]
 
+    def _reduce_flat_blockwise_ring(self, sig, bidx, devs, dtype, cap,
+                                    packed, compression):
+        """Stack packed grads + residuals onto the copies' devices and
+        dispatch ONE fused block-scaled launch; shard the returned
+        residuals back into per-(bucket, copy) storage (same keys as
+        2bit, so the checkpoint export/import path rides unchanged)."""
+        from .tpu_ici import _blockwise_allreduce_fn
+
+        n = len(packed)
+        allreduce, sharding, _mesh = _blockwise_allreduce_fn(
+            devs, cap, str(dtype), compression["type"],
+            compression["block"])
+        gs = jax.make_array_from_single_device_arrays(
+            (n, cap), sharding,
+            [jax.device_put(f.reshape(1, cap), devs[j])
+             for j, f in enumerate(packed)])
+        rs = jax.make_array_from_single_device_arrays(
+            (n, cap), sharding,
+            [self._residual_shard(sig, bidx, j, packed[j], devs[j], cap,
+                                  dtype) for j in range(n)])
+        summed, new_res = self._dispatch_blockwise(devs, sharding,
+                                                   allreduce, gs, rs)
+        # store the NEW residuals as the raw (1, capacity) device shards:
+        # next step reinjects them with zero host-side staging (no
+        # reshape, no device_put) — export_residuals flattens at
+        # checkpoint time so the PR 9 schema is unchanged.
+        rby = {s.device: s.data for s in new_res.addressable_shards}
+        for j in range(n):
+            self._residuals[(sig, bidx, j)] = rby[devs[j]]
+        by_dev = {s.device: s.data for s in summed.addressable_shards}
+        return [by_dev[devs[j]].reshape((cap,)) for j in range(n)]
+
+    def _residual_shard(self, sig, bidx, j, flat, dev, cap, dtype):
+        """The (1, capacity) residual shard for the blockwise launch.
+        Steady state returns the stored shard untouched (it is already
+        on ``dev`` in launch shape); first step / checkpoint adoption /
+        compression-type switch pay a one-time reshape + placement."""
+        res = self._residuals.get((sig, bidx, j))
+        if res is not None and res.shape == (1, cap):
+            return res
+        if res is None:
+            res = self._adopt_pending(sig, bidx, j, flat)
+        if res is None:
+            res = jnp.zeros((1, cap), dtype)
+        return jax.device_put(res.reshape(1, cap), dev)
+
     def _reduce_flat_fallback(self, sig, bidx, b, packed, compression):
         """Copies sharing a device (or host-backed): no ring exists to
         ride — accumulate on the first copy's device (mirrors
-        `TPUICIStore._reduce_copies`' fallback)."""
+        `TPUICIStore._reduce_copies`' fallback).  Block-scaled variants
+        run the collective-free twin of the fused program — the same
+        shared-scale math, amax over all copies replacing the pmax."""
         dev0 = b.devices[0]
+        ctype = None if compression is None \
+            else compression.get("type", "2bit")
+        if ctype in ("int8", "fp8"):
+            from .tpu_ici import _blockwise_local_fn
+
+            n, cap = len(packed), b.capacity
+            fn = _blockwise_local_fn(n, cap, str(b.dtype), ctype,
+                                     compression["block"])
+            put = (lambda a: jax.device_put(a, dev0)) \
+                if dev0 is not None else (lambda a: a)
+            g = jnp.stack([put(f) for f in packed])
+            r = jnp.stack([put(self._residual_flat(sig, bidx, j,
+                                                   packed[j]))
+                           for j in range(n)])
+            out, new_res = fn(g, r)
+            for j in range(n):
+                self._residuals[(sig, bidx, j)] = new_res[j]
+            return out
         if compression is not None:
             thr = compression["threshold"]
             levels = [self._quantize(sig, bidx, j, flat, thr)
@@ -390,6 +534,21 @@ class GradBucketer:
             total = total + flat
         return total
 
+    def _residual_flat(self, sig, bidx, j, flat):
+        """The live error-feedback residual for (bucket, copy): stored,
+        else checkpoint-adopted, else zeros.  Shared by every compressed
+        variant — 2bit and blockwise residuals use the same keys, shapes
+        (flat capacity) and dtype (the grad dtype), which is what lets
+        the PR 9 checkpoint export/import extend instead of fork."""
+        res = self._residuals.get((sig, bidx, j))
+        if res is None:
+            res = self._adopt_pending(sig, bidx, j, flat)
+        if res is None:
+            res = jnp.zeros_like(flat)
+        # blockwise stores launch-shaped (1, capacity) shards; reshape is
+        # free (same-object) when the stored shape already matches
+        return res.reshape(flat.shape)
+
     def _quantize(self, sig, bidx, j, flat, thr):
         """2-bit levels with per-(bucket, copy) error feedback — one
         residual and one quantize launch per bucket instead of one per
@@ -397,14 +556,9 @@ class GradBucketer:
         zero residual quantizes to level 0 and residual 0."""
         from .tpu_ici import _quantize_2bit
 
-        rkey = (sig, bidx, j)
-        res = self._residuals.get(rkey)
-        if res is None:
-            res = self._adopt_pending(sig, bidx, j, flat)
-        if res is None:
-            res = jnp.zeros_like(flat)
+        res = self._residual_flat(sig, bidx, j, flat)
         lvl, res = _quantize_2bit(flat, res, thr)
-        self._residuals[rkey] = res
+        self._residuals[(sig, bidx, j)] = res
         return lvl
 
     # -- checkpoint I/O ----------------------------------------------------
@@ -431,7 +585,10 @@ class GradBucketer:
         live residual (checkpoint gather)."""
         out = {}
         for (sig, bidx, j), res in self._residuals.items():
-            out[(self._sig_digest(sig), bidx, j)] = onp.asarray(res)
+            # blockwise keeps (1, capacity) launch-shaped shards live;
+            # the checkpoint schema is flat (capacity,) for every variant
+            out[(self._sig_digest(sig), bidx, j)] = \
+                onp.asarray(res).reshape(-1)
         return out
 
     def import_residuals(self, entries):

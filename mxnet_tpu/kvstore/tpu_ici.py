@@ -145,11 +145,213 @@ def _quantize_2bit(x, residual, threshold):
     """Reference 2-bit compression (`src/kvstore/gradient_compression.cc`):
     values map to levels {-1, 0, +1} (scaled by threshold on the wire); the
     quantization error is kept as per-key residual and added back next
-    round (error feedback).  Returns (int8 levels, new residual)."""
+    round (error feedback).  Returns (int8 levels, new residual).
+
+    The `_quantize_blockwise` family below generalizes this shape —
+    quantize against a scale, keep the error as residual — to
+    block-scaled int8/fp8 wire formats (EQuARX-style, PAPERS.md arxiv
+    2506.17615) where the scale is data-derived per block instead of a
+    fixed threshold."""
     acc = x + residual
     lvl = jnp.where(acc >= threshold, 1,
                     jnp.where(acc <= -threshold, -1, 0)).astype(jnp.int8)
     return lvl, acc - lvl.astype(acc.dtype) * threshold
+
+
+# -- block-scaled int8/fp8 (EQuARX-style) -----------------------------------
+
+#: Gradient compression types ``set_gradient_compression`` accepts.
+SUPPORTED_COMPRESSION = ("2bit", "int8", "fp8")
+
+#: Largest representable quantized magnitude per block-scaled type
+#: (int8: symmetric 127; fp8 e4m3: 448, the format's finite max).
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+DEFAULT_QBLOCK = 256
+
+
+def qblock_size():
+    """Scale-block size in elements for block-scaled int8/fp8
+    compression (``MXNET_KVSTORE_QBLOCK``, default 256).  256 f32
+    elements = 1 KB, so the 64 KB bucket-capacity quantum is always a
+    whole number of blocks and the padding tail never splits one."""
+    # mxlint: disable=env-read-at-trace-time -- host-side read when compression is configured (env.py table); only sizes static block shapes for the jit cache, never enters traced code
+    return max(1, int(os.environ.get("MXNET_KVSTORE_QBLOCK",
+                                     DEFAULT_QBLOCK)))
+
+
+def _fp8_wire_dtype():
+    """The fp8 wire dtype when the pinned toolchain ships one, else
+    None (``set_gradient_compression('fp8')`` then refuses loudly)."""
+    return getattr(jnp, "float8_e4m3fn", None) or \
+        getattr(jnp, "float8_e4m3", None)
+
+
+def _blockwise_qparams(qtype, n_dev):
+    """``(qmax, wire dtype, psum accumulator dtype)`` for a variant.
+
+    The accumulator is the narrowest type the cross-device sum fits:
+    int8 levels psum EXACTLY in int16 while ``n_dev * 127`` fits (int32
+    beyond 258 devices); fp8 payloads widen to bfloat16 partials.
+    Either way 2 bytes/element ride the wire — half of f32, vs 2bit's
+    quarter at three levels."""
+    if qtype == "int8":
+        acc = jnp.int16 if n_dev <= 258 else jnp.int32
+        return _QMAX["int8"], jnp.int8, acc
+    wire = _fp8_wire_dtype()
+    if wire is None:
+        raise MXNetError(
+            "compression type 'fp8' needs a jax.numpy.float8_e4m3 dtype, "
+            "which this toolchain does not ship — use 'int8' "
+            "(docs/DESIGN.md \"Block-scaled quantized allreduce\")")
+    return _QMAX["fp8"], wire, jnp.bfloat16
+
+
+def _blockwise_layout(numel, block):
+    """``(n_blocks, pad)`` covering ``numel`` elements with full
+    ``block``-element scale blocks (the tail block is zero-padded
+    inside the compiled program)."""
+    nblk = -(-numel // block)
+    return nblk, nblk * block - numel
+
+
+def _blockwise_shard_body(numel, out_dtype, qtype, block, n_dev,
+                          axis="dev"):
+    """The per-shard body of the fused block-scaled all-reduce, factored
+    out so `analysis/capture.py` composes the REAL math into the
+    bucketed-step artifact instead of a reconstruction.
+
+    Per-device payloads scaled by independent scales cannot ride a
+    single psum (``sum_i q_i*s_i`` is not recoverable from ``psum(q_i)``
+    and the scales), so the scale is AGREED first: a pmax of the
+    per-block local amax — a (numel/block,) f32 sideband, ~1/256 of the
+    payload — gives every device the same scale; the quantized payload
+    then psums in the widened narrow type.  Both collectives live in
+    one compiled program, so the runtime cost stays one launch per
+    bucket (hloscan's census honestly counts 2 all-reduce ops in the
+    HLO — the declared contract).
+
+    A zero-amax block keeps scale 1 so 0/0 never reaches the wire; the
+    bucket's zero-padding tail (zero grad + zero residual) therefore
+    stays exactly zero through quantize, psum, and residual alike.  The
+    ``quantize``/``allreduce``/``dequantize`` named scopes feed the
+    layerscope census row that attributes the compression overhead."""
+    qmax, wire, acc_dt = _blockwise_qparams(qtype, n_dev)
+    nblk, pad = _blockwise_layout(numel, block)
+
+    def body(g, res, tok):
+        # g, res: (1, numel) local shards of the stacked (n_dev, numel);
+        # tok: this device's (1, 1) shard of the launch-chain token —
+        # always +0.0, so consuming it below is a bitwise no-op.  Its
+        # JOB is the data dependency: each device's sub-execution of
+        # launch i+1 waits for the shard launch i produced, so chained
+        # collectives execute strictly in issue order per device (no
+        # interleaved rendezvous, hence no emulated-mesh deadlock)
+        # WITHOUT the host-blocking fence serial collectives need.
+        with jax.named_scope("quantize"):
+            accf = (g + res).astype(jnp.float32).reshape(-1)
+            if pad:
+                accf = jnp.concatenate(
+                    [accf, jnp.zeros((pad,), jnp.float32)])
+            blocks = accf.reshape(nblk, block)
+            amax = jnp.max(jnp.abs(blocks), axis=1)
+        with jax.named_scope("allreduce"):
+            # + tok[0] adds +0.0 (x + 0.0 == x bitwise for the gmax >= 0
+            # domain) but keeps the token a live input to the program
+            gmax = jax.lax.pmax(amax, axis) + tok[0]  # scale agreement
+        with jax.named_scope("quantize"):
+            scale = jnp.where(gmax > 0, gmax / qmax,
+                              jnp.float32(1.0)).astype(jnp.float32)
+            q = blocks / scale[:, None]
+            if qtype == "int8":
+                q = jnp.round(q)
+            q = jnp.clip(q, -qmax, qmax).astype(wire)
+            # next launch's token: 0.0 with a data dependency on this
+            # launch (scale > 0 for finite grads, so the product is 0.0)
+            tok_out = (scale[:1] * jnp.float32(0.0)).reshape(1, 1)
+        with jax.named_scope("allreduce"):
+            total = jax.lax.psum(q.astype(acc_dt), axis)
+        with jax.named_scope("dequantize"):
+            out = (total.astype(jnp.float32) * scale[:, None]) \
+                .reshape(-1)[:numel].astype(out_dtype)
+            new_res = (blocks - q.astype(jnp.float32) * scale[:, None]) \
+                .reshape(-1)[:numel].astype(out_dtype)
+        return out.reshape(1, numel), new_res.reshape(1, numel), tok_out
+
+    return body
+
+
+@functools.lru_cache(maxsize=None)
+def _blockwise_allreduce_fn(devices, numel, dtype, qtype, block):
+    """Compile the fused block-scaled quantized all-reduce: ONE launch
+    per bucket doing quantize -> scale-agreement pmax -> payload psum ->
+    dequantize -> residual update (`_blockwise_shard_body` is the math).
+
+    Inputs are the stacked (n_dev, numel) gradient and residual, one
+    shard per device; outputs are the dequantized SUM and the new
+    error-feedback residual with the same sharding — every device holds
+    its own reduced shard, so write-back is transfer-free (the exact
+    `_allreduce_fn` shape)."""
+    from .._compat import shard_map
+
+    mesh = Mesh(onp.asarray(devices), ("dev",))
+    sharding = NamedSharding(mesh, P("dev"))
+    body = _blockwise_shard_body(numel, onp.dtype(dtype), qtype, block,
+                                 len(devices))
+    fn = shard_map(body, mesh, in_specs=(P("dev"), P("dev"), P("dev")),
+                   out_specs=(P("dev"), P("dev"), P("dev")))
+    allreduce = jax.jit(fn, in_shardings=(sharding, sharding, sharding),
+                        out_shardings=(sharding, sharding, sharding))
+    return allreduce, sharding, mesh
+
+
+def _fresh_chain_token(devices, sharding):
+    """Seed a launch-chain token: the (n_dev, 1) all-zeros array whose
+    shards each blockwise launch consumes and re-emits (see
+    `_blockwise_shard_body`).  Built once per chain start — steady state
+    reuses the previous launch's token output with zero staging."""
+    z = onp.zeros((1, 1), onp.float32)
+    return jax.make_array_from_single_device_arrays(
+        (len(devices), 1), sharding,
+        [jax.device_put(z, d) for d in devices])
+
+
+@functools.lru_cache(maxsize=None)
+def _blockwise_local_fn(n, numel, dtype, qtype, block):
+    """The collective-free twin of `_blockwise_allreduce_fn` for copies
+    that share a device (or are host-backed): the amax over ALL copies'
+    blocks replaces the pmax, so fallback and ring paths compute the
+    SAME shared-scale math (bit-identical for int8, whose integer psum
+    is order-free).  Takes stacked (n, numel) grads and residuals;
+    returns ``(reduced (numel,), new residuals (n, numel))``."""
+    out_dtype = onp.dtype(dtype)
+    qmax, wire, acc_dt = _blockwise_qparams(qtype, n)
+    nblk, pad = _blockwise_layout(numel, block)
+
+    def local(g, res):
+        with jax.named_scope("quantize"):
+            accf = (g + res).astype(jnp.float32)
+            if pad:
+                accf = jnp.concatenate(
+                    [accf, jnp.zeros((n, pad), jnp.float32)], axis=1)
+            blocks = accf.reshape(n, nblk, block)
+            gmax = jnp.max(jnp.abs(blocks), axis=(0, 2))
+            scale = jnp.where(gmax > 0, gmax / qmax,
+                              jnp.float32(1.0)).astype(jnp.float32)
+            q = blocks / scale[None, :, None]
+            if qtype == "int8":
+                q = jnp.round(q)
+            q = jnp.clip(q, -qmax, qmax).astype(wire)
+        total = jnp.sum(q.astype(acc_dt), axis=0, dtype=acc_dt)
+        with jax.named_scope("dequantize"):
+            out = (total.astype(jnp.float32) * scale[:, None]) \
+                .reshape(-1)[:numel].astype(out_dtype)
+            new_res = (blocks - q.astype(jnp.float32)
+                       * scale[None, :, None]) \
+                .reshape(n, -1)[:, :numel].astype(out_dtype)
+        return out, new_res
+
+    return jax.jit(local)
 
 
 @KVStoreBase.register
@@ -161,6 +363,8 @@ class TPUICIStore(KVStoreBase):
         self._size = jax.process_count()
         self._compression = None
         self._residuals = {}
+        # device-ring -> live launch-chain token (see _fresh_chain_token)
+        self._chain_tokens = {}
         self._bucketer = None
         self._hb_stop = None
         self._hb_thread = None
@@ -339,25 +543,46 @@ class TPUICIStore(KVStoreBase):
                 NDArray(by_dev[d], ctx=o.ctx).copyto(o)
 
     def set_gradient_compression(self, compression_params):
-        """Enable 2-bit gradient compression with error feedback (reference
+        """Enable gradient compression with error feedback (reference
         `kvstore.py set_gradient_compression` →
-        `src/kvstore/gradient_compression.cc`).  ``{'type': '2bit',
-        'threshold': t}``.
+        `src/kvstore/gradient_compression.cc`).
 
-        Applies to the per-device-copy reduce path only: copies are
-        quantized to {-1,0,+1} levels *before* the cross-device transfer
-        and carried as int8 (4x narrower than f32; the reference packs 16
-        levels per uint32 for ZMQ, int8 is the TPU-friendly container).
-        The SPMD path is untouched — there XLA has already reduced inside
-        the compiled step, so quantizing after the fact would cost accuracy
-        and save nothing."""
+        * ``{'type': '2bit', 'threshold': t}`` — reference three-level
+          quantization: copies map to {-1,0,+1} levels before the
+          cross-device transfer and ride as int8 (4x narrower than f32;
+          the reference packs 16 levels per uint32 for ZMQ, int8 is the
+          TPU-friendly container).
+        * ``{'type': 'int8'}`` / ``{'type': 'fp8'}`` — block-scaled
+          quantization (EQuARX-style): per-``MXNET_KVSTORE_QBLOCK``-block
+          scales agreed across devices by a pmax sideband, payload summed
+          as int16/bf16 partials, quantize→allreduce→dequantize fused in
+          ONE launch per bucket.  ``'block'`` overrides the env block
+          size; ``'fp8'`` needs a toolchain ``float8_e4m3`` dtype.  Wire
+          format: docs/DESIGN.md "Block-scaled quantized allreduce".
+
+        All variants apply to the per-device-copy reduce path only.  The
+        SPMD path is untouched — there XLA has already reduced inside
+        the compiled step, so quantizing after the fact would cost
+        accuracy and save nothing."""
         ctype = compression_params.get("type", "2bit")
-        if ctype != "2bit":
-            raise MXNetError(f"unsupported compression type {ctype!r}")
-        self._compression = {
-            "type": "2bit",
-            "threshold": float(compression_params.get("threshold", 0.5)),
-        }
+        if ctype not in SUPPORTED_COMPRESSION:
+            raise MXNetError(
+                f"unsupported gradient compression type {ctype!r}: "
+                f"supported types are "
+                f"{', '.join(repr(t) for t in SUPPORTED_COMPRESSION)} "
+                f"(docs/DESIGN.md \"Block-scaled quantized allreduce\")")
+        if ctype == "2bit":
+            self._compression = {
+                "type": "2bit",
+                "threshold": float(compression_params.get("threshold", 0.5)),
+            }
+        else:
+            _blockwise_qparams(ctype, 2)  # fail fast on a missing fp8 dtype
+            self._compression = {
+                "type": ctype,
+                "block": int(compression_params.get("block",
+                                                    qblock_size())),
+            }
         self._residuals = {}
 
     def pushpull(self, key, value, out=None, priority=0):
@@ -387,8 +612,13 @@ class TPUICIStore(KVStoreBase):
             # reduced over the data axis inside the jitted step.
             reduced = vals[0]
         elif self._compression is not None:
-            # the wire payload is the int8 levels, 1/4 of the f32 bytes
-            with _collective_span("allreduce_2bit", _payload_bytes(vals) // 4):
+            ctype = self._compression.get("type", "2bit")
+            # 2bit levels ride as int8 (1/4 of the f32 bytes); blockwise
+            # int8/fp8 ride widened 2-byte partials (1/2) plus a
+            # ~4/block scale sideband the span rounds away
+            shrink = 4 if ctype == "2bit" else 2
+            with _collective_span(f"allreduce_{ctype}",
+                                  _payload_bytes(vals) // shrink):
                 reduced = self._reduce_compressed(key, vals)
         else:
             with _collective_span("allreduce", _payload_bytes(vals)):
@@ -439,6 +669,8 @@ class TPUICIStore(KVStoreBase):
         the exact `_reduce_copies` shape, so the compressed path gains the
         ICI ring instead of a serial hub-device loop.  Returns one reduced
         NDArray per input copy, resident on that copy's device."""
+        if self._compression.get("type", "2bit") != "2bit":
+            return self._reduce_blockwise(key, vals)
         thr = self._compression["threshold"]
         levels = []
         for i, v in enumerate(vals):
@@ -479,6 +711,73 @@ class TPUICIStore(KVStoreBase):
         stacked = jax.make_array_from_single_device_arrays(
             (n,) + shape, sharding, pieces)
         summed = allreduce(stacked)
+        by_dev = {s.device: s.data for s in summed.addressable_shards}
+        return [
+            NDArray(by_dev[devs[i]].reshape(shape), ctx=vals[i].ctx)
+            for i in range(n)
+        ]
+
+    def _reduce_blockwise(self, key, vals):
+        """Per-key block-scaled int8/fp8 reduce (the bucketer composes
+        the same compiled programs per bucket): stack grads + residuals,
+        ONE fused quantize->pmax+psum->dequantize launch, residual per
+        (key, copy) stored in the value's own shape and dtype so
+        `_residual_matches` keeps gating staleness and the checkpoint
+        residual export (`kvres/`) rides unchanged."""
+        ctype = self._compression["type"]
+        block = self._compression["block"]
+        n = len(vals)
+        shape = tuple(vals[0].shape)
+        numel = int(vals[0].size)
+        dstr = str(onp.dtype(vals[0]._data.dtype))
+        devs = _value_devices(vals)
+        flats, res_flats = [], []
+        for i, v in enumerate(vals):
+            res = self._residuals.get((key, i))
+            if res is not None and not _residual_matches(res, v._data):
+                # the copy moved (reset_ctx), changed shape, or changed
+                # dtype since the residual was recorded: stale error
+                # feedback must be dropped, not applied to the wrong
+                # tensor
+                res = None
+            if res is None:
+                res = jnp.zeros_like(v._data)
+            flats.append(v._data.reshape(-1))
+            res_flats.append(res.reshape(-1))
+        if None in devs or len(set(devs)) < n:
+            # copies sharing a device (or host-backed): no ring exists —
+            # the collective-free twin computes the same shared-scale
+            # math on the first copy's device
+            fn = _blockwise_local_fn(n, numel, dstr, ctype, block)
+            put = (lambda a: jax.device_put(a, devs[0])) \
+                if devs[0] is not None else (lambda a: a)
+            out, new_res = fn(jnp.stack([put(f) for f in flats]),
+                              jnp.stack([put(f) for f in res_flats]))
+            for i in range(n):
+                self._residuals[(key, i)] = new_res[i].reshape(shape)
+            return NDArray(out.reshape(shape), ctx=vals[0].ctx)
+        allreduce, sharding, _mesh = _blockwise_allreduce_fn(
+            tuple(devs), numel, dstr, ctype, block)
+        gs = jax.make_array_from_single_device_arrays(
+            (n, numel), sharding,
+            [jax.device_put(f.reshape(1, numel), devs[i])
+             for i, f in enumerate(flats)])
+        rs = jax.make_array_from_single_device_arrays(
+            (n, numel), sharding,
+            [jax.device_put(f.reshape(1, numel), devs[i])
+             for i, f in enumerate(res_flats)])
+        entry = self._chain_tokens.get(tuple(devs))
+        if entry is None:
+            tok = _fresh_chain_token(tuple(devs), sharding)
+        else:
+            # depth-2 launch window (see GradBucketer._dispatch_blockwise)
+            older, tok = entry
+            jax.block_until_ready(older)
+        summed, new_res, tok_out = allreduce(gs, rs, tok)
+        self._chain_tokens[tuple(devs)] = (tok, tok_out)
+        rby = {s.device: s.data for s in new_res.addressable_shards}
+        for i in range(n):
+            self._residuals[(key, i)] = rby[devs[i]].reshape(shape)
         by_dev = {s.device: s.data for s in summed.addressable_shards}
         return [
             NDArray(by_dev[devs[i]].reshape(shape), ctx=vals[i].ctx)

@@ -352,8 +352,9 @@ def gather_training_state(trainer, step, scaler=None, include_rng=True):
         arrays["rng/root"] = onp.asarray(
             jax.random.key_data(_rng._state.root))
         meta["rng_counter"] = int(_rng._state.counter)
-    # -- 2bit error-feedback residuals (owed to the params; see module
-    # docstring).  Store-level residuals are keyed (param_idx, copy).
+    # -- error-feedback residuals, 2bit and block-scaled alike (owed to
+    # the params; see module docstring).  Store-level residuals are
+    # keyed (param_idx, copy).
     store = trainer._kvstore
     if store is not None and getattr(store, "_residuals", None):
         for (key, c), res in store._residuals.items():
@@ -426,6 +427,14 @@ def restore_training_state(arrays, meta, trainer, scaler=None):
         _rng._state.root = jax.random.wrap_key_data(
             onp.asarray(arrays["rng/root"]))
         _rng._state.counter = int(meta.get("rng_counter", 0))
+    # a restarted process restores BEFORE its first step, so the lazily
+    # created kvstore/bucketer may not exist yet — materialize them when
+    # the checkpoint carries residuals, or the compressed-allreduce
+    # error feedback would be silently dropped
+    if trainer._kvstore is None and (
+            any(k.startswith("kvres/") for k in arrays)
+            or meta.get("bucket_residuals")):
+        trainer._init_kvstore()
     store = trainer._kvstore
     if store is not None and hasattr(store, "_residuals"):
         import jax.numpy as jnp
@@ -440,6 +449,10 @@ def restore_training_state(arrays, meta, trainer, scaler=None):
     bucketer = getattr(store, "_bucketer", None) if store is not None \
         else None
     pending = meta.get("bucket_residuals")
+    if bucketer is None and pending and store is not None \
+            and hasattr(store, "_bucketer"):
+        from ..kvstore.bucketing import GradBucketer
+        bucketer = store._bucketer = GradBucketer()
     if bucketer is not None and pending:
         bucketer.import_residuals({
             (e["digest"], e["bucket"], e["copy"]):

@@ -349,7 +349,9 @@ def test_real_entrypoints_scan_clean(real_artifacts):
 def test_real_artifact_inventory(real_artifacts):
     names = {a.name for a in real_artifacts}
     assert names == {"fused_train_step.dp", "allreduce.bucket_dense",
-                     "allreduce.bucket_2bit", "allreduce.bucketed_step",
+                     "allreduce.bucket_2bit", "allreduce.bucket_int8",
+                     "allreduce.bucket_fp8", "allreduce.bucketed_step",
+                     "allreduce.bucketed_step_int8",
                      "flash_attention.fwd", "flash_attention.bwd",
                      "serve.endpoint"}
     for a in real_artifacts:
@@ -368,6 +370,23 @@ def test_dp_step_census_locks_bucket_collapse(real_artifacts):
     assert bucketed.meta["n_tensors"] == 160
     assert bucketed.meta["n_buckets"] == 4
     assert hlo.collective_counts(bucketed.best_module) == {"all-reduce": 4}
+
+
+def test_quantized_step_census_keeps_bucket_collapse(real_artifacts):
+    """The block-scaled int8 step rides the SAME 4-bucket plan: two
+    all-reduce ops per bucket in the HLO (the ~1/256 scale-agreement
+    pmax + the widened int8-payload psum), both inside one launch — so
+    the runtime launch count the dryrun rider measures stays 4."""
+    by_name = {a.name: a for a in real_artifacts}
+    q = by_name["allreduce.bucketed_step_int8"]
+    assert q.meta["n_tensors"] == 160
+    assert q.meta["n_buckets"] == 4
+    assert q.contract["expected_collectives"] == {"all-reduce": 8}
+    assert hlo.collective_counts(q.best_module) == {"all-reduce": 8}
+    for name in ("allreduce.bucket_int8", "allreduce.bucket_fp8"):
+        a = by_name[name]
+        assert a.contract["expected_collectives"] == {"all-reduce": 2}
+        assert hlo.collective_counts(a.best_module) == {"all-reduce": 2}
 
 
 def test_dp_step_overlap_is_real(real_artifacts):

@@ -256,7 +256,7 @@ class _SpyStore(kvstore.KVStoreBase):
         return "spy"
 
 
-def _multi_device_trainer(spy=None, n_ctx=2):
+def _multi_device_trainer(spy=None, n_ctx=2, compression_params=None):
     from mxnet_tpu.gluon import nn
 
     ctxs = [mx.cpu(i) for i in range(n_ctx)]
@@ -267,7 +267,8 @@ def _multi_device_trainer(spy=None, n_ctx=2):
     net.initialize(ctx=ctxs)
     trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
                                {"learning_rate": 0.05},
-                               kvstore=spy if spy is not None else "tpu_ici")
+                               kvstore=spy if spy is not None else "tpu_ici",
+                               compression_params=compression_params)
     return net, trainer, ctxs
 
 
@@ -403,6 +404,336 @@ def test_single_copy_and_rowsparse_stay_per_key():
                                    onp.full(8, 2.0, onp.float32))
     # only the dense pair was bucketed
     assert kv._bucketer.last_issue_keys == [2]
+
+
+# -- block-scaled int8/fp8 quantized allreduce (ISSUE 11) --------------------
+
+def _oracle_blockwise(flats, residuals, qtype, block):
+    """Single-host numpy reference of the fused block-scaled reduce:
+    shared per-block scale from the global amax, quantize, order-free
+    integer (or fp8) sum, dequantize, error-feedback residual.
+
+    The residual emulates XLA's fused multiply-subtract (one rounding):
+    ``q*scale`` is exact in float64 (8-bit x 24-bit significands), and
+    since ``q = round(blocks/scale)``, ``blocks`` and ``q*scale`` are
+    within a factor of two, so Sterbenz's lemma makes the float64
+    subtraction exact — the single cast to float32 IS the fma rounding.
+    """
+    import ml_dtypes
+
+    qmax = {"int8": 127.0, "fp8": 448.0}[qtype]
+    n = len(flats)
+    numel = flats[0].size
+    nblk = -(-numel // block)
+    pad = nblk * block - numel
+    acc = onp.stack([f.astype(onp.float32) + r.astype(onp.float32)
+                     for f, r in zip(flats, residuals)])
+    if pad:
+        acc = onp.concatenate(
+            [acc, onp.zeros((n, pad), onp.float32)], axis=1)
+    blocks = acc.reshape(n, nblk, block)
+    gmax = onp.max(onp.abs(blocks), axis=(0, 2))
+    scale = onp.where(gmax > 0, gmax / onp.float32(qmax),
+                      onp.float32(1.0)).astype(onp.float32)
+    q = blocks / scale[None, :, None]
+    if qtype == "int8":
+        q = onp.clip(onp.round(q), -qmax, qmax).astype(onp.int8)
+        total = q.astype(onp.int32).sum(axis=0)
+    else:
+        q = onp.clip(q, -qmax, qmax).astype(ml_dtypes.float8_e4m3fn)
+        total = q.astype(onp.float32).sum(axis=0)
+    out = (total.astype(onp.float32) * scale[:, None]).reshape(-1)[:numel]
+    new_res = (blocks.astype(onp.float64)
+               - q.astype(onp.float64)
+               * scale[None, :, None].astype(onp.float64)
+               ).astype(onp.float32).reshape(n, -1)[:, :numel]
+    return out, new_res
+
+
+def test_int8_perkey_bitparity_vs_oracle():
+    """Acceptance: quantize -> allreduce -> dequantize over 4 distinct
+    devices is BIT-identical to the single-host oracle — the shared
+    scale makes the int payload psum order-free — and so are the stored
+    error-feedback residuals, across two steps."""
+    rs = onp.random.RandomState(11)
+    base = rs.randn(700).astype(onp.float32)
+    kv = kvstore.create("tpu_ici")
+    kv.set_gradient_compression({"type": "int8"})
+    res = [onp.zeros(700, onp.float32)] * N_COPIES
+    for step in range(2):
+        grads = [base * (0.5 ** step) + c for c in range(N_COPIES)]
+        vals = [mx.np.array(g, ctx=mx.cpu(c))
+                for c, g in enumerate(grads)]
+        kv.pushpull("k", vals)
+        want, res = _oracle_blockwise(grads, res, "int8", 256)
+        for c, v in enumerate(vals):
+            assert onp.array_equal(v.asnumpy(), want), (step, c)
+        for c in range(N_COPIES):
+            got_r = onp.asarray(kv._residuals[("k", c)]).reshape(-1)
+            assert onp.array_equal(got_r, res[c]), (step, c)
+
+
+def test_fp8_perkey_within_oracle_envelope_and_deterministic():
+    """fp8 cannot be oracle-bitwise (XLA's f32->fp8 rounding may sit one
+    quantization step from ml_dtypes near ties, and the bf16 psum rounds
+    per accumulation), so the fence is two-sided: every element within
+    one top-of-range fp8 step per contribution of the oracle, and the
+    whole reduce bit-deterministic run to run (the resume-parity
+    property the checkpoint tests build on)."""
+    rs = onp.random.RandomState(12)
+    base = rs.randn(700).astype(onp.float32)
+
+    def run():
+        kv = kvstore.create("tpu_ici")
+        kv.set_gradient_compression({"type": "fp8"})
+        vals = [mx.np.array(base + c, ctx=mx.cpu(c))
+                for c in range(N_COPIES)]
+        kv.pushpull("k", vals)
+        return (vals[0].asnumpy(),
+                [onp.asarray(kv._residuals[("k", c)])
+                 for c in range(N_COPIES)])
+
+    got, res1 = run()
+    got2, res2 = run()
+    assert onp.array_equal(got, got2)
+    for a, b in zip(res1, res2):
+        assert onp.array_equal(a, b)
+
+    grads = [base + c for c in range(N_COPIES)]
+    want, _ = _oracle_blockwise(
+        grads, [onp.zeros(700, onp.float32)] * N_COPIES, "fp8", 256)
+    blocks = onp.concatenate(
+        [onp.stack(grads), onp.zeros((N_COPIES, 68), onp.float32)],
+        axis=1).reshape(N_COPIES, -1, 256)
+    gmax = onp.max(onp.abs(blocks), axis=(0, 2))
+    # one fp8 step at the top of the range is amax/14 (e4m3: step 32 of
+    # 448); each of the N contributions may land one step off
+    atol = (N_COPIES * gmax / 14.0 * 1.05)[
+        onp.repeat(onp.arange(gmax.size), 256)[:700]]
+    assert (onp.abs(got - want) <= atol).all()
+
+
+def test_int8_bucketed_bitparity_vs_oracle():
+    """The bucketed path quantizes the PACKED flat buffer: two keys +
+    zero padding reduce bitwise like the oracle run on the packed
+    buffer, residuals included — and the padding tail stays exactly
+    zero through quantize/psum/residual (the zero-amax guard)."""
+    rs = onp.random.RandomState(13)
+    k0 = rs.randn(20).astype(onp.float32)
+    k1 = rs.randn(9).astype(onp.float32)
+    b = bucketing.GradBucketer(quantum_bytes=64)
+    comp = {"type": "int8", "block": 8}
+    pairs = [(0, [mx.np.array(k0 + c, ctx=mx.cpu(c)) for c in range(2)]),
+             (1, [mx.np.array(k1 + c, ctx=mx.cpu(c)) for c in range(2)])]
+    b.pushpull(pairs, compression=comp)
+    sig = next(iter(b._plans))
+    cap = b._plans[sig][0].capacity
+    assert cap > 29  # real padding in play
+    packed = [onp.concatenate([k0 + c, k1 + c,
+                               onp.zeros(cap - 29, onp.float32)])
+              for c in range(2)]
+    want, wres = _oracle_blockwise(
+        packed, [onp.zeros(cap, onp.float32)] * 2, "int8", 8)
+    assert onp.array_equal(pairs[0][1][0].asnumpy(), want[:20])
+    assert onp.array_equal(pairs[1][1][1].asnumpy(), want[20:29])
+    for j in range(2):
+        # stored launch-shaped (1, capacity); the checkpoint schema
+        # stays flat (export_residuals flattens)
+        got_r = onp.asarray(b._residuals[(sig, 0, j)]).reshape(-1)
+        assert onp.array_equal(got_r, wres[j])
+        assert not got_r[29:].any()  # padding tail exactly zero
+
+
+@pytest.mark.parametrize("qtype", ["int8", "fp8"])
+def test_blockwise_error_feedback_parity_across_steps(qtype):
+    """Bucketed vs per-key across 3 steps for the block-scaled modes.
+    Block boundaries differ between the packed buffer and the flat
+    tensor, so parity is only bitwise when the bucket IS the tensor: a
+    quantum-aligned single key packs identically on both paths (int8
+    exactly; fp8 to the bf16-psum reduction order)."""
+    numel = bucketing.DEFAULT_QUANTUM_BYTES // 4  # one full bucket
+    rs = onp.random.RandomState(17)
+    base = rs.randn(numel).astype(onp.float32)
+    kv_b = kvstore.create("tpu_ici")
+    kv_b.set_gradient_compression({"type": qtype})
+    kv_p = kvstore.create("tpu_ici")
+    kv_p.set_gradient_compression({"type": qtype})
+    for step in range(3):
+        grads = [base * (0.5 ** step) + c for c in range(N_COPIES)]
+        vb = [mx.np.array(g, ctx=mx.cpu(c)) for c, g in enumerate(grads)]
+        vp = [mx.np.array(g, ctx=mx.cpu(c)) for c, g in enumerate(grads)]
+        kv_b.pushpull_list([(0, vb)])
+        kv_p.pushpull(0, vp)
+        for a, b in zip(vb, vp):
+            if qtype == "int8":
+                assert onp.array_equal(a.asnumpy(), b.asnumpy()), step
+            else:
+                onp.testing.assert_allclose(
+                    a.asnumpy(), b.asnumpy(), rtol=1e-2, atol=1e-2,
+                    err_msg=f"step {step}")
+
+
+def test_blockwise_mixed_dtype_groups_split_buckets():
+    """float32 and bfloat16 gradients keep their per-dtype buckets under
+    int8 compression, and each group reduces within quantization error
+    of the dense per-key sum (zero residual on step one means the
+    quantized sum is one rounding step from dense per block)."""
+    specs = [((256,), "float32"), ((128,), "bfloat16"),
+             ((512,), "float32"), ((64,), "bfloat16")]
+    p_q = _make_pairs(19, specs)
+    p_d = _make_pairs(19, specs)
+    kv_q = kvstore.create("tpu_ici")
+    kv_q.set_gradient_compression({"type": "int8"})
+    kv_d = kvstore.create("tpu_ici")
+    kv_q.pushpull_list(list(reversed(p_q)))
+    kv_d.pushpull_list(list(reversed(p_d)))
+    assert kv_q._bucketer.last_num_buckets == 2
+    for (k, vq), (_, vd) in zip(p_q, p_d):
+        for a, b in zip(vq, vd):
+            dense = b.asnumpy().astype(onp.float32)
+            got = a.asnumpy().astype(onp.float32)
+            # |error| <= n_copies * amax/(2*127) per element for f32;
+            # bf16 grads add their own half-step rounding
+            tol = N_COPIES * onp.abs(dense).max() / 64.0 + 1e-3
+            onp.testing.assert_allclose(got, dense, atol=tol,
+                                        err_msg=str(k))
+
+
+def test_trainer_quantized_trajectory_tracks_dense():
+    """3-step trainer trajectory with int8 compression: device copies
+    stay bitwise in sync, and the loss trajectory tracks the dense run
+    within quantization tolerance (error feedback keeps the gap from
+    compounding)."""
+    def run(compression_params):
+        onp.random.seed(23)
+        mx.random.seed(23)
+        net, trainer, ctxs = _multi_device_trainer(
+            compression_params=compression_params)
+        from mxnet_tpu import autograd
+        from mxnet_tpu.gluon.utils import split_and_load
+        losses = []
+        for _ in range(3):
+            xs = split_and_load(
+                mx.np.array(onp.random.randn(8, 6).astype(onp.float32)),
+                ctxs)
+            with autograd.record():
+                ls = [(net(xb) ** 2).mean() for xb in xs]
+            autograd.backward(ls)
+            trainer.step(8)
+            losses.append(float(sum(l.asnumpy().item() for l in ls)))
+        params = net.collect_params()
+        for k in params:
+            copies = [d.asnumpy() for d in params[k].list_data()]
+            for c in copies[1:]:
+                assert onp.array_equal(copies[0], c), k
+        return losses, {k: params[k].list_data()[0].asnumpy()
+                        for k in params}
+
+    loss_dense, w_dense = run(None)
+    for qtype in ("int8", "fp8"):
+        loss_q, w_q = run({"type": qtype})
+        for ld, lq in zip(loss_dense, loss_q):
+            assert abs(ld - lq) <= 1e-2 * max(1.0, abs(ld)), (qtype, ld, lq)
+        for k in w_dense:
+            onp.testing.assert_allclose(
+                w_q[k], w_dense[k], atol=5e-3,
+                err_msg=f"{qtype} {k}")
+
+
+def test_unsupported_compression_type_lists_supported():
+    """Satellite: the error names every supported type and points at the
+    docs instead of the old bare '2bit only' ValueError."""
+    from mxnet_tpu.base import MXNetError
+
+    kv = kvstore.create("tpu_ici")
+    with pytest.raises(MXNetError) as exc:
+        kv.set_gradient_compression({"type": "1bit"})
+    msg = str(exc.value)
+    assert "'2bit'" in msg and "'int8'" in msg and "'fp8'" in msg
+    assert "docs/DESIGN.md" in msg
+
+
+def test_qblock_env_controls_block_size(monkeypatch):
+    """MXNET_KVSTORE_QBLOCK sizes the scale blocks of a fresh store;
+    an explicit ``block`` in compression_params wins over the env."""
+    monkeypatch.setenv("MXNET_KVSTORE_QBLOCK", "32")
+    kv = kvstore.create("tpu_ici")
+    kv.set_gradient_compression({"type": "int8"})
+    assert kv._compression["block"] == 32
+    kv.set_gradient_compression({"type": "int8", "block": 16})
+    assert kv._compression["block"] == 16
+
+
+@pytest.mark.parametrize("qtype", ["int8", "fp8"])
+def test_blockwise_kv_residuals_checkpoint_roundtrip(qtype):
+    """ISSUE 11 fence: int8/fp8 residual stores ride the PR 9 checkpoint
+    path unchanged — a restored store continues the quantized reduce
+    bit-identically to the uninterrupted one."""
+    from mxnet_tpu.resilience import (gather_training_state,
+                                      restore_training_state)
+
+    def _store():
+        kv = kvstore.create("tpu_ici")
+        kv.set_gradient_compression({"type": qtype})
+        return kv
+
+    def _vals():
+        rs = onp.random.RandomState(29)
+        base = rs.randn(300).astype(onp.float32)
+        return [mx.np.array(base * (1.0 + c), ctx=mx.cpu(c))
+                for c in range(2)]
+
+    kv1 = _store()
+    kv1.pushpull(0, _vals())
+    assert kv1._residuals
+
+    net, trainer, ctxs = _multi_device_trainer()
+    _step(net, trainer, ctxs)
+    trainer._kvstore = kv1
+    arrays, meta = gather_training_state(trainer, step=1)
+    assert any(k.startswith("kvres/") for k in arrays)
+
+    net2, trainer2, ctxs2 = _multi_device_trainer()
+    _step(net2, trainer2, ctxs2)
+    kv2 = _store()
+    trainer2._kvstore = kv2
+    restore_training_state(arrays, meta, trainer2)
+    assert set(kv2._residuals) == set(kv1._residuals)
+    for k in kv1._residuals:
+        assert onp.asarray(kv2._residuals[k]).tobytes() == \
+            onp.asarray(kv1._residuals[k]).tobytes()
+    a1, a2 = _vals(), _vals()
+    kv1.pushpull(0, a1)
+    kv2.pushpull(0, a2)
+    for x, y in zip(a1, a2):
+        assert onp.array_equal(x.asnumpy(), y.asnumpy())
+
+
+@pytest.mark.parametrize("qtype", ["int8", "fp8"])
+def test_blockwise_bucketer_residual_export_import_roundtrip(qtype):
+    """Bucketer-side twin: exported block-scaled residuals imported into
+    a fresh bucketer produce a bit-identical next reduce."""
+    def _pairs():
+        rs = onp.random.RandomState(31)
+        return [(k, [mx.np.array(
+            rs.randn(40).astype(onp.float32) + k + c, ctx=mx.cpu(c))
+            for c in range(2)]) for k in range(2)]
+
+    comp = {"type": qtype, "block": 16}
+    b_cont, b_orig = bucketing.GradBucketer(), bucketing.GradBucketer()
+    b_cont.pushpull(_pairs(), compression=comp)
+    b_orig.pushpull(_pairs(), compression=comp)
+    exported = b_orig.export_residuals()
+    assert exported
+
+    b_rest = bucketing.GradBucketer()
+    b_rest.import_residuals(exported)
+    p_cont, p_rest = _pairs(), _pairs()
+    b_cont.pushpull(p_cont, compression=comp)
+    b_rest.pushpull(p_rest, compression=comp)
+    for (_, vc), (_, vr) in zip(p_cont, p_rest):
+        for x, y in zip(vc, vr):
+            assert onp.array_equal(x.asnumpy(), y.asnumpy())
 
 
 def test_bucket_bytes_env_controls_plan(monkeypatch):

@@ -367,6 +367,79 @@ def test_resume_parity_after_injected_preemption(tmp_path):
     mgr.close()
 
 
+def test_quantized_resume_parity_after_injected_preemption(tmp_path):
+    """The resume-parity fence through the block-scaled int8 bucketed
+    path (ISSUE 11): preempt step 3 inside the quantized collective,
+    restore into a fresh process BEFORE its first step (the restore
+    itself must materialize the kvstore/bucketer for the residuals to
+    land), and the 3-step trajectory matches fault-free bitwise."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.utils import split_and_load
+
+    ctxs = [mx.cpu(i) for i in range(2)]
+    comp = {"type": "int8", "block": 64}
+
+    def build(seed):
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=6, activation="relu"))
+        net.add(nn.Dense(4, in_units=8))
+        net.initialize(ctx=ctxs)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9},
+                           kvstore="tpu_ici", compression_params=comp)
+        return net, tr
+
+    def qbatch(t):
+        rs = onp.random.RandomState(300 + t)
+        return mx.np.array(rs.randn(4, 6).astype(onp.float32))
+
+    def qstep(net, tr, t):
+        xs = split_and_load(qbatch(t), ctxs)
+        with autograd.record():
+            ls = [(net(xb) ** 2).mean() for xb in xs]
+        autograd.backward(ls)
+        tr.step(4)
+
+    def params_np(net):
+        return {k: onp.asarray(p.data()._data)
+                for k, p in net.collect_params().items()}
+
+    # fault-free reference trajectory
+    net_a, tr_a = build(seed=11)
+    for t in range(3):
+        qstep(net_a, tr_a, t)
+    ref = params_np(net_a)
+
+    # chaos run: checkpoint after step 2, preempted inside step 3's
+    # quantized bucket dispatch
+    net_b, tr_b = build(seed=11)
+    for t in range(2):
+        qstep(net_b, tr_b, t)
+    mgr = CheckpointManager(tmp_path / "ckpt", async_write=False, rank=0)
+    arrays, meta = gather_training_state(tr_b, step=2)
+    assert any(k.startswith("bucketres/") for k in arrays)
+    mgr.save(2, arrays, meta)
+    faultline.plan([{"site": "collective.dispatch", "kind": "preempt",
+                     "at": 1}])
+    with pytest.raises(faultline.InjectedPreemption):
+        qstep(net_b, tr_b, 2)
+    faultline.clear()
+
+    # 'restarted process': wrong init seed, restore before any step
+    net_c, tr_c = build(seed=77)
+    assert tr_c._kvstore is None
+    step, arrays_r, meta_r = mgr.restore_latest()
+    assert step == 2
+    assert restore_training_state(arrays_r, meta_r, tr_c) == 2
+    assert tr_c._kvstore is not None and tr_c._kvstore._bucketer is not None
+    qstep(net_c, tr_c, 2)
+    got = params_np(net_c)
+    for k in ref:
+        assert got[k].tobytes() == ref[k].tobytes(), k
+    mgr.close()
+
+
 def test_kv_residuals_survive_checkpoint_roundtrip():
     """2bit error-feedback residuals ride the checkpoint: a restored
     store continues the compressed reduce exactly like the original."""

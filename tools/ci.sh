@@ -93,6 +93,92 @@ rec = telemetry.default_registry().get_sample_value(
 assert rec == 1, rec
 print("ci: env-plan KV timeout injected and recovered")
 EOF
+  # quantized preempt/resume parity (ISSUE 11): the resume-parity fence
+  # again, but through the block-scaled int8 bucketed path — its
+  # error-feedback residuals ride the SAME kvres/bucketres checkpoint
+  # schema as 2bit, so a preempted quantized run must resume with a
+  # bitwise-identical trajectory (docs/RESILIENCE.md recovery matrix;
+  # opt out with MXTPU_CHAOS_QUANTIZED=0)
+  if [ "${MXTPU_CHAOS_QUANTIZED:-1}" != "0" ]; then
+  python - <<'EOF'
+import tempfile
+
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.utils import split_and_load
+from mxnet_tpu.resilience import (CheckpointManager, faultline,
+                                  gather_training_state,
+                                  restore_training_state)
+
+CTXS = [mx.cpu(i) for i in range(2)]
+COMP = {"type": "int8", "block": 64}
+
+def build(seed):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=6, activation="relu"))
+    net.add(nn.Dense(4, in_units=8))
+    net.initialize(ctx=CTXS)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9},
+                       kvstore="tpu_ici", compression_params=COMP)
+    return net, tr
+
+def batch(t):
+    rs = onp.random.RandomState(300 + t)
+    return mx.np.array(rs.randn(4, 6).astype(onp.float32))
+
+def step(net, tr, t):
+    xs = split_and_load(batch(t), CTXS)
+    with autograd.record():
+        ls = [(net(xb) ** 2).mean() for xb in xs]
+    autograd.backward(ls)
+    tr.step(4)
+
+def params_np(net):
+    return {k: onp.asarray(p.data()._data)
+            for k, p in net.collect_params().items()}
+
+# fault-free reference trajectory
+net_a, tr_a = build(seed=11)
+for t in range(3):
+    step(net_a, tr_a, t)
+ref = params_np(net_a)
+
+# chaos run: checkpoint after step 2, preempted during step 3's bucket
+# dispatch (the quantized collective itself)
+net_b, tr_b = build(seed=11)
+for t in range(2):
+    step(net_b, tr_b, t)
+mgr = CheckpointManager(tempfile.mkdtemp(), async_write=False, rank=0)
+arrays, meta = gather_training_state(tr_b, step=2)
+assert any(k.startswith("bucketres/") for k in arrays), \
+    "int8 bucketer residuals must ride the checkpoint"
+mgr.save(2, arrays, meta)
+faultline.plan([{"site": "collective.dispatch", "kind": "preempt", "at": 1}])
+try:
+    step(net_b, tr_b, 2)
+    raise SystemExit("ci: FAIL — preemption did not fire")
+except faultline.InjectedPreemption:
+    pass
+faultline.clear()
+
+# 'restarted process': wrong init seed proves restore wins; restore
+# runs BEFORE the first step, like a real restart (it materializes the
+# kvstore/bucketer itself so the residuals have somewhere to land)
+net_c, tr_c = build(seed=77)
+s, arrays_r, meta_r = mgr.restore_latest()
+assert s == 2 and restore_training_state(arrays_r, meta_r, tr_c) == 2
+step(net_c, tr_c, 2)
+got = params_np(net_c)
+for k in ref:
+    assert got[k].tobytes() == ref[k].tobytes(), k
+mgr.close()
+print("ci: quantized int8 preempt/resume parity bitwise")
+EOF
+  fi
 }
 run_perf()   { python benchmark/opperf/opperf.py --smoke; }
 run_dryrun() {

@@ -126,6 +126,38 @@ assert _n_params == 6 and _delta < _n_params, (_delta, _n_params)
 print(f"smoke: bucketed allreduce ok ({int(_delta)} launches for "
       f"{_n_params} params)")
 
+# 2c'. block-scaled quantized allreduce gate (ISSUE 11): the int8 path
+# must keep every copy bitwise in sync, reproduce bitwise across fresh
+# stores (integer psum is reduction-order-free), and land within the
+# block-scale rounding envelope of the dense sum
+from mxnet_tpu import kvstore as _kvs
+
+_QN, _QBLK = 128, 64
+_qxs = [(onp.random.RandomState(5).randn(_QN) * (c + 1)).astype(onp.float32)
+        for c in range(4)]
+
+def _int8_reduce():
+    _kv = _kvs.create("tpu_ici")
+    _kv.set_gradient_compression({"type": "int8", "block": _QBLK})
+    _vals = [mx.np.array(_x, ctx=mx.cpu(c)) for c, _x in enumerate(_qxs)]
+    _kv.pushpull(0, _vals)
+    return [_v.asnumpy() for _v in _vals]
+
+_q1, _q2 = _int8_reduce(), _int8_reduce()
+assert all(onp.array_equal(_q1[0], _c) for _c in _q1[1:]), \
+    "int8 reduce left device copies out of sync"
+assert all(onp.array_equal(_a, _b) for _a, _b in zip(_q1, _q2)), \
+    "int8 reduce must be run-to-run deterministic"
+# shared per-block scale = pmax(amax)/127; each copy rounds once, so
+# |quantized sum - dense sum| <= n_copies * scale / 2 per element
+_qdense = sum(_qxs)
+_scale = onp.max(onp.abs(onp.stack(_qxs)).reshape(4, -1, _QBLK),
+                 axis=(0, 2)) / 127.0
+_qerr = onp.abs(_q1[0] - _qdense).reshape(-1, _QBLK)
+assert (_qerr <= len(_qxs) * _scale[:, None] / 2 + 1e-6).all(), \
+    "int8 reduce outside the block-scale rounding envelope"
+print("smoke: block-scaled int8 allreduce parity ok")
+
 # 2d. input-pipeline gate (ISSUE 10): sharded readers must partition the
 # record file deterministically, and the sharded prefetcher must build dp
 # global batches accounted under kind=shard_put (one wire crossing, no
@@ -187,9 +219,14 @@ EOF
 
 # 3b. quick compiled-program contract gate (ISSUE 7): the cheap
 # allreduce artifacts only — bucket census + resharding-freedom at the
-# HLO level; the full artifact set runs in ci.sh's hloscan stage
+# HLO level; the full artifact set runs in ci.sh's hloscan stage.  The
+# block-scaled programs (ISSUE 11) are pinned here too: quantize +
+# scale-agreement pmax + payload psum + dequantize must stay ONE launch
+# per bucket (2 all-reduce ops, zero extra dispatches)
 python -m tools.hloscan allreduce.bucket_dense allreduce.bucket_2bit \
-  allreduce.bucketed_step --verdicts --no-metrics
+  allreduce.bucket_int8 allreduce.bucket_fp8 \
+  allreduce.bucketed_step allreduce.bucketed_step_int8 \
+  --verdicts --no-metrics
 echo "smoke: hloscan allreduce contracts ok"
 
 # 3c. layer-census gate (ISSUE 8): the dp FusedTrainStep census artifact
