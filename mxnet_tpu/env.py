@@ -107,6 +107,17 @@ MXNET_IO_ERROR_TOLERANCE     decode-error fraction per window of records
                              WARNING and keeps ticking
                              ``mxtpu_io_decode_errors_total`` (default
                              0.01; read at iterator construction)
+MXNET_SERVE_REPLICAS         default replica count for ``serve.Fleet``
+                             (default 2; read when a fleet is created
+                             without an explicit ``replicas=``)
+MXNET_SERVE_DEADLINE_MS      base request deadline for the fleet's SLA
+                             classes: interactive = 1x, standard = 4x,
+                             batch = 20x (default 1000 ms; read when a
+                             router's class table is built)
+MXNET_SERVE_EJECT_AFTER      consecutive replica failures before the
+                             fleet ejects it from routing (default 2 —
+                             the tpu_ici two-observation suspicion rule;
+                             read when a fleet is created)
 =========================== =================================================
 """
 from __future__ import annotations
@@ -114,7 +125,8 @@ from __future__ import annotations
 import os
 
 __all__ = ["apply", "describe", "is_naive_engine", "cpu_worker_nthreads",
-           "decode_threads", "prefetch_depth", "io_error_tolerance"]
+           "decode_threads", "prefetch_depth", "io_error_tolerance",
+           "serve_replicas", "serve_deadline_ms", "serve_eject_after"]
 
 _naive_engine = False
 
@@ -151,6 +163,29 @@ def io_error_tolerance(default=0.01):
     if v is None:
         return default
     return max(0.0, float(v))
+
+
+def serve_replicas(default=2):
+    v = os.environ.get("MXNET_SERVE_REPLICAS")
+    if v is None:
+        return default
+    return max(1, int(v))
+
+
+def serve_deadline_ms(default=1000.0):
+    """Base deadline for the fleet SLA classes (interactive = 1x)."""
+    v = os.environ.get("MXNET_SERVE_DEADLINE_MS")
+    if v is None:
+        return default
+    return max(1.0, float(v))
+
+
+def serve_eject_after(default=2):
+    """Consecutive failures before a fleet replica is ejected."""
+    v = os.environ.get("MXNET_SERVE_EJECT_AFTER")
+    if v is None:
+        return default
+    return max(1, int(v))
 
 
 def apply():
@@ -203,5 +238,7 @@ def describe():
              "MXNET_TPU_MODEL_REPO", "MXNET_FAULTLINE",
              "MXNET_CHECKPOINT_KEEP", "MXNET_KVSTORE_RETRIES",
              "MXNET_KVSTORE_QBLOCK", "MXNET_DECODE_THREADS",
-             "MXNET_PREFETCH_DEPTH", "MXNET_IO_ERROR_TOLERANCE"]
+             "MXNET_PREFETCH_DEPTH", "MXNET_IO_ERROR_TOLERANCE",
+             "MXNET_SERVE_REPLICAS", "MXNET_SERVE_DEADLINE_MS",
+             "MXNET_SERVE_EJECT_AFTER"]
     return [(n, os.environ.get(n), n in __doc__) for n in names]

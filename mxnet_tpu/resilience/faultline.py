@@ -16,6 +16,7 @@ kvstore.kv           ``TPUICIStore._kv_try_get`` (coordination KV reads)
 kvstore.pushpull     ``TPUICIStore.pushpull`` (per-key collectives)
 collective.dispatch  ``GradBucketer._issue_bucket`` (bucketed collectives)
 serve.model_call     ``serve.Endpoint._execute`` (batched model call)
+serve.replica        ``serve.Fleet`` dispatch (replica-level kill/timeout)
 data.iterator        ``io.DevicePrefetcher._pull`` (feeder thread)
 checkpoint.write     ``resilience.checkpoint`` shard writer
 train.grads          ``FusedTrainStep._prepare`` (gradient poisoning)
@@ -61,8 +62,8 @@ __all__ = [
 ]
 
 SITES = ("kvstore.kv", "kvstore.pushpull", "collective.dispatch",
-         "serve.model_call", "data.iterator", "checkpoint.write",
-         "train.grads")
+         "serve.model_call", "serve.replica", "data.iterator",
+         "checkpoint.write", "train.grads")
 KINDS = ("timeout", "error", "preempt", "nan_grad")
 
 

@@ -22,16 +22,34 @@ Quickstart::
     print(ep.stats())                              # qps, p99, occupancy...
     ep.shutdown(drain=True)
 
-See ``docs/SERVING.md`` for bucket-grid sizing and the full API.
+Scaling past one host, :class:`Fleet` pools N endpoints pinned to
+disjoint device slices behind an SLA-aware router (priority/deadline
+service classes, deadline sheds with a distinct error, health-tracked
+replicas with ejection + re-admission, hot model-version swap), and
+:class:`ContinuousBatcher` runs the prefill/decode-split loop for
+autoregressive workloads — new sequences join the running decode batch
+between steps.
+
+See ``docs/SERVING.md`` for bucket-grid sizing, the Fleet routing and
+swap semantics, and the full API.
 """
 from .bucketing import BucketSpec, pick_bucket, pow2_buckets
 from .cache import ExecutableCache
+from .continuous import ContinuousBatcher
 from .endpoint import Endpoint, EndpointClosed, QueueFullError, \
     RequestTimeout
+from .fleet import Fleet, FleetMetrics, Replica
 from .metrics import EndpointMetrics
+from .router import (DeadlineExceeded, FleetClosed, NoHealthyReplica,
+                     PriorityRouter, ReplicaUnavailable, SLAClass,
+                     UnknownServiceClass, default_classes)
 
 __all__ = [
     "Endpoint", "BucketSpec", "ExecutableCache", "EndpointMetrics",
     "QueueFullError", "RequestTimeout", "EndpointClosed",
     "pick_bucket", "pow2_buckets",
+    "Fleet", "FleetMetrics", "Replica", "ContinuousBatcher",
+    "PriorityRouter", "SLAClass", "default_classes",
+    "UnknownServiceClass", "DeadlineExceeded", "NoHealthyReplica",
+    "ReplicaUnavailable", "FleetClosed",
 ]

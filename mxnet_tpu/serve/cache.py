@@ -22,14 +22,26 @@ class ExecutableCache:
     """Maps ``(input shapes, dtypes, donate)`` -> compiled executable
     for one endpoint function ``fn(*arrays)``."""
 
-    def __init__(self, fn, metrics=None, static_args=()):
+    def __init__(self, fn, metrics=None, static_args=(), device=None):
         self._fn = fn
+        self._device = device
         # params (or other per-endpoint constants) closed over every
         # executable; never donated — they are reused across calls.
+        # When the cache is pinned to a device (a fleet replica's slice),
+        # the statics move there once, at construction — not per call.
+        if device is not None:
+            static_args = tuple(
+                jax.tree_util.tree_map(lambda a: jax.device_put(a, device),
+                                       s) for s in static_args)
         self._static_args = tuple(static_args)
         self._metrics = metrics
         self._entries = {}
         self._lock = threading.Lock()
+
+    @property
+    def device(self):
+        """Device every executable is pinned to (None = jax default)."""
+        return self._device
 
     @staticmethod
     def key_for(arrays, donate):
@@ -37,6 +49,14 @@ class ExecutableCache:
                 bool(donate))
 
     def _compile(self, specs, donate):
+        if self._device is not None:
+            # pin the program to this cache's device: the AOT path takes
+            # placement from the input specs' shardings, and committed
+            # executables auto-place uncommitted (host) argument arrays,
+            # so callers need no per-call device_put
+            sharding = jax.sharding.SingleDeviceSharding(self._device)
+            specs = [jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                          sharding=sharding) for s in specs]
         n_static = len(self._static_args)
         donate_argnums = tuple(
             n_static + i for i in range(len(specs))) if donate else ()
@@ -75,6 +95,25 @@ class ExecutableCache:
         with self._lock:
             self._entries.setdefault(key, exe)
         return True
+
+    def warmed_grid(self):
+        """``[(shapes_dtypes, donate), ...]`` for every cached entry, in
+        the form ``warm()`` accepts.  This is the hot-swap staging input:
+        a successor cache (new model version) replays the live grid with
+        ``warm()`` BEFORE the version flip, so the swap never pays a
+        compile stall against live traffic."""
+        with self._lock:
+            keys = list(self._entries)
+        return [([(tuple(shp), dt) for shp, dt in sig], donate)
+                for sig, donate in keys]
+
+    def adopt_grid(self, other):
+        """Precompile this cache for every shape ``other`` has served
+        (see :meth:`warmed_grid`).  Returns the number compiled."""
+        compiled = 0
+        for shapes_dtypes, donate in other.warmed_grid():
+            compiled += bool(self.warm(shapes_dtypes, donate=donate))
+        return compiled
 
     def __len__(self):
         with self._lock:

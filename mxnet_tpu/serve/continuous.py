@@ -1,0 +1,271 @@
+"""Continuous batching for autoregressive decode.
+
+The drain-batch serving shape (batch N prompts, decode until *all*
+finish) wastes device time: short sequences sit done while the longest
+one drags the batch.  Continuous batching (the Orca/vLLM scheduling
+shape, and the Gemma-on-TPU pool design in PAPERS.md) splits serving
+into two programs:
+
+* **prefill** — per-sequence: ``prefill_fn(prompt) -> (carry, token)``
+  consumes the whole prompt once and returns the sequence's decode
+  state (for a transformer, the KV cache the PR 3 flash kernels
+  attend over) plus the first generated token;
+* **decode** — one fixed-shape program over a **slot-stacked** batch:
+  ``decode_fn(carry_stack, last_tokens) -> (carry_stack, next_tokens)``
+  advances every active slot one token.  The slot count is fixed, so
+  there is exactly ONE decode executable — steady state never
+  retraces (the same property :class:`ExecutableCache` gives the
+  request endpoint; the telemetry retrace watchdog would flag a leak).
+
+New sequences **join between decode steps**: a finished prefill is
+scattered into a free slot (a jitted ``carry.at[slot].set(new)``)
+while the rest of the batch keeps decoding — nobody waits for a drain.
+A sequence leaves the moment it emits ``eos_id`` or hits its token
+budget, freeing the slot for the next admission.  Inactive slots decode
+garbage rows; like endpoint batch padding this requires ``decode_fn``
+to be row-independent, so occupied slots are numerically identical to
+a solo run (``tests/test_fleet.py`` checks join/leave traffic against
+a drain-batch oracle).
+
+The per-step host sync is the (slots,) token vector only — the carry
+stays on device for the sequence's whole life.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from concurrent.futures import Future
+
+import numpy as onp
+
+from .. import telemetry as _telemetry
+from .endpoint import EndpointClosed
+
+__all__ = ["ContinuousBatcher"]
+
+_counter = itertools.count()
+
+
+class _Sequence:
+    __slots__ = ("prompt", "max_new_tokens", "future", "tokens", "slot")
+
+    def __init__(self, prompt, max_new_tokens):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.future = Future()
+        self.tokens = []
+        self.slot = None
+
+
+class ContinuousBatcher:
+    """Runs ``decode_fn`` as a persistent slot-batch; ``submit()`` adds
+    sequences that join it between steps.
+
+    Parameters
+    ----------
+    prefill_fn : callable
+        ``prompt -> (carry, first_token)``; carry is a pytree of
+        per-sequence arrays, token an integer scalar.
+    decode_fn : callable
+        ``(carry_stack, last_tokens) -> (carry_stack, next_tokens)``
+        over the slot axis; must be row-independent (each slot's next
+        token depends only on that slot's carry and token).
+    slots : int
+        Decode batch capacity (fixes the decode program's shape).
+    max_new_tokens : int
+        Default per-sequence generation budget (prompt's first token
+        included).
+    eos_id : int or None
+        Token that ends a sequence early.
+    """
+
+    def __init__(self, prefill_fn, decode_fn, slots=4, max_new_tokens=32,
+                 eos_id=None, name=None, start=True):
+        import jax
+
+        if slots < 1:
+            raise ValueError("need at least one decode slot")
+        self.name = name or f"continuous_{next(_counter)}"
+        self.slots = slots
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self._prefill = jax.jit(prefill_fn)
+        # the decode program is THE hot loop: watch it for retraces
+        self._decode = _telemetry.watch_jit(
+            jax.jit(decode_fn), name=f"serve/{self.name}/decode")
+        self._join_carry = jax.jit(
+            lambda stack, new, idx: jax.tree_util.tree_map(
+                lambda s, n: s.at[idx].set(n), stack, new))
+        self._waiting = []
+        self._active = [None] * slots     # slot -> _Sequence
+        self._carry = None                # slot-stacked decode state
+        self._last = None                 # (slots,) last emitted tokens
+        self._cv = threading.Condition()
+        self._closed = False
+        self._drain = True
+
+        reg = _telemetry.default_registry()
+        steps = reg.counter(
+            "mxtpu_continuous_total",
+            "Continuous-batcher activity: decode steps, sequence joins, "
+            "sequence leaves", ("batcher", "event"))
+        self._ev = {e: steps.labels(batcher=self.name, event=e)
+                    for e in ("steps", "joins", "leaves")}
+        self._occupancy = reg.gauge(
+            "mxtpu_continuous_occupancy",
+            "Active decode slots / capacity",
+            ("batcher",)).labels(batcher=self.name)
+        self._worker = None
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._closed = False
+            self._worker = threading.Thread(
+                target=self._run, name=f"continuous:{self.name}",
+                daemon=True)
+            self._worker.start()
+        return self
+
+    def shutdown(self, drain=True, timeout=None):
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._drain = drain
+            self._cv.notify_all()
+        if self._worker is not None and self._worker.is_alive():
+            self._worker.join(timeout=timeout)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None):
+        """Queue one prompt (1-D int array).  Returns a Future resolving
+        to the generated token array (first token included, eos
+        excluded)."""
+        prompt = onp.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError("prompt must be a non-empty 1-D token array")
+        budget = int(max_new_tokens if max_new_tokens is not None
+                     else self.max_new_tokens)
+        if budget < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        seq = _Sequence(prompt, budget)
+        with self._cv:
+            if self._closed:
+                raise EndpointClosed(
+                    f"continuous batcher {self.name} is shut down")
+            self._waiting.append(seq)
+            self._cv.notify()
+        return seq.future
+
+    def generate(self, prompt, max_new_tokens=None, timeout=None):
+        """Blocking submit."""
+        return self.submit(
+            prompt, max_new_tokens=max_new_tokens).result(timeout=timeout)
+
+    def stats(self):
+        with self._cv:
+            active = sum(s is not None for s in self._active)
+            waiting = len(self._waiting)
+        return {"slots": self.slots, "active": active, "waiting": waiting,
+                "steps": self._ev["steps"].value,
+                "joins": self._ev["joins"].value,
+                "leaves": self._ev["leaves"].value}
+
+    # -- the decode loop ---------------------------------------------------
+    def _free_slots(self):
+        return [i for i, s in enumerate(self._active) if s is None]
+
+    def _admit(self):
+        """Prefill waiting sequences into free slots (between steps)."""
+        import jax.numpy as jnp
+
+        while True:
+            with self._cv:
+                free = self._free_slots()
+                if not free or not self._waiting:
+                    return
+                seq = self._waiting.pop(0)
+                slot = free[0]
+                self._active[slot] = seq
+                seq.slot = slot
+            carry, tok = self._prefill(seq.prompt)
+            if self._carry is None:
+                # first sequence ever: materialize the slot-stacked
+                # decode state from its carry structure
+                import jax
+                self._carry = jax.tree_util.tree_map(
+                    lambda leaf: jnp.zeros((self.slots,) + leaf.shape,
+                                           leaf.dtype), carry)
+                self._last = jnp.zeros((self.slots,),
+                                       jnp.asarray(tok).dtype)
+            self._carry = self._join_carry(self._carry, carry,
+                                           jnp.int32(slot))
+            self._last = self._last.at[slot].set(tok)
+            seq.tokens.append(int(tok))
+            self._ev["joins"].inc()
+            self._finish_done([slot])    # budget of 1: done at prefill
+
+    def _finish_done(self, slot_indices):
+        """Resolve sequences that hit eos or their token budget."""
+        for slot in slot_indices:
+            seq = self._active[slot]
+            if seq is None:
+                continue
+            done = len(seq.tokens) >= seq.max_new_tokens
+            if self.eos_id is not None and seq.tokens \
+                    and seq.tokens[-1] == self.eos_id:
+                seq.tokens.pop()         # eos is a terminator, not output
+                done = True
+            if done:
+                with self._cv:
+                    self._active[slot] = None
+                if not seq.future.done():
+                    seq.future.set_result(
+                        onp.asarray(seq.tokens, dtype=onp.int64))
+                self._ev["leaves"].inc()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                idle = not self._waiting \
+                    and all(s is None for s in self._active)
+                if self._closed and (idle or not self._drain):
+                    break
+                if idle:
+                    self._cv.wait(timeout=0.1)
+                    continue
+            self._admit()
+            active = [i for i, s in enumerate(self._active)
+                      if s is not None]
+            self._occupancy.set(len(active) / self.slots)
+            if not active:
+                continue
+            # one step for the whole slot batch; the only host pull is
+            # the (slots,) token vector
+            self._carry, self._last = self._decode(self._carry, self._last)
+            toks = onp.asarray(self._last)
+            self._ev["steps"].inc()
+            for slot in active:
+                self._active[slot].tokens.append(int(toks[slot]))
+            self._finish_done(active)
+        # non-draining close: whatever is left must still get an answer
+        with self._cv:
+            leftovers = self._waiting[:] + [s for s in self._active
+                                            if s is not None]
+            self._waiting = []
+            self._active = [None] * self.slots
+        for seq in leftovers:
+            if not seq.future.done():
+                seq.future.set_exception(EndpointClosed(
+                    f"continuous batcher {self.name} shut down without "
+                    "draining"))
+        self._occupancy.set(0.0)

@@ -61,7 +61,7 @@ _counter = itertools.count()
 
 class _Request:
     __slots__ = ("arrays", "rows", "seq_len", "future", "t_enqueue",
-                 "deadline", "signature")
+                 "deadline", "signature", "version")
 
     def __init__(self, arrays, signature, seq_len, timeout_s):
         self.arrays = arrays
@@ -71,6 +71,7 @@ class _Request:
         self.future = Future()
         self.t_enqueue = time.perf_counter()
         self.deadline = (self.t_enqueue + timeout_s) if timeout_s else None
+        self.version = 0          # model version that admitted the request
 
 
 class _HookHandle:
@@ -112,16 +113,27 @@ class Endpoint:
     donate : bool
         Donate input buffers to the executable (steady-state serving
         never reuses them; saves one batch-sized buffer per call).
+    device : jax.Device or None
+        Pin every executable (and the parameters) to one device — a
+        fleet replica's mesh slice.  None uses the jax default.
+
+    Models are **versioned**: :meth:`swap_model` stages a new version's
+    executables off the hot path, then flips atomically.  Every request
+    is pinned at submit() to the version that admitted it, so in-flight
+    traffic is answered by the old model while new traffic gets the new
+    one; a retired version's executables are dropped once its last
+    in-flight request resolves.
     """
 
     def __init__(self, model, name=None, max_batch_size=8,
                  max_latency_ms=5.0, batch_buckets=None, seq_buckets=None,
                  seq_axis=1, max_queue=256, full_policy="raise",
-                 timeout_ms=None, donate=False, start=True):
+                 timeout_ms=None, donate=False, device=None, start=True):
         if full_policy not in ("raise", "block"):
             raise ValueError("full_policy must be 'raise' or 'block'")
         self.model = model
         self.name = name or f"{type(model).__name__}_{next(_counter)}"
+        self.device = device
         self.spec = BucketSpec(max_batch_size, batch_buckets=batch_buckets,
                                seq_buckets=seq_buckets, seq_axis=seq_axis)
         self.max_latency_s = max_latency_ms / 1e3
@@ -130,7 +142,11 @@ class Endpoint:
         self.donate = donate
         self.metrics = EndpointMetrics(self.name)
         self._queue = _queue.Queue(maxsize=max_queue)
-        self._cache = None            # built lazily (needs input shapes)
+        self._version = 0
+        self._models = {0: model}     # version -> model
+        self._caches = {}             # version -> ExecutableCache (lazy)
+        self._inflight = {}           # version -> unresolved request count
+        self._example_arrays = None   # first-seen inputs (swap staging)
         self._model_lock = threading.Lock()
         self._batch_hooks = []
         self._closed = False
@@ -208,9 +224,17 @@ class Endpoint:
         timeout_s = (timeout_ms / 1e3) if timeout_ms is not None \
             else self.timeout_s
         req = _Request(arrays, signature, seq_len, timeout_s)
+        with self._model_lock:
+            # pin the admitting version atomically vs swap_model's flip:
+            # this request is answered by THIS version, whatever lands
+            # in the queue behind it
+            req.version = self._version
+            self._inflight[req.version] = \
+                self._inflight.get(req.version, 0) + 1
         try:
             self._queue.put(req, block=self.full_policy == "block")
         except _queue.Full:
+            self._retire(req)
             self.metrics.incr("rejected_full")
             raise QueueFullError(
                 f"endpoint {self.name}: queue full "
@@ -234,45 +258,113 @@ class Endpoint:
         return _HookHandle(self._batch_hooks, hook, self._model_lock)
 
     # -- model -> pure fn --------------------------------------------------
-    def _ensure_executable(self, arrays):
-        """Build the pure jax function + cache on first use (parameter
-        shapes may be deferred until the first concrete input)."""
-        if self._cache is not None:
-            return
-        with self._model_lock:
-            if self._cache is not None:
-                return
-            import jax
-            from ..gluon.block import Block, _scoped_forward
-            from ..ndarray.ndarray import NDArray
+    def _build_cache(self, model, arrays):
+        """Pure jax function + :class:`ExecutableCache` for ``model``
+        (parameter shapes may be deferred until the first concrete
+        input).  Compile-free; executables come later via warm()/get()."""
+        import jax
+        from ..gluon.block import Block, _scoped_forward
+        from ..ndarray.ndarray import NDArray
 
-            if isinstance(self.model, Block):
-                nds = [NDArray(onp.asarray(a)) for a in arrays]
-                if hasattr(self.model, "_ensure_shapes"):
-                    self.model._ensure_shapes(*nds)
-                else:
-                    self.model(*nds)   # finish any deferred init
-                params = self.model.collect_params()
-                names = sorted(k for k in params
-                               if params[k]._data is not None)
-                plist = [params[k] for k in names]
-                param_datas = tuple(p.data()._data for p in plist)
-                treedef = jax.tree_util.tree_structure(
-                    tuple(range(len(arrays))))
-                block = self.model
-
-                def fn(param_datas_, *input_datas):
-                    # serving graph: predict mode, fixed key (dropout off)
-                    out, _aux = _scoped_forward(
-                        block, plist, param_datas_, jax.random.key(0),
-                        list(input_datas), treedef, training=False)
-                    return out
-
-                self._cache = ExecutableCache(
-                    fn, metrics=self.metrics, static_args=(param_datas,))
+        if isinstance(model, Block):
+            nds = [NDArray(onp.asarray(a)) for a in arrays]
+            if hasattr(model, "_ensure_shapes"):
+                model._ensure_shapes(*nds)
             else:
-                self._cache = ExecutableCache(
-                    self.model, metrics=self.metrics)
+                model(*nds)        # finish any deferred init
+            params = model.collect_params()
+            names = sorted(k for k in params
+                           if params[k]._data is not None)
+            plist = [params[k] for k in names]
+            param_datas = tuple(p.data()._data for p in plist)
+            treedef = jax.tree_util.tree_structure(
+                tuple(range(len(arrays))))
+
+            def fn(param_datas_, *input_datas):
+                # serving graph: predict mode, fixed key (dropout off)
+                out, _aux = _scoped_forward(
+                    model, plist, param_datas_, jax.random.key(0),
+                    list(input_datas), treedef, training=False)
+                return out
+
+            return ExecutableCache(fn, metrics=self.metrics,
+                                   static_args=(param_datas,),
+                                   device=self.device)
+        return ExecutableCache(model, metrics=self.metrics,
+                               device=self.device)
+
+    def _cache_for(self, version, arrays):
+        """The executable cache serving ``version``, built lazily."""
+        cache = self._caches.get(version)
+        if cache is not None:
+            return cache
+        with self._model_lock:
+            cache = self._caches.get(version)
+            if cache is not None:
+                return cache
+            model = self._models[version]
+            if self._example_arrays is None:
+                self._example_arrays = [onp.asarray(a) for a in arrays]
+            cache = self._build_cache(model, arrays)
+            self._caches[version] = cache
+            return cache
+
+    def _ensure_executable(self, arrays):
+        """Build the live version's cache (analysis/capture entry)."""
+        self._cache_for(self._version, arrays)
+
+    @property
+    def _cache(self):
+        """The live version's cache (None before the first request) —
+        the artifact source ``tools.hloscan`` captures."""
+        return self._caches.get(self._version)
+
+    def _retire(self, req):
+        """One request resolved: drop its version's executables once it
+        was both retired (swap happened) and fully drained."""
+        with self._model_lock:
+            v = req.version
+            n = self._inflight.get(v, 1) - 1
+            if n > 0:
+                self._inflight[v] = n
+                return
+            self._inflight.pop(v, None)
+            if v != self._version:
+                self._caches.pop(v, None)
+                self._models.pop(v, None)
+
+    def swap_model(self, model, stage=True):
+        """Hot-swap to a new model version.
+
+        Stages the new version's executables first — builds its cache
+        and replays the live cache's warmed shape grid via
+        :meth:`ExecutableCache.adopt_grid` — then flips the version
+        atomically.  Requests already admitted keep the version that
+        admitted them (their executables stay alive until they drain);
+        requests submitted after the flip get ``model``.  Returns the
+        new version number.  ``stage=False`` skips pre-compilation (the
+        first post-swap request pays the compile instead)."""
+        staged = None
+        with self._model_lock:
+            live_cache = self._caches.get(self._version)
+            example = self._example_arrays
+        if stage and live_cache is not None and example is not None:
+            staged = self._build_cache(model, example)
+            staged.adopt_grid(live_cache)
+        with self._model_lock:
+            self._version += 1
+            v = self._version
+            self._models[v] = model
+            if staged is not None:
+                self._caches[v] = staged
+            self.model = model
+            # versions that already drained can go now; the rest go in
+            # _retire() when their last in-flight request resolves
+            for old in [u for u in self._models
+                        if u != v and not self._inflight.get(u)]:
+                self._models.pop(old, None)
+                self._caches.pop(old, None)
+        return v
 
     def warmup(self, *example_inputs):
         """Precompile the full bucket grid for this input signature:
@@ -281,7 +373,7 @@ class Endpoint:
         extents are ignored).  Returns the number of executables
         compiled."""
         arrays = [self._to_numpy(x) for x in example_inputs]
-        self._ensure_executable(arrays)
+        cache = self._cache_for(self._version, arrays)
         compiled = 0
         seq_grid = self.spec.seq_buckets or [None]
         for b in self.spec.batch_buckets:
@@ -292,14 +384,16 @@ class Endpoint:
                     if s is not None and a.ndim > self.spec.seq_axis:
                         shape[self.spec.seq_axis] = s
                     shapes.append((tuple(shape), a.dtype))
-                compiled += bool(self._cache.warm(shapes,
-                                                  donate=self.donate))
+                compiled += bool(cache.warm(shapes, donate=self.donate))
         return compiled
 
     def stats(self):
         out = self.metrics.stats()
         out["queue_depth"] = self._queue.qsize()
-        out["executables"] = len(self._cache) if self._cache else 0
+        with self._model_lock:
+            out["executables"] = sum(
+                len(c) for c in self._caches.values())
+            out["model_version"] = self._version
         return out
 
     # -- the batcher loop --------------------------------------------------
@@ -386,6 +480,7 @@ class Endpoint:
                     EndpointClosed(f"endpoint {self.name} shut down "
                                    "without draining"))
                 self.metrics.incr("failed")
+                self._retire(req)
 
     def _dispatch(self, batch):
         """Group compatible requests, run one device call per group,
@@ -399,11 +494,15 @@ class Endpoint:
                         f"request waited past its deadline "
                         f"({(now - req.t_enqueue) * 1e3:.1f} ms)"))
                 self.metrics.incr("timeouts")
+                self._retire(req)
             else:
+                self.metrics.observe_queue_wait(now - req.t_enqueue)
                 live.append(req)
         groups = {}
         for req in live:
-            groups.setdefault(req.signature, []).append(req)
+            # a swap between two requests' submits splits them into
+            # different groups: each batch runs ONE version's executable
+            groups.setdefault((req.signature, req.version), []).append(req)
         for group in groups.values():
             try:
                 self._execute(group)
@@ -412,6 +511,7 @@ class Endpoint:
                     if not group[0].future.done():
                         group[0].future.set_exception(exc)
                     self.metrics.incr("failed")
+                    self._retire(group[0])
                 else:
                     # isolate the poison: rerun each request alone so
                     # only the bad one fails
@@ -423,12 +523,14 @@ class Endpoint:
         import jax.numpy as jnp
         from ..ndarray.ndarray import NDArray
 
-        self._ensure_executable(group[0].arrays)
+        cache = self._cache_for(group[0].version, group[0].arrays)
         rows = sum(r.rows for r in group)
         bucket = pick_bucket(rows, self.spec.batch_buckets)
         n_inputs = len(group[0].arrays)
-        padded = [jnp.asarray(self.spec.pad_concat(
-            [r.arrays[i] for r in group], bucket))
+        # device=None device_put == jnp.asarray (default placement);
+        # pinned endpoints land the batch on their replica's slice
+        padded = [jax.device_put(self.spec.pad_concat(
+            [r.arrays[i] for r in group], bucket), self.device)
             for i in range(n_inputs)]
         padded_seq = padded[0].shape[self.spec.seq_axis] \
             if (self.spec.seq_buckets
@@ -443,7 +545,7 @@ class Endpoint:
             # fault hook fires BEFORE the device call, so a retried
             # injection never re-dispatches against donated buffers
             _faultline.check("serve.model_call")
-            o = self._cache(padded, donate=self.donate)
+            o = cache(padded, donate=self.donate)
             return jax.block_until_ready(o)
 
         t0 = time.perf_counter()
@@ -459,6 +561,7 @@ class Endpoint:
         latency = time.perf_counter() - t0
 
         self.metrics.observe_batch(rows, bucket)
+        self.metrics.observe_execute(latency)
         for hook in list(self._batch_hooks):
             hook(self, rows, bucket, latency)
 
@@ -482,3 +585,4 @@ class Endpoint:
             if not req.future.done():
                 req.future.set_result(result)
             self.metrics.observe_latency(time.perf_counter() - req.t_enqueue)
+            self._retire(req)

@@ -11,7 +11,8 @@ Every update is also published into the :mod:`mxnet_tpu.telemetry`
 default registry under an ``endpoint`` label
 (``mxtpu_serve_requests_total`` / ``_batches_total`` /
 ``_batch_rows_total`` / ``_cache_total`` / ``_queue_depth`` /
-``_latency_seconds``), so one ``telemetry.export_prometheus()`` scrape
+``_latency_seconds`` / ``_queue_wait_seconds`` / ``_execute_seconds``),
+so one ``telemetry.export_prometheus()`` scrape
 covers every live endpoint next to the trainer and kvstore series.
 Registry children are resolved once at construction — the per-event cost
 is a locked add.
@@ -82,6 +83,19 @@ class EndpointMetrics:
             "mxtpu_serve_latency_seconds",
             "End-to-end request latency (enqueue to result delivery)",
             ("endpoint",)).labels(endpoint=name)
+        # end-to-end latency decomposed: time queued waiting for a batch
+        # vs time inside the device call — the two knobs (max_latency_ms
+        # / bucket grid) tune different halves, so the storm gate and
+        # dashboards need them separately (p50/p99 via .quantile())
+        self._reg_queue_wait = reg.histogram(
+            "mxtpu_serve_queue_wait_seconds",
+            "Time a request waited in the endpoint queue before its "
+            "batch was dispatched", ("endpoint",)).labels(endpoint=name)
+        self._reg_execute = reg.histogram(
+            "mxtpu_serve_execute_seconds",
+            "Device-call latency per dispatched batch (pad/concat + "
+            "executable run + result sync)",
+            ("endpoint",)).labels(endpoint=name)
 
     def incr(self, name, delta=1):
         with self._lock:
@@ -103,6 +117,12 @@ class EndpointMetrics:
         self._reg_batches.inc()
         self._reg_rows_real.inc(real_rows)
         self._reg_rows_slots.inc(bucket_rows)
+
+    def observe_queue_wait(self, seconds):
+        self._reg_queue_wait.observe(seconds)
+
+    def observe_execute(self, seconds):
+        self._reg_execute.observe(seconds)
 
     def observe_latency(self, seconds):
         with self._lock:
@@ -137,4 +157,10 @@ class EndpointMetrics:
                 "latency_ms_p95": float(onp.percentile(lat, 95)) if n else None,
                 "latency_ms_p99": float(onp.percentile(lat, 99)) if n else None,
             })
-            return out
+        for key, child in (("queue_wait_ms", self._reg_queue_wait),
+                           ("execute_ms", self._reg_execute)):
+            for q in (0.5, 0.99):
+                v = child.quantile(q)
+                out[f"{key}_p{int(q * 100)}"] = (
+                    v * 1e3 if v is not None else None)
+        return out

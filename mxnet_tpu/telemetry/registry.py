@@ -130,6 +130,33 @@ class _HistogramChild:
             out.append(cum)
         return out
 
+    def quantile(self, q):
+        """Estimated value at quantile ``q`` in [0, 1] from the bucket
+        counts — Prometheus ``histogram_quantile`` semantics: find the
+        bucket the rank lands in, interpolate linearly inside it.
+        Observations in the overflow bucket clamp to the largest finite
+        bound (there is no upper edge to interpolate toward).  Returns
+        None when the histogram is empty.  This is what serving SLO
+        gates read (p50/p99 per class) without keeping a reservoir."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if not total:
+            return None
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c and cum + c >= rank:
+                if i == len(self._bounds):      # overflow bucket
+                    return float(self._bounds[-1])
+                lo = self._bounds[i - 1] if i > 0 else 0.0
+                return float(lo + (self._bounds[i] - lo)
+                             * max(rank - cum, 0.0) / c)
+            cum += c
+        return float(self._bounds[-1])
+
     def _samples(self, name, labels):
         out = []
         cums = self.bucket_counts()
@@ -265,6 +292,9 @@ class Histogram(_MetricFamily):
 
     def observe(self, value):
         self._unlabeled().observe(value)
+
+    def quantile(self, q):
+        return self._unlabeled().quantile(q)
 
 
 class MetricsRegistry:

@@ -1,0 +1,444 @@
+"""serve.fleet (ISSUE 12): replica pool, SLA routing, continuous
+batching, hot swap, and failover.
+
+Covers the acceptance grid: batched == unbatched parity through the
+router, priority ordering under a full queue, deadline shedding (a
+distinct error, never a silent drop), unknown-class / unroutable-replica
+negatives, the ejection/re-admission state machine (unit and via an
+injected-timeout storm), continuous-batching join/leave against a
+drain-batch oracle, hot swap with in-flight requests pinned to their
+admitting version, and a kill-mid-traffic zero-drop smoke.
+"""
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import faultline
+from mxnet_tpu.serve import (ContinuousBatcher, DeadlineExceeded,
+                             EndpointClosed, Fleet, FleetClosed,
+                             NoHealthyReplica, PriorityRouter, Replica,
+                             ReplicaUnavailable, UnknownServiceClass)
+from mxnet_tpu.serve.endpoint import Endpoint
+from mxnet_tpu.serve.fleet import DEAD, DRAINING, EJECTED, HEALTHY
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faultline.clear()
+    yield
+    faultline.clear()
+
+
+def _sample(name, labels=None):
+    v = telemetry.default_registry().get_sample_value(name, labels)
+    return 0.0 if v is None else v
+
+
+def _mlp(out_units=4, in_units=8, seed=None):
+    if seed is not None:
+        mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(out_units))
+    net.initialize()
+    net(mx.np.zeros((1, in_units)))
+    return net
+
+
+# -- routing: parity, priority, shedding, negatives ---------------------------
+
+def test_fleet_batched_matches_unbatched(rng):
+    """Requests routed through the fleet return exactly what a direct
+    forward pass returns — padding, slicing, and replica choice are
+    value-preserving."""
+    net = _mlp()
+    xs = [rng.standard_normal((n, 8)).astype(onp.float32)
+          for n in (1, 3, 2, 4, 1, 2)]
+    refs = [net(mx.np.array(x)).asnumpy() for x in xs]
+    clss = ["interactive", "standard", "batch"]
+    with Fleet(net, replicas=2, name="t_parity", max_batch_size=4,
+               max_latency_ms=2) as fleet:
+        fleet.warmup(xs[0])
+        futs = [fleet.submit(x, cls=clss[i % 3], timeout_ms=60_000)
+                for i, x in enumerate(xs)]
+        outs = [f.result(timeout=60) for f in futs]
+    for out, ref in zip(outs, refs):
+        assert out.shape == ref.shape
+        onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5,
+                                    atol=1e-6)
+
+
+def test_priority_ordering_under_full_queue(rng):
+    """With the dispatcher stopped and the heap full, interactive pops
+    before standard before batch, FIFO within each class."""
+    net = _mlp()
+    fleet = Fleet(net, replicas=1, name="t_prio", start=False,
+                  max_batch_size=4, max_latency_ms=1)
+    # submit in anti-priority order so ordering can't be an accident
+    order = [("batch", 0), ("batch", 1), ("standard", 2),
+             ("standard", 3), ("interactive", 4), ("interactive", 5)]
+    futs = []
+    for cls, tag in order:
+        x = onp.full((1, 8), float(tag), dtype=onp.float32)
+        futs.append(fleet.submit(x, cls=cls, timeout_ms=60_000))
+    popped = [fleet.router.pop(timeout=1) for _ in range(len(order))]
+    assert [r.sla.name for r in popped] == \
+        ["interactive"] * 2 + ["standard"] * 2 + ["batch"] * 2
+    # FIFO within class: the tag baked into each payload stays ordered
+    assert [int(r.arrays[0][0, 0]) for r in popped] == [4, 5, 2, 3, 0, 1]
+    # put them back and let the fleet actually serve them
+    for r in popped:
+        fleet.router.push(r, r.sla.priority)
+    fleet.start()
+    for f in futs:
+        assert f.result(timeout=60).shape == (1, 4)
+    fleet.shutdown(drain=True)
+
+
+def test_deadline_shed_is_distinct_error(rng):
+    """A request whose deadline passes before dispatch is shed with
+    DeadlineExceeded — and the shed counter ticks (never a drop)."""
+    net = _mlp()
+    fleet = Fleet(net, replicas=1, name="t_shed", start=False,
+                  max_batch_size=4, max_latency_ms=1)
+    x = rng.standard_normal((1, 8)).astype(onp.float32)
+    fut = fleet.submit(x, cls="interactive", timeout_ms=30)
+    time.sleep(0.1)                      # deadline passes pre-dispatch
+    fleet.start()
+    with pytest.raises(DeadlineExceeded, match="shed, not dropped"):
+        fut.result(timeout=30)
+    assert fleet.metrics.value("interactive", "shed") == 1
+    assert fleet.metrics.value("interactive", "completed") == 0
+    fleet.shutdown(drain=True)
+    with pytest.raises(FleetClosed):
+        fleet.submit(x)
+
+
+def test_unknown_service_class_lists_supported(rng):
+    net = _mlp()
+    fleet = Fleet(net, replicas=1, name="t_unknown", start=False)
+    with pytest.raises(UnknownServiceClass) as exc:
+        fleet.submit(onp.zeros((1, 8), onp.float32), cls="premium")
+    msg = str(exc.value)
+    assert "'interactive', 'standard', 'batch'" in msg
+    assert "docs/SERVING.md" in msg
+    fleet.shutdown()
+
+
+def test_pinned_submit_to_unroutable_replica_carries_fleet_state(rng):
+    """Pinning to an ejected or draining replica raises
+    ReplicaUnavailable with the full per-replica fleet state."""
+    net = _mlp()
+    x = rng.standard_normal((1, 8)).astype(onp.float32)
+    with Fleet(net, replicas=2, name="t_pin", max_batch_size=4,
+               max_latency_ms=2) as fleet:
+        fleet.replicas[1].set_state(EJECTED)
+        with pytest.raises(ReplicaUnavailable) as exc:
+            fleet.submit(x, replica=1)
+        msg = str(exc.value)
+        assert "r1" in msg and "ejected" in msg
+        assert "r0=healthy" in msg         # the whole fleet state
+        # drained replicas are equally unroutable for pinned traffic...
+        fleet.drain_replica(1)
+        with pytest.raises(ReplicaUnavailable, match="draining"):
+            fleet.submit(x, replica=1)
+        # ...but unpinned traffic still lands on the survivor
+        out = fleet.predict(x, timeout_ms=60_000)
+        assert out.shape == (1, 4)
+
+
+def test_no_healthy_replica_when_all_dead(rng):
+    net = _mlp()
+    x = rng.standard_normal((1, 8)).astype(onp.float32)
+    fleet = Fleet(net, replicas=1, name="t_alldead", max_batch_size=4,
+                  max_latency_ms=2)
+    fleet.predict(x, timeout_ms=60_000)    # healthy baseline
+    fleet.kill_replica(0)
+    with pytest.raises(NoHealthyReplica, match="r0=dead"):
+        fleet.predict(x, timeout_ms=2_000)
+    fleet.shutdown(drain=True)
+
+
+# -- health: ejection / re-admission ------------------------------------------
+
+def test_replica_state_machine_unit():
+    """Two-observation ejection, success clears suspicion, probe
+    success readmits; kill/drain are terminal for routing."""
+    rep = Replica(0, endpoint=None, eject_after=2)
+    assert rep.is_routable() and rep.state == HEALTHY
+    assert rep.record_failure() is False       # SUSPECT, not ejected
+    assert rep.state == HEALTHY and rep.consecutive_failures == 1
+    rep.record_success()                       # fresh success clears
+    assert rep.consecutive_failures == 0
+    assert rep.record_failure() is False
+    assert rep.record_failure() is True        # second consecutive: eject
+    assert rep.state == EJECTED and not rep.is_routable()
+    assert rep.record_failure() is False       # already ejected
+    assert rep.record_success() is True        # probe success readmits
+    assert rep.state == HEALTHY and rep.consecutive_failures == 0
+    rep.set_state(DEAD)
+    assert not rep.is_routable()
+    assert "r0=dead" in rep.describe()
+
+
+def test_ejection_and_probe_readmission_end_to_end(rng):
+    """Injected transport timeouts strike the replica twice (two
+    endpoint submissions, one retry each = 4 model-call arrivals), the
+    fleet ejects it, the re-admission probe brings it back once the
+    fault clears, and the held request still completes."""
+    net = _mlp()
+    x = rng.standard_normal((2, 8)).astype(onp.float32)
+    ref = net(mx.np.array(x)).asnumpy()
+    fleet = Fleet(net, replicas=1, name="t_eject", max_batch_size=4,
+                  max_latency_ms=1, probe_interval=0.05)
+    fleet.warmup(x)                     # seeds the 1-row probe payload
+    faultline.plan([{"site": "serve.model_call", "kind": "timeout",
+                     "at": 1, "times": 4}])
+    out = fleet.predict(x, cls="standard", timeout_ms=20_000)
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+    rep = fleet.replicas[0]
+    assert rep.state == HEALTHY         # readmitted by a probe success
+    assert _sample("mxtpu_fleet_probes_total",
+                   {"fleet": "t_eject", "outcome": "ok"}) >= 1
+    # ejection was observed, not skipped: two strikes were recorded and
+    # cleared again by the probe
+    assert rep.consecutive_failures == 0
+    fleet.shutdown(drain=True)
+
+
+def test_kill_replica_mid_traffic_zero_drop(rng):
+    """The storm gate in miniature: a planned preempt kills the picked
+    replica under live traffic; every request is still answered
+    correctly by the survivor, and the failover is visible in the
+    metrics."""
+    net = _mlp()
+    xs = [rng.standard_normal((1 + i % 3, 8)).astype(onp.float32)
+          for i in range(8)]
+    refs = [net(mx.np.array(x)).asnumpy() for x in xs]
+    fleet = Fleet(net, replicas=2, name="t_kill", max_batch_size=4,
+                  max_latency_ms=2)
+    fleet.warmup(xs[0])
+    before = _sample("mxtpu_faults_recovered_total",
+                     {"site": "serve.replica", "kind": "preempt"})
+    faultline.plan([{"site": "serve.replica", "kind": "preempt",
+                     "at": 2}])
+    futs = [fleet.submit(x, cls="interactive", timeout_ms=60_000)
+            for x in xs]
+    outs = [f.result(timeout=60) for f in futs]       # zero drops
+    for out, ref in zip(outs, refs):
+        onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5,
+                                    atol=1e-6)
+    assert sum(r.state == DEAD for r in fleet.replicas) == 1
+    after = _sample("mxtpu_faults_recovered_total",
+                    {"site": "serve.replica", "kind": "preempt"})
+    assert after == before + 1          # the rerouted request recovered
+    assert fleet.metrics._failover.count >= 1
+    assert fleet.metrics.value("interactive", "rerouted") >= 1
+    fleet.shutdown(drain=True)
+
+
+# -- hot model-version swap ---------------------------------------------------
+
+def test_endpoint_hot_swap_pins_in_flight_version(rng):
+    """Requests admitted before the flip are answered by the old
+    parameters, requests after by the new — deterministically, by
+    queueing both around a swap with the batcher stopped."""
+    old = _mlp(seed=11)
+    new = _mlp(seed=22)
+    x = rng.standard_normal((2, 8)).astype(onp.float32)
+    ref_old = old(mx.np.array(x)).asnumpy()
+    ref_new = new(mx.np.array(x)).asnumpy()
+    assert not onp.allclose(ref_old, ref_new)   # the swap is observable
+
+    ep = Endpoint(old, name="t_swap_ep", max_batch_size=4,
+                  max_latency_ms=1, start=False)
+    f_old = ep.submit(x)                 # admitted under version 0
+    v = ep.swap_model(new)               # flip (stage=True is lazy here:
+    assert v == 1                        # no live cache to replay yet)
+    f_new = ep.submit(x)                 # admitted under version 1
+    ep.start()
+    onp.testing.assert_allclose(f_old.result(timeout=60).asnumpy(),
+                                ref_old, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(f_new.result(timeout=60).asnumpy(),
+                                ref_new, rtol=1e-5, atol=1e-6)
+    s = ep.stats()
+    assert s["model_version"] == 1
+    # the drained old version's executables were retired
+    assert s["executables"] == 1
+    ep.shutdown(drain=True)
+
+
+def test_fleet_hot_swap_under_load(rng):
+    """swap_model() under concurrent traffic: every future resolves (to
+    one version's answer or the other — never a mix or an error), and
+    everything submitted after the swap returns is served by the new
+    parameters."""
+    old = _mlp(seed=31)
+    new = _mlp(seed=32)
+    x = rng.standard_normal((2, 8)).astype(onp.float32)
+    ref_old = old(mx.np.array(x)).asnumpy()
+    ref_new = new(mx.np.array(x)).asnumpy()
+
+    def matches(out, ref):
+        return onp.allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+
+    with Fleet(old, replicas=2, name="t_swap_fleet", max_batch_size=4,
+               max_latency_ms=1) as fleet:
+        fleet.warmup(x)
+        futs = [fleet.submit(x, timeout_ms=60_000) for _ in range(6)]
+        versions = fleet.swap_model(new)
+        assert set(versions) == {"r0", "r1"}
+        assert all(v == 1 for v in versions.values())
+        late = [fleet.submit(x, timeout_ms=60_000) for _ in range(4)]
+        for f in futs:
+            out = f.result(timeout=60)
+            assert matches(out, ref_old) or matches(out, ref_new)
+        for f in late:                   # post-flip: new params only
+            assert matches(f.result(timeout=60), ref_new)
+
+
+# -- continuous batching ------------------------------------------------------
+
+def _int_lm():
+    """A tiny deterministic integer 'language model': hash-fold the
+    prompt, then h -> (3h + tok) % 1000, tok = h % 7.  Row-independent
+    by construction, so slot batching must be exact."""
+    import jax.numpy as jnp
+
+    def prefill(prompt):
+        h = (jnp.sum(prompt).astype(jnp.int32) * 13
+             + jnp.int32(prompt.shape[0])) % 1000
+        return h, (h % 7).astype(jnp.int32)
+
+    def decode(h_stack, toks):
+        new = (h_stack * 3 + toks.astype(jnp.int32)) % 1000
+        return new, (new % 7).astype(jnp.int32)
+
+    def oracle(prompt, budget, eos_id=None):
+        h = (int(onp.sum(prompt)) * 13 + len(prompt)) % 1000
+        toks = [h % 7]
+        while len(toks) < budget:
+            h = (h * 3 + toks[-1]) % 1000
+            toks.append(h % 7)
+        if eos_id is not None and eos_id in toks:
+            toks = toks[:toks.index(eos_id)]
+        return onp.asarray(toks, dtype=onp.int64)
+
+    return prefill, decode, oracle
+
+
+def test_continuous_join_leave_matches_drain_oracle(rng):
+    """Staggered prompts with ragged budgets join and leave a 3-slot
+    decode batch mid-flight; every sequence matches the solo
+    (drain-batch) oracle exactly."""
+    prefill, decode, oracle = _int_lm()
+    prompts = [rng.integers(0, 50, size=rng.integers(1, 6))
+               .astype(onp.int32) for _ in range(7)]
+    budgets = [1, 3, 6, 4, 2, 5, 6]
+    with ContinuousBatcher(prefill, decode, slots=3,
+                           name="t_cont") as cb:
+        futs = []
+        for p, b in zip(prompts, budgets):
+            futs.append(cb.submit(p, max_new_tokens=b))
+            time.sleep(0.01)             # force mid-decode joins
+        outs = [f.result(timeout=60) for f in futs]
+    for out, p, b in zip(outs, prompts, budgets):
+        onp.testing.assert_array_equal(out, oracle(p, b))
+    s = cb.stats()
+    assert s["joins"] == 7 and s["leaves"] == 7 and s["active"] == 0
+
+
+def test_continuous_eos_terminates_and_is_excluded(rng):
+    prefill, decode, oracle = _int_lm()
+    eos = 3
+    # find a prompt whose stream hits eos strictly mid-sequence
+    prompt = None
+    for v in range(200):
+        toks = oracle(onp.asarray([v], onp.int32), 12)
+        if eos in toks.tolist()[1:-1]:
+            prompt = onp.asarray([v], onp.int32)
+            break
+    assert prompt is not None
+    with ContinuousBatcher(prefill, decode, slots=2, eos_id=eos,
+                           name="t_eos") as cb:
+        out = cb.generate(prompt, max_new_tokens=12, timeout=60)
+    expect = oracle(prompt, 12, eos_id=eos)
+    assert len(expect) < 12              # eos actually fired early
+    onp.testing.assert_array_equal(out, expect)
+    assert eos not in out.tolist()       # terminator, not output
+
+
+def test_continuous_validation_and_close(rng):
+    prefill, decode, _ = _int_lm()
+    cb = ContinuousBatcher(prefill, decode, slots=2, name="t_cval",
+                           start=False)
+    with pytest.raises(ValueError, match="non-empty 1-D"):
+        cb.submit(onp.zeros((2, 3), onp.int32))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        cb.submit(onp.asarray([1], onp.int32), max_new_tokens=0)
+    cb.start()
+    cb.shutdown(drain=True)
+    with pytest.raises(EndpointClosed):
+        cb.submit(onp.asarray([1], onp.int32))
+
+
+# -- router / metrics units ---------------------------------------------------
+
+def test_router_is_priority_stable_and_timeouts():
+    r = PriorityRouter()
+    assert r.pop(timeout=0.01) is None
+    r.push("b1", 2)
+    r.push("a1", 0)
+    r.push("a2", 0)
+    r.push("s1", 1)
+    assert [r.pop() for _ in range(4)] == ["a1", "a2", "s1", "b1"]
+    assert r.pending() == 0
+
+
+def test_endpoint_stats_expose_wait_and_execute_quantiles(rng):
+    net = _mlp()
+    x = rng.standard_normal((2, 8)).astype(onp.float32)
+    with Endpoint(net, name="t_quant", max_batch_size=4,
+                  max_latency_ms=1) as ep:
+        for _ in range(5):
+            ep.predict(x)
+        s = ep.stats()
+    for key in ("queue_wait_ms_p50", "queue_wait_ms_p99",
+                "execute_ms_p50", "execute_ms_p99"):
+        assert s[key] is not None and s[key] >= 0.0
+    assert s["queue_wait_ms_p50"] <= s["queue_wait_ms_p99"]
+    assert s["execute_ms_p50"] <= s["execute_ms_p99"]
+
+
+def test_histogram_quantile_interpolation():
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("t_q_seconds", "test", buckets=(1.0, 2.0, 4.0))
+    assert h.quantile(0.5) is None       # empty: no estimate, not 0
+    for v in (0.5, 1.5, 1.5, 3.0):
+        h.observe(v)
+    assert 0.0 < h.quantile(0.25) <= 1.0
+    assert 1.0 < h.quantile(0.5) <= 2.0
+    assert 2.0 < h.quantile(0.99) <= 4.0
+    h.observe(100.0)                     # overflow clamps to top bound
+    assert h.quantile(1.0) == 4.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_fleet_sla_report_shape(rng):
+    net = _mlp()
+    x = rng.standard_normal((1, 8)).astype(onp.float32)
+    with Fleet(net, replicas=1, name="t_sla", max_batch_size=4,
+               max_latency_ms=1) as fleet:
+        fleet.warmup(x)
+        fleet.predict(x, cls="interactive", timeout_ms=60_000)
+        report = fleet.sla_report()
+    assert set(report) == {"interactive", "standard", "batch"}
+    r = report["interactive"]
+    assert r["p99_ms"] is not None and r["ok"] is True
+    assert report["standard"]["p99_ms"] is None   # no traffic, no claim

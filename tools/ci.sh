@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI entry (reference: ci/build.py + runtime_functions.sh stages).
-# Stages: lint | import | hloscan | census | smoke | test | chaos | perf
-# | dryrun | all (default: all).
+# Stages: lint | import | hloscan | census | smoke | test | chaos
+# | storm | perf | dryrun | all (default: all).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -180,6 +180,17 @@ print("ci: quantized int8 preempt/resume parity bitwise")
 EOF
   fi
 }
+run_storm() {
+  # fleet chaos load-storm gate (ISSUE 12): mixed-shape, mixed-priority
+  # traffic through a 3-replica fleet WHILE a faultline plan kills one
+  # replica mid-storm — zero dropped (non-shed) requests, per-class p99
+  # inside the declared SLA, and the failover visible in
+  # mxtpu_faults_recovered_total + mxtpu_fleet_failover_seconds
+  # (docs/SERVING.md "Fleet"; opt out with MXTPU_CHAOS_STORM=0)
+  if [ "${MXTPU_CHAOS_STORM:-1}" != "0" ]; then
+    python -m tools.storm --gate
+  fi
+}
 run_perf()   { python benchmark/opperf/opperf.py --smoke; }
 run_dryrun() {
   # pytest already runs the 4-process launcher test; skip it inside the
@@ -198,9 +209,10 @@ case "$stage" in
   smoke)   run_smoke ;;
   test)    run_test ;;
   chaos)   run_chaos ;;
+  storm)   run_storm ;;
   perf)    run_perf ;;
   dryrun)  run_dryrun ;;
   all)     run_lint; run_import; run_hloscan; run_census; run_smoke
-           run_test; run_chaos; run_perf; run_dryrun ;;
+           run_test; run_chaos; run_storm; run_perf; run_dryrun ;;
   *) echo "unknown stage $stage" >&2; exit 2 ;;
 esac

@@ -76,6 +76,28 @@ assert ep.stats()["completed"] == 1
 ep.shutdown(drain=True)
 print("smoke: serve round-trip ok")
 
+# 2a'. fleet failover gate (ISSUE 12): 2 replicas, a faultline plan
+# kills one at its first dispatch, and the request must complete on the
+# survivor with the recovery visible in mxtpu_faults_recovered_total —
+# the quick round-trip version of the ci.sh storm stage
+from mxnet_tpu import telemetry as _tel
+from mxnet_tpu.resilience import faultline as _fl
+_fl.clear()
+_fl.plan([{"site": "serve.replica", "kind": "preempt", "at": 1}])
+_fleet = mx.serve.Fleet(net, replicas=2, name="smoke_fleet",
+                        max_batch_size=4, max_latency_ms=2)
+_fout = _fleet.predict(x, cls="interactive", timeout_ms=60000)
+assert _fout.shape == (2, 4)
+_fl.clear()
+_dead = [r.index for r in _fleet.replicas if r.state == "dead"]
+assert len(_dead) == 1, _fleet.describe_state()
+_frec = _tel.default_registry().get_sample_value(
+    "mxtpu_faults_recovered_total",
+    {"site": "serve.replica", "kind": "preempt"})
+assert _frec and _frec >= 1, _frec
+_fleet.shutdown(drain=True)
+print(f"smoke: fleet failover ok (r{_dead[0]} killed, survivor answered)")
+
 # 2b. telemetry gate (ISSUE 2): the Prometheus exposition must parse and
 # reflect the traffic just served — a broken exporter or a silently
 # non-publishing endpoint can never land
@@ -253,6 +275,7 @@ EOF
 # 4. the driver entry points compile on the virtual mesh (the full
 # hloscan + census dryrun riders run in ci.sh's dryrun stage, not here)
 MXTPU_DRYRUN_HLOSCAN=0 MXTPU_DRYRUN_CENSUS=0 MXTPU_DRYRUN_RESILIENCE=0 \
+  MXTPU_DRYRUN_FLEET=0 \
   python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)

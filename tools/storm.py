@@ -1,0 +1,199 @@
+"""Chaos load-storm gate for the serving fleet (``tools/ci.sh storm``).
+
+Drives heavy mixed-shape, mixed-priority traffic through a
+:class:`mxnet_tpu.serve.Fleet` WHILE a seeded faultline plan kills one
+replica mid-storm, then gates on the fleet's contract:
+
+1. **zero dropped requests** — every submitted future resolves as
+   completed, shed (:class:`DeadlineExceeded`, the distinct error), or
+   failed; completed outputs are bit-checked against the bare model;
+2. **zero failed requests** — the storm's model never errors, so any
+   failure is a fleet bug;
+3. **per-class p99 within the declared SLA** — measured from the
+   ``mxtpu_fleet_latency_seconds`` histograms via
+   ``Histogram.quantile``;
+4. **visible failover** — the mid-storm replica death must tick
+   ``mxtpu_faults_recovered_total{site="serve.replica"}`` and record a
+   death-to-rerouted-completion time in
+   ``mxtpu_fleet_failover_seconds``.
+
+Deterministic: the traffic mix is seeded per client and the kill is a
+faultline arrival plan, so a failing storm replays exactly.  Run
+directly::
+
+    python -m tools.storm --gate
+
+Prints one ``storm_verdict: PASS|FAIL`` line; ``--gate`` exits nonzero
+on FAIL.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import faultline
+from mxnet_tpu.serve import DeadlineExceeded, Fleet, SLAClass
+
+IN_UNITS = 16
+OUT_UNITS = 8
+
+# class mix: mostly standard, a hot interactive tier, a bulk tail
+_CLASS_MIX = (("interactive", 0.3), ("standard", 0.5), ("batch", 0.2))
+
+
+def _build_model(seed):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, in_units=IN_UNITS, activation="relu"))
+    net.add(nn.Dense(OUT_UNITS, in_units=32))
+    net.initialize()
+    return net
+
+
+def _classes(base_deadline_ms):
+    # declared SLA: p99 objective = 2x the class deadline (the shed
+    # bound plus one in-flight device call) — generous in absolute
+    # terms because CI runs 8 virtual devices on one contended CPU
+    return {
+        "interactive": SLAClass("interactive", 0, base_deadline_ms),
+        "standard": SLAClass("standard", 1, 4 * base_deadline_ms),
+        "batch": SLAClass("batch", 2, 20 * base_deadline_ms),
+    }
+
+
+def _client(idx, seed, fleet, net_ref, n_requests, results, max_rows):
+    rng = onp.random.default_rng(seed + idx)
+    names = [n for n, _ in _CLASS_MIX]
+    probs = onp.asarray([p for _, p in _CLASS_MIX])
+    for _ in range(n_requests):
+        rows = int(rng.integers(1, max_rows + 1))
+        x = rng.standard_normal((rows, IN_UNITS)).astype(onp.float32)
+        cls = names[int(rng.choice(len(names), p=probs))]
+        want = net_ref(mx.np.array(x)).asnumpy()
+        fut = fleet.submit(x, cls=cls)
+        results.append((fut, want, cls))
+        time.sleep(float(rng.uniform(0.0, 0.004)))
+
+
+def run_storm(replicas=3, clients=6, requests=20, seed=7, kill_at=None,
+              base_deadline_ms=8000.0, no_fault=False):
+    """Returns (verdict_line, ok, summary_dict)."""
+    total = clients * requests
+    if kill_at is None:
+        kill_at = max(2, total // 4)   # mid-storm, after warm traffic
+    net = _build_model(seed)
+    fleet = Fleet(net, replicas=replicas, name="storm",
+                  classes=_classes(base_deadline_ms),
+                  max_batch_size=8, max_latency_ms=2.0)
+    example = onp.zeros((1, IN_UNITS), onp.float32)
+    compiled = fleet.warmup(example)
+    faultline.clear()
+    if not no_fault:
+        faultline.plan([{"site": "serve.replica", "kind": "preempt",
+                         "at": int(kill_at)}])
+
+    results = []
+    threads = [threading.Thread(
+        target=_client, name=f"storm-client-{i}",
+        args=(i, seed, fleet, net, requests, results, 4))
+        for i in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    completed = shed = failed = wrong = 0
+    first_error = None
+    for fut, want, _cls in results:
+        try:
+            got = fut.result(timeout=240)
+            completed += 1
+            if not onp.allclose(got.asnumpy(), want, atol=1e-5):
+                wrong += 1
+        except DeadlineExceeded:
+            shed += 1
+        except Exception as exc:                     # noqa: BLE001
+            failed += 1                  # a failed answer, not a drop —
+            if first_error is None:      # named in the verdict line
+                first_error = f"{type(exc).__name__}: {exc}"
+    wall = time.perf_counter() - t0
+    faultline.clear()
+
+    answered = completed + shed + failed
+    dropped = total - answered
+    sla = fleet.sla_report()
+    dead = [f"r{r.index}" for r in fleet.replicas if r.state == "dead"]
+    reg = telemetry.default_registry()
+    recovered = reg.get_sample_value(
+        "mxtpu_faults_recovered_total",
+        {"site": "serve.replica", "kind": "preempt"}) or 0
+    failover_n = fleet.metrics._failover.count
+    failover_s = fleet.metrics._failover.sum
+    fleet.shutdown(drain=True)
+
+    checks = {
+        "zero_dropped": dropped == 0,
+        "zero_failed": failed == 0,
+        "outputs_correct": wrong == 0,
+        "sla_p99": all(v["ok"] for v in sla.values()),
+    }
+    if not no_fault:
+        checks["replica_killed"] = len(dead) == 1
+        checks["fault_recovered"] = recovered >= 1
+        checks["failover_measured"] = failover_n >= 1
+    ok = all(checks.values())
+
+    p99s = ", ".join(
+        f"p99[{c}]={v['p99_ms']:.0f}ms<=SLO {v['slo_p99_ms']:.0f}ms"
+        if v["p99_ms"] is not None else f"p99[{c}]=n/a"
+        for c, v in sla.items())
+    fail_bits = "" if ok else " FAILED: " + ",".join(
+        k for k, v in checks.items() if not v)
+    if first_error is not None:
+        fail_bits += f" [first error: {first_error}]"
+    verdict = (
+        f"storm_verdict: {'PASS' if ok else 'FAIL'} — {answered}/{total} "
+        f"answered ({completed} completed, {shed} shed, {failed} failed, "
+        f"{dropped} dropped), {p99s}, dead={dead or 'none'}, "
+        f"recovered={recovered:.0f}, failover={failover_s:.2f}s "
+        f"(n={failover_n}), {compiled} exes warmed, wall={wall:.1f}s"
+        f"{fail_bits}")
+    summary = dict(checks, completed=completed, shed=shed, failed=failed,
+                   dropped=dropped, wrong=wrong, wall=wall, sla=sla)
+    return verdict, ok, summary
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=6)
+    ap.add_argument("--requests", type=int, default=20,
+                    help="requests per client")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--kill-at", type=int, default=None,
+                    help="faultline arrival index of the replica kill "
+                         "(default: total/4)")
+    ap.add_argument("--base-deadline-ms", type=float, default=8000.0)
+    ap.add_argument("--no-fault", action="store_true",
+                    help="load only, no replica kill")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 on FAIL (the CI mode)")
+    args = ap.parse_args(argv)
+    verdict, ok, _summary = run_storm(
+        replicas=args.replicas, clients=args.clients,
+        requests=args.requests, seed=args.seed, kill_at=args.kill_at,
+        base_deadline_ms=args.base_deadline_ms, no_fault=args.no_fault)
+    print(verdict)
+    return 0 if (ok or not args.gate) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
