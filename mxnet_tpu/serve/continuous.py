@@ -184,6 +184,30 @@ class ContinuousBatcher:
     def _free_slots(self):
         return [i for i, s in enumerate(self._active) if s is None]
 
+    def _check_join(self, carry):
+        """A joining carry must match the running slot stack leaf for
+        leaf: the decode program is fixed-shape, so a prefill whose
+        carry shape tracks the prompt (e.g. an unpadded KV cache) would
+        poison the whole batch at the next ``_join_carry``."""
+        import jax
+
+        stack_leaves, stack_def = jax.tree_util.tree_flatten(self._carry)
+        new_leaves, new_def = jax.tree_util.tree_flatten(carry)
+        if stack_def != new_def:
+            raise ValueError(
+                f"prefill carry structure {new_def} does not match the "
+                f"running decode stack {stack_def}: prefill_fn must "
+                "return the same pytree for every prompt")
+        for s, n in zip(stack_leaves, new_leaves):
+            if tuple(s.shape[1:]) != tuple(n.shape) or s.dtype != n.dtype:
+                raise ValueError(
+                    f"prefill carry leaf shape {tuple(n.shape)}/{n.dtype} "
+                    f"does not match the decode stack's per-slot shape "
+                    f"{tuple(s.shape[1:])}/{s.dtype}: the decode program "
+                    "is fixed-shape, so prefill_fn must emit identical "
+                    "carry shapes for every prompt (pad the prompt or "
+                    "the cache to a fixed length)")
+
     def _admit(self):
         """Prefill waiting sequences into free slots (between steps)."""
         import jax.numpy as jnp
@@ -197,19 +221,31 @@ class ContinuousBatcher:
                 slot = free[0]
                 self._active[slot] = seq
                 seq.slot = slot
-            carry, tok = self._prefill(seq.prompt)
-            if self._carry is None:
-                # first sequence ever: materialize the slot-stacked
-                # decode state from its carry structure
-                import jax
-                self._carry = jax.tree_util.tree_map(
-                    lambda leaf: jnp.zeros((self.slots,) + leaf.shape,
-                                           leaf.dtype), carry)
-                self._last = jnp.zeros((self.slots,),
-                                       jnp.asarray(tok).dtype)
-            self._carry = self._join_carry(self._carry, carry,
-                                           jnp.int32(slot))
-            self._last = self._last.at[slot].set(tok)
+            try:
+                carry, tok = self._prefill(seq.prompt)
+                if self._carry is None:
+                    # first sequence ever: materialize the slot-stacked
+                    # decode state from its carry structure
+                    import jax
+                    self._carry = jax.tree_util.tree_map(
+                        lambda leaf: jnp.zeros((self.slots,) + leaf.shape,
+                                               leaf.dtype), carry)
+                    self._last = jnp.zeros((self.slots,),
+                                           jnp.asarray(tok).dtype)
+                self._check_join(carry)
+                self._carry = self._join_carry(self._carry, carry,
+                                               jnp.int32(slot))
+                self._last = self._last.at[slot].set(tok)
+            except Exception as exc:  # noqa: BLE001
+                # a bad prompt fails ITS future only ("every future
+                # resolves"); the slot frees, the worker and the other
+                # sequences keep decoding
+                with self._cv:
+                    self._active[slot] = None
+                if not seq.future.done():
+                    seq.future.set_exception(exc)
+                self._ev["leaves"].inc()
+                continue
             seq.tokens.append(int(tok))
             self._ev["joins"].inc()
             self._finish_done([slot])    # budget of 1: done at prefill
@@ -233,6 +269,20 @@ class ContinuousBatcher:
                         onp.asarray(seq.tokens, dtype=onp.int64))
                 self._ev["leaves"].inc()
 
+    def _fail_active(self, exc):
+        """Fail every active sequence with ``exc`` and reset the slot
+        stack (the shared carry is unusable after a decode error)."""
+        with self._cv:
+            seqs = [s for s in self._active if s is not None]
+            self._active = [None] * self.slots
+            self._carry = None
+            self._last = None
+        for seq in seqs:
+            if not seq.future.done():
+                seq.future.set_exception(exc)
+            self._ev["leaves"].inc()
+        self._occupancy.set(0.0)
+
     def _run(self):
         while True:
             with self._cv:
@@ -249,10 +299,19 @@ class ContinuousBatcher:
             self._occupancy.set(len(active) / self.slots)
             if not active:
                 continue
-            # one step for the whole slot batch; the only host pull is
-            # the (slots,) token vector
-            self._carry, self._last = self._decode(self._carry, self._last)
-            toks = onp.asarray(self._last)
+            try:
+                # one step for the whole slot batch; the only host pull
+                # is the (slots,) token vector
+                self._carry, self._last = self._decode(self._carry,
+                                                       self._last)
+                toks = onp.asarray(self._last)
+            except Exception as exc:  # noqa: BLE001
+                # a decode failure poisons the whole slot stack: every
+                # active sequence gets the exception (never a silent
+                # drop), the stack resets, and the worker stays alive
+                # for the sequences still waiting
+                self._fail_active(exc)
+                continue
             self._ev["steps"].inc()
             for slot in active:
                 self._active[slot].tokens.append(int(toks[slot]))

@@ -38,6 +38,7 @@ import functools
 import itertools
 import threading
 import time
+import warnings
 
 import numpy as onp
 
@@ -221,7 +222,9 @@ class Fleet:
     devices : sequence of jax.Device or None
         Mesh to slice across replicas (default ``jax.devices()``).
         Replica ``i`` owns slice ``devices[i*k:(i+1)*k]`` and pins its
-        executables to the slice's first device.
+        executables to the slice's first device.  More replicas than
+        devices forfeits the disjoint-slice guarantee: replicas share
+        devices round-robin, with a ``RuntimeWarning``.
     eject_after : int or None
         Consecutive-failure ejection threshold (default
         ``MXNET_SERVE_EJECT_AFTER`` = 2).
@@ -245,6 +248,13 @@ class Fleet:
             else _env.serve_eject_after()
         self.probe_interval = probe_interval
         self.metrics = FleetMetrics(self.name, list(self.router.classes))
+        if n > len(devices):
+            warnings.warn(
+                f"fleet {self.name}: {n} replicas over {len(devices)} "
+                "device(s) — replicas will share devices, voiding the "
+                "disjoint-slice guarantee (their programs contend for "
+                "the same chip); use replicas <= devices for isolation",
+                RuntimeWarning, stacklevel=2)
         k = max(1, len(devices) // n)
         self.replicas = []
         for i in range(n):
@@ -286,6 +296,13 @@ class Fleet:
             self._drain = drain
         if self._dispatcher is not None and self._dispatcher.is_alive():
             self._dispatcher.join(timeout=timeout)
+        if self._dispatcher is None or not self._dispatcher.is_alive():
+            # the dispatcher is gone: anything still on the heap (a
+            # non-draining close, or a submit that raced the close) has
+            # no one left to serve it — fail it, never strand it
+            for req in self.router.drain():
+                self._fail(req, FleetClosed(
+                    f"fleet {self.name} shut down without draining"))
         for rep in self.replicas:
             if rep.state != DEAD:
                 rep.endpoint.shutdown(drain=drain, timeout=timeout)
@@ -311,10 +328,15 @@ class Fleet:
         ``timeout_ms`` overrides the class deadline; ``replica`` pins
         the request to one replica (raises
         :class:`ReplicaUnavailable` unless it is healthy)."""
-        if self._closed:
-            raise FleetClosed(f"fleet {self.name} is shut down")
         sla = self.router.resolve_class(cls)
         if replica is not None:
+            replica = int(replica)
+            if not 0 <= replica < len(self.replicas):
+                raise ReplicaUnavailable(
+                    f"replica index {replica} is out of range for fleet "
+                    f"{self.name}: valid replicas are "
+                    f"0..{len(self.replicas) - 1} "
+                    f"(docs/SERVING.md \"Fleet\")")
             rep = self.replicas[replica]
             if not rep.is_routable():
                 raise ReplicaUnavailable(
@@ -326,8 +348,15 @@ class Fleet:
         deadline_s = (timeout_ms / 1e3) if timeout_ms is not None \
             else sla.deadline_ms / 1e3
         req = _FleetRequest(arrays, sla, deadline_s, replica)
-        self.metrics.event(sla.name, "submitted")
-        self.router.push(req, sla.priority)
+        # closed-check and push are one atomic step: a submit racing a
+        # shutdown must either raise here or land on the heap before the
+        # dispatcher's drain check can see it — never push into a loop
+        # that already exited (a stranded future)
+        with self._lock:
+            if self._closed:
+                raise FleetClosed(f"fleet {self.name} is shut down")
+            self.metrics.event(sla.name, "submitted")
+            self.router.push(req, sla.priority)
         return req.future
 
     def predict(self, *inputs, cls="standard", timeout_ms=None,
@@ -351,7 +380,11 @@ class Fleet:
                     if not self._drain:
                         break
                     with self._lock:
-                        if self._inflight == 0:
+                        # a request is either terminal, on the heap, or
+                        # counted in _inflight (callbacks re-push BEFORE
+                        # decrementing) — so both empty means truly done
+                        if self._inflight == 0 \
+                                and self.router.pending() == 0:
                             break
                 continue
             if self._closed and not self._drain:
@@ -424,32 +457,42 @@ class Fleet:
 
     def _on_result(self, req, target, fut):
         target.note_done()
-        with self._lock:
-            self._inflight -= 1
         exc = fut.exception()
         now = time.perf_counter()
-        if exc is None:
-            if target.record_success():
-                self.metrics.set_replica_state(target.index, target.state)
-            self._complete(req, fut.result(), now)
-        elif isinstance(exc, RequestTimeout):
-            self._shed(req, now)
-        elif isinstance(exc, (EndpointClosed,) + TRANSIENT_EXCEPTIONS):
-            # the replica died under the request (or its transport timed
-            # out past the retry budget): health strike + reroute
-            if target.record_failure():
-                self.metrics.set_replica_state(target.index, target.state)
-            if self._closed and not self._drain:
-                self._fail(req, FleetClosed(
-                    f"fleet {self.name} shut down without draining"))
-            elif req.attempts >= len(self.replicas) + 1:
-                self._fail(req, exc)      # bounded: no infinite bounce
+        try:
+            if exc is None:
+                if target.record_success():
+                    self.metrics.set_replica_state(target.index,
+                                                   target.state)
+                self._complete(req, fut.result(), now)
+            elif isinstance(exc, RequestTimeout):
+                self._shed(req, now)
+            elif isinstance(exc, (EndpointClosed,) + TRANSIENT_EXCEPTIONS):
+                # the replica died under the request (or its transport
+                # timed out past the retry budget): health strike +
+                # reroute
+                if target.record_failure():
+                    self.metrics.set_replica_state(target.index,
+                                                   target.state)
+                if self._closed and not self._drain:
+                    self._fail(req, FleetClosed(
+                        f"fleet {self.name} shut down without draining"))
+                elif req.attempts >= len(self.replicas) + 1:
+                    self._fail(req, exc)  # bounded: no infinite bounce
+                else:
+                    self._reroute(req, target)
             else:
-                self._reroute(req, target)
-        else:
-            # a real model error is the caller's answer (a failed
-            # request, not a dropped one)
-            self._fail(req, exc)
+                # a real model error is the caller's answer (a failed
+                # request, not a dropped one)
+                self._fail(req, exc)
+        finally:
+            # decrement only once the request is terminal or back on the
+            # heap: the drain condition reads _inflight together with
+            # router.pending(), and decrementing before the re-push
+            # opens a window where both look empty while the request is
+            # in neither place — the dispatcher would exit and strand it
+            with self._lock:
+                self._inflight -= 1
 
     # -- request terminal states (every admitted future hits exactly one) --
     def _complete(self, req, result, now):

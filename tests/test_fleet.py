@@ -152,6 +152,47 @@ def test_pinned_submit_to_unroutable_replica_carries_fleet_state(rng):
         assert out.shape == (1, 4)
 
 
+def test_pinned_submit_validates_replica_index(rng):
+    """An out-of-range or negative pinned index raises
+    ReplicaUnavailable naming the valid range — never a bare
+    IndexError, and a negative index never wraps to a different
+    replica than the caller named."""
+    net = _mlp()
+    x = rng.standard_normal((1, 8)).astype(onp.float32)
+    with Fleet(net, replicas=2, name="t_pin_range", max_batch_size=4,
+               max_latency_ms=2) as fleet:
+        for bad in (2, 7, -1, -2):
+            with pytest.raises(ReplicaUnavailable,
+                               match=r"out of range.*0\.\.1"):
+                fleet.submit(x, replica=bad)
+        out = fleet.predict(x, replica=1, timeout_ms=60_000)
+        assert out.shape == (1, 4)
+
+
+def test_more_replicas_than_devices_warns():
+    net = _mlp()
+    import jax
+    with pytest.warns(RuntimeWarning, match="share devices"):
+        fleet = Fleet(net, replicas=len(jax.devices()) + 1,
+                      name="t_overcommit", start=False)
+    fleet.shutdown()
+
+
+def test_nondrain_shutdown_fails_queued_futures_no_strand(rng):
+    """Requests still on the heap when a non-draining shutdown tears
+    the dispatcher down resolve with FleetClosed — never a future that
+    hangs forever."""
+    net = _mlp()
+    x = rng.standard_normal((1, 8)).astype(onp.float32)
+    fleet = Fleet(net, replicas=1, name="t_nodrain", start=False,
+                  max_batch_size=4, max_latency_ms=1)
+    futs = [fleet.submit(x, timeout_ms=60_000) for _ in range(4)]
+    fleet.shutdown(drain=False)          # dispatcher never started
+    for f in futs:
+        with pytest.raises(FleetClosed, match="without draining"):
+            f.result(timeout=10)
+
+
 def test_no_healthy_replica_when_all_dead(rng):
     net = _mlp()
     x = rng.standard_normal((1, 8)).astype(onp.float32)
@@ -371,6 +412,39 @@ def test_continuous_eos_terminates_and_is_excluded(rng):
     assert len(expect) < 12              # eos actually fired early
     onp.testing.assert_array_equal(out, expect)
     assert eos not in out.tolist()       # terminator, not output
+
+
+def test_continuous_bad_carry_fails_only_that_future(rng):
+    """A prompt whose prefill carry shape mismatches the running slot
+    stack (here: a carry that tracks the prompt length) fails ITS
+    future with a clear error; the worker survives and keeps serving
+    well-shaped prompts — 'every future resolves' holds."""
+    import jax.numpy as jnp
+
+    def prefill(prompt):
+        # carry shape tracks the prompt length, so variable-length
+        # prompts produce mismatched carries by construction
+        return prompt.astype(jnp.int32), (prompt[0] % 7).astype(jnp.int32)
+
+    def decode(stack, toks):
+        return stack, (jnp.sum(stack, axis=1).astype(jnp.int32)
+                       + toks) % 7
+
+    with ContinuousBatcher(prefill, decode, slots=2,
+                           name="t_badcarry") as cb:
+        out0 = cb.generate(onp.asarray([9, 2, 4], onp.int32),
+                           max_new_tokens=1, timeout=60)
+        onp.testing.assert_array_equal(out0, [2])     # 9 % 7
+        bad = cb.submit(onp.asarray([1, 2], onp.int32),
+                        max_new_tokens=1)
+        with pytest.raises(ValueError, match="per-slot shape"):
+            bad.result(timeout=60)
+        # the worker survived: a well-shaped prompt still completes
+        out1 = cb.generate(onp.asarray([8, 1, 1], onp.int32),
+                           max_new_tokens=1, timeout=60)
+        onp.testing.assert_array_equal(out1, [1])     # 8 % 7
+    s = cb.stats()
+    assert s["active"] == 0 and s["waiting"] == 0
 
 
 def test_continuous_validation_and_close(rng):
