@@ -118,6 +118,27 @@ MXNET_SERVE_EJECT_AFTER      consecutive replica failures before the
                              fleet ejects it from routing (default 2 —
                              the tpu_ici two-observation suspicion rule;
                              read when a fleet is created)
+MXNET_ELASTIC                ``1`` lets ``resilience.ElasticSupervisor``
+                             re-shard onto the survivor mesh after a
+                             permanent host loss instead of re-raising
+                             ``DeadNodeError`` (default 0: abort to
+                             checkpoint, the pre-elastic behavior; read
+                             when a supervisor is created without an
+                             explicit ``elastic=``)
+MXNET_ELASTIC_MIN_WORLD      smallest world the supervisor will shrink
+                             to; a fault leaving fewer survivors aborts
+                             to checkpoint instead of resharding
+                             (default 1; read at supervisor creation)
+MXNET_ELASTIC_SCALING        batch/lr scaling rule across a world-size
+                             change: ``linear`` (default — per-host
+                             batch constant, so global batch AND lr
+                             scale by world/base_world; loss scale
+                             untouched) or ``none`` (keep the lr; the
+                             global batch still shrinks with the world
+                             and the supervisor logs that the effective
+                             step size changed).  Read at supervisor
+                             creation; the applied rule is always
+                             logged, never silent
 =========================== =================================================
 """
 from __future__ import annotations
@@ -126,7 +147,8 @@ import os
 
 __all__ = ["apply", "describe", "is_naive_engine", "cpu_worker_nthreads",
            "decode_threads", "prefetch_depth", "io_error_tolerance",
-           "serve_replicas", "serve_deadline_ms", "serve_eject_after"]
+           "serve_replicas", "serve_deadline_ms", "serve_eject_after",
+           "elastic_enabled", "elastic_min_world", "elastic_scaling"]
 
 _naive_engine = False
 
@@ -188,6 +210,36 @@ def serve_eject_after(default=2):
     return max(1, int(v))
 
 
+def elastic_enabled(default=False):
+    """Whether the elastic supervisor may re-shard onto survivors after
+    a permanent host loss (default: abort to checkpoint instead)."""
+    v = os.environ.get("MXNET_ELASTIC")
+    if v is None:
+        return default
+    return v not in ("0", "")
+
+
+def elastic_min_world(default=1):
+    """Smallest world the supervisor will shrink to; fewer survivors
+    abort to checkpoint."""
+    v = os.environ.get("MXNET_ELASTIC_MIN_WORLD")
+    if v is None:
+        return default
+    return max(1, int(v))
+
+
+def elastic_scaling(default="linear"):
+    """Batch/lr scaling rule across a world-size change: ``linear`` or
+    ``none`` (see the docstring table; the choice is always logged)."""
+    v = os.environ.get("MXNET_ELASTIC_SCALING")
+    if v is None:
+        return default
+    if v not in ("linear", "none"):
+        raise ValueError(
+            f"MXNET_ELASTIC_SCALING={v!r}: expected 'linear' or 'none'")
+    return v
+
+
 def apply():
     """Read the environment once at package import."""
     global _naive_engine
@@ -240,5 +292,6 @@ def describe():
              "MXNET_KVSTORE_QBLOCK", "MXNET_DECODE_THREADS",
              "MXNET_PREFETCH_DEPTH", "MXNET_IO_ERROR_TOLERANCE",
              "MXNET_SERVE_REPLICAS", "MXNET_SERVE_DEADLINE_MS",
-             "MXNET_SERVE_EJECT_AFTER"]
+             "MXNET_SERVE_EJECT_AFTER", "MXNET_ELASTIC",
+             "MXNET_ELASTIC_MIN_WORLD", "MXNET_ELASTIC_SCALING"]
     return [(n, os.environ.get(n), n in __doc__) for n in names]

@@ -485,6 +485,20 @@ class ImageIter:
     def __iter__(self):
         return self
 
+    def reshard(self, num_parts, part_index):
+        """Re-derive this reader's part of the world (elastic re-shard:
+        a survivor host takes its dense index in the shrunk world).
+        Takes effect at the next :meth:`reset` — all parts share the
+        same (seed, epoch) permutation stream, so from the next epoch
+        on the survivor parts partition the global permutation exactly:
+        no record read twice, none dropped.  The remainder of the
+        CURRENT epoch keeps the old slicing; the dead parts' unread
+        records are the cost of the fault, bounded by one epoch."""
+        if num_parts < 1 or not 0 <= part_index < num_parts:
+            raise ValueError("need 0 <= part_index < num_parts")
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
+
     def reset(self):
         # same sharding law as the native pipeline: shuffle the GLOBAL
         # index list with a (seed, epoch) generator, then take this

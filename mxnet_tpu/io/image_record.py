@@ -92,6 +92,18 @@ class ImageRecordIter(DataIter):
         self._lib = L
         self._h, self._w = h, w
         self._layout = layout
+        # kept for reshard(): the native pipeline bakes the partition
+        # into its worker threads, so re-deriving the world after an
+        # elastic re-shard rebuilds the handle from these
+        self._ctor = dict(
+            path_imgrec=path_imgrec, resize=int(resize),
+            rand_crop=int(bool(rand_crop)),
+            rand_mirror=int(bool(rand_mirror)),
+            shuffle=int(bool(shuffle)), seed=int(seed),
+            preprocess_threads=int(preprocess_threads),
+            prefetch_buffer=int(prefetch_buffer))
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
         self._handle = L.imgpipe_create(
             path_imgrec.encode(), batch_size, h, w, int(resize),
             int(preprocess_threads), int(prefetch_buffer),
@@ -200,6 +212,39 @@ class ImageRecordIter(DataIter):
         # the native stream is epoch-continuous (reshuffles itself per
         # wrap); reset only rearms the python epoch counter
         self._cursor = 0
+
+    def reshard(self, num_parts, part_index):
+        """Re-derive the shard after an elastic world change: destroy
+        the native pipeline and rebuild it for the new
+        ``(num_parts, part_index)``.  The sharding law is unchanged —
+        part ``p`` reads ``perm[p::num_parts]`` of the (seed, epoch)
+        global permutation, so the survivor parts again partition each
+        epoch exactly — but unlike the pure-python ``ImageIter`` the
+        partition is baked into the worker threads, so the rebuilt
+        stream restarts its epoch sequence at 0 (documented cost of a
+        re-shard on the native path)."""
+        num_parts, part_index = int(num_parts), int(part_index)
+        if num_parts < 1 or not 0 <= part_index < num_parts:
+            raise ValueError("need 0 <= part_index < num_parts")
+        L = self._lib
+        c = self._ctor
+        self.close()
+        self._handle = L.imgpipe_create(
+            c["path_imgrec"].encode(), self.batch_size, self._h, self._w,
+            c["resize"], c["preprocess_threads"], c["prefetch_buffer"],
+            c["rand_crop"], c["rand_mirror"], c["shuffle"], c["seed"],
+            num_parts, part_index)
+        if not self._handle:
+            raise IOError(L.imgpipe_last_error().decode())
+        self.num_parts, self.part_index = num_parts, part_index
+        self._part_records = L.imgpipe_part_records(self._handle)
+        self._batches_per_epoch = max(
+            1, (self._num_records // num_parts) // self.batch_size)
+        self._cursor = 0
+        # the fresh handle's decode-error counter restarts at zero
+        self._err_seen = 0
+        self._err_window_base = 0
+        self._err_window_records = 0
 
     def close(self):
         if getattr(self, "_handle", None):

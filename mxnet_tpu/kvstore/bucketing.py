@@ -184,6 +184,10 @@ class GradBucketer:
         self._plans = {}      # signature -> list[_Bucket]
         self._residuals = {}  # (signature, bucket_idx, copy_idx) -> jax.Array
         self._pending_residuals = {}  # checkpoint-restored, pre-adoption
+        # per-KEY residual totals parked by an elastic reshard (a dead
+        # world's error feedback, summed over its copies) — re-bucketed
+        # into THIS plan's buckets at the next pushpull
+        self._pending_key_residuals = {}
         self._inflight = None  # host-CPU platform: last dispatched psum
         # device-ring -> live launch-chain token for the blockwise path
         # (tpu_ici._fresh_chain_token); chained launches order through
@@ -491,6 +495,8 @@ class GradBucketer:
         if res is None:
             res = self._adopt_pending(sig, bidx, j, flat)
         if res is None:
+            res = self._adopt_key_pending(sig, bidx, j, (cap,), dtype)
+        if res is None:
             res = jnp.zeros((1, cap), dtype)
         return jax.device_put(res.reshape(1, cap), dev)
 
@@ -543,6 +549,9 @@ class GradBucketer:
         res = self._residuals.get((sig, bidx, j))
         if res is None:
             res = self._adopt_pending(sig, bidx, j, flat)
+        if res is None:
+            res = self._adopt_key_pending(sig, bidx, j, tuple(flat.shape),
+                                          onp.dtype(flat.dtype))
         if res is None:
             res = jnp.zeros_like(flat)
         # blockwise stores launch-shaped (1, capacity) shards; reshape is
@@ -608,3 +617,61 @@ class GradBucketer:
                 onp.dtype(pending.dtype) != onp.dtype(flat.dtype):
             return None  # topology changed since the checkpoint: drop
         return jnp.asarray(pending)
+
+    # -- elastic reshard (world-size change) -------------------------------
+    # The digest embeds the copy count, so after a world shrink the
+    # pending residuals above can never adopt — and the bucket PLAN
+    # itself changes with the device set, so even shape-matched flats
+    # would land in the wrong buckets.  The reshard path instead exports
+    # the old plan's LAYOUT (export_layouts, stored in the checkpoint
+    # meta), slices the flat bucket residuals back into per-key segments,
+    # sums them over the dead world's copies (the allreduce only ever
+    # consumes the SUM of the copies' residuals, so the total is the
+    # error owed to the params), and parks the per-key totals here for
+    # re-bucketing into the survivor plan at the next pushpull.
+    def export_layouts(self):
+        """Device-free layout of every planned bucket, keyed by the same
+        digest as :meth:`export_residuals`: per bucket, the keys it packs
+        and their (offset, size) segments in the flat buffer.  JSON-safe
+        (rides the checkpoint manifest meta)."""
+        out = {}
+        for sig, plan in self._plans.items():
+            out[self._sig_digest(sig)] = {"buckets": [
+                {"keys": list(b.keys),
+                 "offsets": [int(o) for o in b.offsets],
+                 "sizes": [int(s) for s in b.sizes]}
+                for b in plan]}
+        return out
+
+    def import_key_residuals(self, per_key):
+        """Park per-key residual totals (``{key: flat ndarray}``, already
+        summed over a dead world's copies) for re-bucketing into THIS
+        bucketer's plan: the next pushpull packs each key's segment into
+        copy 0 of whatever bucket the survivor plan assigns the key."""
+        self._pending_key_residuals = {
+            k: onp.asarray(v).reshape(-1) for k, v in per_key.items()}
+
+    def _adopt_key_pending(self, sig, bidx, j, shape, dtype):
+        """Build copy ``j``'s residual for (bucket ``bidx``) from parked
+        per-key totals.  Only copy 0 adopts — the totals were already
+        summed over the old copies, and parking the whole sum on one copy
+        conserves the owed error exactly (copies j>0 start from zero).
+        The padding tail stays zero; a key missing from the parked set
+        (or whose size changed) contributes zeros."""
+        if j != 0 or not self._pending_key_residuals:
+            return None
+        plan = self._plans.get(sig)
+        if plan is None or bidx >= len(plan):
+            return None
+        b = plan[bidx]
+        out = onp.zeros(int(onp.prod(onp.asarray(shape, onp.int64))),
+                        onp.dtype(dtype))
+        hit = False
+        for key, off, size in zip(b.keys, b.offsets, b.sizes):
+            pend = self._pending_key_residuals.get(key)
+            if pend is None or pend.size != size:
+                continue
+            out[off:off + size] = pend.astype(out.dtype)
+            del self._pending_key_residuals[key]
+            hit = True
+        return jnp.asarray(out.reshape(shape)) if hit else None

@@ -475,14 +475,22 @@ class TPUICIStore(KVStoreBase):
         fresh stamp clears the suspicion."""
         import time
 
+        from ..resilience import faultline as _faultline
+
         client = self._kv_client()
         if client is None or self._size <= 1:
             return []
         now = time.time()
+        # ranks an injected `dead_node` fault killed: their stamp reads
+        # permanently stale, exactly what a host that stopped beating
+        # looks like — the two-observation rule below still applies
+        killed = _faultline.dead_ranks()
         dead = []
         for r in range(self._size):
             stamp = self._kv_try_get(client, f"mxtpu/heartbeat/{r}")
-            if stamp is None:
+            if r in killed:
+                stale = True
+            elif stamp is None:
                 # never heartbeat: stale only if it had time to start —
                 # within the grace window after this store's own startup
                 # a missing stamp means "still launching", not "dead"

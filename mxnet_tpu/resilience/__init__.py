@@ -25,26 +25,36 @@ recovery path:
 * :mod:`~mxnet_tpu.resilience.policies` — bounded exponential-backoff
   retry for transient faults, and abort-to-checkpoint when the heartbeat
   declares a peer dead.
+* :mod:`~mxnet_tpu.resilience.elastic` — the supervisor above all three:
+  preemptions resume bitwise on the same topology; a PERMANENT host loss
+  (``DeadNodeError``) re-shards onto the survivor mesh — smaller
+  :class:`~mxnet_tpu.resilience.elastic.ElasticWorld`, rebuilt
+  kvstore/bucketer/readers, checkpoint restored with ``reshard=True``
+  (residual debt re-bucketed, never dropped) and an explicit, logged
+  batch/lr scaling rule.
 
 See docs/RESILIENCE.md for the fault model and the recovery matrix.
 """
 from __future__ import annotations
 
-from . import faultline
+from . import elastic, faultline
 from .checkpoint import (CheckpointCorrupt, CheckpointManager,
+                         CheckpointTopologyError, complete_steps,
                          gather_training_state, load_checkpoint,
                          restore_training_state, save_checkpoint)
+from .elastic import ElasticSupervisor, ElasticWorld, EmulatedPod, scaled_lr
 from .faultline import (InjectedError, InjectedFault, InjectedPreemption,
                         InjectedTimeout)
 from .policies import (DeadNodeError, TRANSIENT_EXCEPTIONS,
                        abort_to_checkpoint, check_peers, retry_transient)
 
 __all__ = [
-    "faultline",
+    "faultline", "elastic",
     "InjectedFault", "InjectedTimeout", "InjectedError", "InjectedPreemption",
-    "CheckpointManager", "CheckpointCorrupt",
-    "save_checkpoint", "load_checkpoint",
+    "CheckpointManager", "CheckpointCorrupt", "CheckpointTopologyError",
+    "save_checkpoint", "load_checkpoint", "complete_steps",
     "gather_training_state", "restore_training_state",
+    "ElasticSupervisor", "ElasticWorld", "EmulatedPod", "scaled_lr",
     "retry_transient", "abort_to_checkpoint", "check_peers",
     "DeadNodeError", "TRANSIENT_EXCEPTIONS",
 ]

@@ -44,8 +44,10 @@ from .. import telemetry as _telemetry
 from . import faultline
 
 __all__ = ["CheckpointManager", "CheckpointCorrupt",
+           "CheckpointTopologyError",
            "save_checkpoint", "load_checkpoint", "latest_step",
-           "list_steps", "gather_training_state", "restore_training_state"]
+           "list_steps", "complete_steps",
+           "gather_training_state", "restore_training_state"]
 
 SCHEMA = "mxtpu-ckpt-v1"
 _ARRAYS = "arrays.npz"
@@ -61,6 +63,23 @@ _NATIVE = frozenset(
 
 class CheckpointCorrupt(RuntimeError):
     """A shard failed manifest/checksum validation."""
+
+
+class CheckpointTopologyError(RuntimeError):
+    """The checkpoint was saved by a different world than the one
+    restoring it (device-copy count or a parameter shape differs).
+    Raised by :func:`restore_training_state` instead of letting the
+    mismatch surface as an obscure reshape/device error deep in jax;
+    ``.saved_world`` / ``.live_world`` name both sides.  The elastic
+    reshard path (``restore_training_state(..., reshard=True)``, driven
+    by :class:`~mxnet_tpu.resilience.elastic.ElasticSupervisor`) is the
+    sanctioned way past a world-size mismatch; a shape mismatch means
+    the wrong model and has no reshard story."""
+
+    def __init__(self, message, saved_world=None, live_world=None):
+        super().__init__(message)
+        self.saved_world = saved_world
+        self.live_world = live_world
 
 
 def _counter(name, help, labelnames=()):
@@ -295,6 +314,23 @@ def latest_step(root):
     return steps[-1] if steps else None
 
 
+def complete_steps(root, ranks):
+    """Steps whose shard exists AND validates for EVERY rank in
+    ``ranks``, ascending.  Under a mid-save host death the hosts can
+    disagree on their newest local step; the newest *complete* step is
+    the only one every survivor can restore together, so the elastic
+    path restores from ``complete_steps(root, survivors)[-1]``."""
+    out = []
+    for step in list_steps(root):
+        try:
+            for r in ranks:
+                _validate_shard(_host_dir(root, step, r))
+        except CheckpointCorrupt:
+            continue
+        out.append(step)
+    return out
+
+
 # --------------------------------------------------------------------------
 # training-state gather / restore
 # --------------------------------------------------------------------------
@@ -321,6 +357,14 @@ def gather_training_state(trainer, step, scaler=None, include_rng=True):
         names.append(p.name)
         arrays[f"param/{i}"] = onp.asarray(p.list_data()[0]._data)
     meta["param_names"] = names
+    # the saved world, named explicitly so restore can detect (and the
+    # elastic path can reshard across) a topology change instead of
+    # tripping an obscure device/shape error deep in jax
+    import jax
+
+    copies = max((len(p.list_data()) for p in trainer._params), default=1)
+    meta["world"] = {"copies": int(copies),
+                     "processes": int(jax.process_count())}
     # -- optimizer: per-param state tuples (+ one list entry per device
     # copy), update counts per device, global num_update
     opt = trainer._optimizer
@@ -370,26 +414,63 @@ def gather_training_state(trainer, step, scaler=None, include_rng=True):
             meta["bucket_residuals"].append(
                 {"digest": digest, "bucket": int(bidx), "copy": int(c),
                  "index": n})
+        # bucket layouts (keys + flat segments per bucket, by digest):
+        # what an elastic restore needs to slice the flat residuals back
+        # into per-key totals and re-bucket them for the survivor world
+        meta["bucket_layouts"] = bucketer.export_layouts()
     return arrays, meta
 
 
-def restore_training_state(arrays, meta, trainer, scaler=None):
+def restore_training_state(arrays, meta, trainer, scaler=None,
+                           reshard=False):
     """Inverse of :func:`gather_training_state`: rebind params, optimizer
     states and counts, scaler, RNG stream, and residuals — bitwise.
-    Returns the checkpointed step number."""
+    Returns the checkpointed step number.
+
+    A checkpoint saved by a DIFFERENT world (device-copy count) raises
+    :class:`CheckpointTopologyError` unless ``reshard=True`` — the
+    elastic path.  Resharding restores onto the live topology: params
+    broadcast from the canonical copy, optimizer states from saved copy
+    0 (device copies are kept bitwise in sync by the allreduce, so copy
+    0 IS the state), the RNG stream and loss scale verbatim (both are
+    world-size-free), and the error-feedback residuals summed over the
+    dead world's copies and re-bucketed through ``GradBucketer`` for the
+    survivor device set (``import_key_residuals``) — never adopted by
+    digest, which embeds the old copy count, and never dropped."""
     import jax
 
     from .. import random as _rng
 
     trainer._init_states()
+    live_copies = max((len(p.list_data()) for p in trainer._params),
+                      default=1)
+    saved = meta.get("world")
+    saved_copies = saved.get("copies") if saved else None
+    changed = saved_copies is not None and int(saved_copies) != live_copies
+    if changed and not reshard:
+        raise CheckpointTopologyError(
+            f"checkpoint topology mismatch: saved world has "
+            f"{saved_copies} device copies ({saved.get('processes')} "
+            f"process(es)), live world has {live_copies} device copies "
+            f"({jax.process_count()} process(es)); pass reshard=True "
+            "(the elastic supervisor's path) to restore onto the "
+            "survivor world", saved_world=dict(saved),
+            live_world={"copies": live_copies,
+                        "processes": int(jax.process_count())})
     for i, p in enumerate(trainer._params):
         a = arrays.get(f"param/{i}")
         if a is None:
             continue
+        if tuple(a.shape) != tuple(p.shape):
+            raise CheckpointTopologyError(
+                f"checkpoint shape mismatch for param {i} "
+                f"({meta.get('param_names', [None] * (i + 1))[i]}): "
+                f"saved {tuple(a.shape)}, live {tuple(p.shape)} — "
+                "different model, not a reshardable world change",
+                saved_world=saved,
+                live_world={"copies": live_copies})
         for w in p.list_data():
-            dev = (list(w._data.devices())[0]
-                   if isinstance(w._data, jax.Array) else None)
-            w._rebind(jax.device_put(a, dev))
+            w._rebind(_nd_put(a, w))
     opt = trainer._optimizer
     opt_multi = meta.get("opt_multi", {})
     for i, entry in (trainer._states or {}).items():
@@ -398,18 +479,20 @@ def restore_training_state(arrays, meta, trainer, scaler=None):
             continue
         if isinstance(entry, list):
             for c, st in enumerate(entry):
-                src_c = c if ncopies else None
+                # reshard: every live copy restores from saved copy 0 —
+                # copies are bitwise replicas, so copy 0 is canonical and
+                # the survivor count may be anything
+                src_c = (0 if changed else c) if ncopies else None
                 for j, s in enumerate(_as_tuple(st)):
                     key = (f"opt/{i}/{src_c}/{j}" if src_c is not None
                            else f"opt/{i}/{j}")
                     if key in arrays:
-                        s._rebind(jax.device_put(
-                            arrays[key], _nd_device(s)))
+                        s._rebind(_nd_put(arrays[key], s))
         else:
             for j, s in enumerate(_as_tuple(entry)):
                 key = f"opt/{i}/0/{j}" if ncopies else f"opt/{i}/{j}"
                 if key in arrays:
-                    s._rebind(jax.device_put(arrays[key], _nd_device(s)))
+                    s._rebind(_nd_put(arrays[key], s))
     counts = meta.get("opt_update_counts")
     if counts is not None:
         opt._all_index_update_counts = {
@@ -439,13 +522,29 @@ def restore_training_state(arrays, meta, trainer, scaler=None):
     if store is not None and hasattr(store, "_residuals"):
         import jax.numpy as jnp
 
-        for name, a in arrays.items():
-            if name.startswith("kvres/"):
-                # uncommitted jnp arrays: `_residual_matches` only gates
-                # on shape/dtype for these, so the next compressed reduce
-                # adopts them wherever the copies live
-                _, key, c = name.split("/")
-                store._residuals[(int(key), int(c))] = jnp.asarray(a)
+        if changed:
+            # reshard: each saved copy's residual is quantization error
+            # owed to the params, so the total debt is their SUM.  Park
+            # the per-key sums on survivor copy 0 — uncommitted, so
+            # `_residual_matches` gates only on shape/dtype and the next
+            # compressed reduce adopts them wherever the copies now live.
+            totals = {}
+            for name, a in arrays.items():
+                if name.startswith("kvres/"):
+                    _, key, _c = name.split("/")
+                    k = int(key)
+                    a = onp.asarray(a)
+                    totals[k] = a if k not in totals else totals[k] + a
+            for k, tot in totals.items():
+                store._residuals[(k, 0)] = jnp.asarray(tot)
+        else:
+            for name, a in arrays.items():
+                if name.startswith("kvres/"):
+                    # uncommitted jnp arrays: `_residual_matches` only
+                    # gates on shape/dtype for these, so the next
+                    # compressed reduce adopts them where the copies live
+                    _, key, c = name.split("/")
+                    store._residuals[(int(key), int(c))] = jnp.asarray(a)
     bucketer = getattr(store, "_bucketer", None) if store is not None \
         else None
     pending = meta.get("bucket_residuals")
@@ -454,10 +553,43 @@ def restore_training_state(arrays, meta, trainer, scaler=None):
         from ..kvstore.bucketing import GradBucketer
         bucketer = store._bucketer = GradBucketer()
     if bucketer is not None and pending:
-        bucketer.import_residuals({
-            (e["digest"], e["bucket"], e["copy"]):
-                arrays[f"bucketres/{e['index']}"]
-            for e in pending})
+        if changed:
+            # reshard: the digest embeds the dead world's copy count and
+            # the bucket plan itself changes with the device set, so
+            # digest adoption is impossible by construction.  Slice each
+            # flat residual back into per-key segments via the saved
+            # layouts, sum across copies and buckets, and hand the
+            # totals to the bucketer for re-bucketing into the survivor
+            # plan at its next pushpull.
+            import logging
+
+            layouts = meta.get("bucket_layouts") or {}
+            per_key, missing = {}, 0
+            for e in pending:
+                layout = layouts.get(e["digest"])
+                if layout is None:
+                    missing += 1
+                    continue
+                b = layout["buckets"][int(e["bucket"])]
+                flat = onp.asarray(
+                    arrays[f"bucketres/{e['index']}"]).reshape(-1)
+                for key, off, size in zip(b["keys"], b["offsets"],
+                                          b["sizes"]):
+                    seg = flat[off:off + size]
+                    acc = per_key.get(key)
+                    per_key[key] = seg.copy() if acc is None else acc + seg
+            if missing:
+                logging.getLogger(__name__).warning(
+                    "elastic restore: %d bucket residual(s) saved without "
+                    "a layout (pre-elastic checkpoint) cannot be "
+                    "re-bucketed and were dropped", missing)
+            if per_key:
+                bucketer.import_key_residuals(per_key)
+        else:
+            bucketer.import_residuals({
+                (e["digest"], e["bucket"], e["copy"]):
+                    arrays[f"bucketres/{e['index']}"]
+                for e in pending})
     return int(meta.get("step", 0))
 
 
@@ -466,6 +598,21 @@ def _nd_device(nd):
 
     return (list(nd._data.devices())[0]
             if isinstance(nd._data, jax.Array) else None)
+
+
+def _nd_put(a, nd):
+    """Place host array ``a`` exactly where ``nd``'s buffer lives: the
+    single device, or — for sharded/committed jax Arrays — the same
+    sharding, so an elastic restore lands on the survivor mesh without
+    a resharding transfer afterwards."""
+    import jax
+
+    if isinstance(nd._data, jax.Array):
+        devs = nd._data.devices()
+        if len(devs) > 1:
+            return jax.device_put(a, nd._data.sharding)
+        return jax.device_put(a, list(devs)[0])
+    return jax.device_put(a, None)
 
 
 # --------------------------------------------------------------------------
@@ -592,14 +739,33 @@ class CheckpointManager:
                     shutil.rmtree(os.path.join(self.root, name),
                                   ignore_errors=True)
 
-    def restore_latest(self):
+    def restore_latest(self, ranks=None):
         """Newest valid shard for this rank: ``(step, arrays, meta)``.
         A corrupt shard is logged, counted, and skipped — restore falls
         back to the previous checkpoint; ``None`` when nothing valid
-        exists."""
+        exists.
+
+        ``ranks`` (the elastic path) restricts the search to steps whose
+        shard validates for EVERY given rank: a host that died mid-save
+        leaves its newest step torn — some shards committed, its own
+        missing — and restoring it would resume the survivors from
+        different steps.  A torn step ticks the restore counter with
+        outcome ``torn_fallback`` and the previous complete step is
+        used."""
         import logging
 
         for step in reversed(list_steps(self.root)):
+            if ranks is not None:
+                try:
+                    for r in ranks:
+                        _validate_shard(_host_dir(self.root, step, r))
+                except CheckpointCorrupt as e:
+                    _restores_counter().labels(
+                        outcome="torn_fallback").inc()
+                    logging.getLogger(__name__).warning(
+                        "checkpoint step %d incomplete across ranks %s "
+                        "(%s); falling back", step, list(ranks), e)
+                    continue
             try:
                 out = load_checkpoint(self.root, step, rank=self._rank)
             except CheckpointCorrupt as e:

@@ -1,0 +1,391 @@
+"""Elastic training: survive permanent host loss by re-sharding onto
+the survivor mesh.
+
+PR 9 proved bitwise preempt/resume onto the *same* topology; this
+module closes ROADMAP item 4's other half — a pod that permanently
+loses a host keeps training on the hosts it still has.  The pieces:
+
+* :class:`ElasticWorld` — the live topology as a value: survivor ranks,
+  the base (launch-time) world size, and a generation counter bumped at
+  every re-shard.
+* :class:`ElasticSupervisor` — wraps the training loop.  It polls peer
+  liveness (``policies.check_peers``) every ``check_every`` steps, and:
+
+  - an :class:`~.faultline.InjectedPreemption` (same-topology host
+    restart) rebuilds against the SAME world and restores bitwise —
+    the PR 9 contract, now owned by the supervisor;
+  - a :class:`~.policies.DeadNodeError` (permanent host loss) shrinks
+    the world to the survivors, rebuilds kvstore / bucketer /
+    ``FusedTrainStep`` via the user's ``build(world)`` callback, and
+    restores the newest checkpoint complete across ALL survivors with
+    ``restore_training_state(..., reshard=True)`` — params broadcast,
+    optimizer state from canonical copy 0, RNG stream and loss scale
+    verbatim, and the 2bit/int8/fp8 error-feedback residuals summed
+    per key and re-bucketed for the survivor device set;
+  - fewer survivors than ``MXNET_ELASTIC_MIN_WORLD`` (or elastic mode
+    off) re-raises — abort-to-checkpoint, the pre-elastic behavior.
+
+* :class:`EmulatedPod` — a liveness oracle standing in for a multi-host
+  pod inside one CI process, observing planned ``dead_node`` faults
+  exactly like ``TPUICIStore.get_dead_nodes`` observes a real death.
+
+**The scaling rule, stated once** (:func:`scaled_lr`): the per-host
+batch is held constant, so the global batch scales by
+``world.size / world.base_size`` across a re-shard.  Under the default
+``linear`` rule the learning rate scales by the same factor (the
+linear-scaling rule); under ``none`` the lr is kept and the supervisor
+logs that the effective step size changed.  The **loss scale is never
+adjusted**: ``rescale_grad`` divides by the global batch, so
+per-parameter gradient magnitudes are world-size-invariant and the
+scaler's overflow statistics stay calibrated.  Whichever rule applies,
+it is logged loudly — never silent.
+
+What is and is not trajectory-preserved across a world-size change is
+documented in docs/RESILIENCE.md ("Elastic recovery"): same-topology
+recovery is bitwise; a re-shard is *state-exact* (params, optimizer,
+RNG, residual debt all carried over) but the trajectory forks forward
+because the global batch — and under ``linear`` the lr — changed.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from .. import env as _env
+from .. import telemetry as _telemetry
+from . import checkpoint as _checkpoint
+from . import faultline
+from .policies import DeadNodeError, check_peers
+
+__all__ = ["ElasticWorld", "ElasticSupervisor", "EmulatedPod",
+           "scaled_lr", "rederive_reader", "SCALING_RULES"]
+
+SCALING_RULES = ("linear", "none")
+
+_log = logging.getLogger(__name__)
+
+
+def _reshards_counter():
+    return _telemetry.counter(
+        "mxtpu_elastic_reshards_total",
+        "World shrinks the elastic supervisor survived: a permanent "
+        "host loss re-sharded onto the survivor mesh and training "
+        "continued — each tick cost one checkpoint interval, not a job "
+        "restart")
+
+
+def _world_gauge():
+    return _telemetry.gauge(
+        "mxtpu_elastic_world_size",
+        "Live world size under the elastic supervisor (hosts currently "
+        "training); below the launch size means a re-shard happened")
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticWorld:
+    """The live topology as an immutable value.
+
+    ``ranks`` are the global ranks still alive (sorted), ``base_size``
+    the launch-time world (the denominator of every scaling factor),
+    ``generation`` bumps at each re-shard so rebuilt components can tag
+    caches/telemetry by topology epoch."""
+
+    ranks: tuple
+    base_size: int
+    generation: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "ranks", tuple(sorted(int(r) for r in self.ranks)))
+        if not self.ranks:
+            raise ValueError("ElasticWorld needs at least one rank")
+
+    @classmethod
+    def fresh(cls, size):
+        """The launch world: ranks 0..size-1, generation 0."""
+        return cls(tuple(range(int(size))), int(size))
+
+    @property
+    def size(self):
+        return len(self.ranks)
+
+    @property
+    def scale(self):
+        """Global-batch factor vs launch: ``size / base_size``."""
+        return self.size / float(self.base_size)
+
+    def part_index(self, rank):
+        """This rank's dense index among the survivors — what a reader's
+        ``part_index`` must become so the survivor parts partition the
+        dataset with no gap at the dead ranks' old indices."""
+        return self.ranks.index(int(rank))
+
+    def shrink(self, survivors):
+        """The next-generation world holding only ``survivors`` (must be
+        a non-empty subset of the current ranks)."""
+        survivors = tuple(sorted(int(r) for r in survivors))
+        if not set(survivors) <= set(self.ranks):
+            raise ValueError(
+                f"survivors {survivors} not a subset of {self.ranks}")
+        return ElasticWorld(survivors, self.base_size, self.generation + 1)
+
+
+def scaled_lr(base_lr, world, rule="linear"):
+    """The batch/lr scaling rule (module docstring): per-host batch is
+    constant, so the global batch scales by ``world.scale``; ``linear``
+    scales the lr by the same factor, ``none`` keeps it.  The loss
+    scale is NEVER touched — ``rescale_grad`` already normalizes by the
+    global batch, so gradient magnitudes (and the scaler's overflow
+    window) are world-size-invariant."""
+    if rule not in SCALING_RULES:
+        raise ValueError(f"unknown scaling rule {rule!r}; "
+                         f"one of {SCALING_RULES}")
+    if rule == "linear":
+        return float(base_lr) * world.scale
+    return float(base_lr)
+
+
+def rederive_reader(it, world, rank):
+    """Point a partitioned reader (``ImageIter`` / ``ImageRecordIter``)
+    at the survivor world: ``num_parts = world.size``, ``part_index``
+    this rank's dense survivor index.  Takes effect at the reader's
+    next epoch — the survivor parts then partition the permutation
+    exactly, no record read twice or dropped within an epoch."""
+    it.reshard(num_parts=world.size, part_index=world.part_index(rank))
+    return it
+
+
+class EmulatedPod:
+    """A liveness oracle standing in for a multi-host pod inside ONE
+    process (CI has no second host to kill).  Mirrors
+    ``TPUICIStore.get_dead_nodes`` observation-for-observation: each
+    live rank's stamp read passes through the ``kvstore.kv`` faultline
+    hook (so planned ``dead_node`` specs fire on the same deterministic
+    arrival schedule as a real store's KV reads), a rank in
+    :func:`faultline.dead_ranks` reads permanently stale, and death is
+    declared on the second consecutive stale observation — one missed
+    beat never kills a live job."""
+
+    def __init__(self, ranks):
+        self.ranks = tuple(sorted(int(r) for r in ranks))
+        self._stale_counts = {}
+
+    def shrink(self, survivors):
+        """Forget dead ranks after a re-shard: only survivors are
+        polled (and can be killed) from here on."""
+        self.ranks = tuple(sorted(int(r) for r in survivors))
+        for r in list(self._stale_counts):
+            if r not in self.ranks:
+                self._stale_counts.pop(r)
+
+    def get_dead_nodes(self, timeout=60):
+        """Same contract as ``TPUICIStore.get_dead_nodes`` (``timeout``
+        accepted for signature parity; emulated staleness is driven by
+        the fault plan, not wall clock)."""
+        dead = []
+        for r in self.ranks:
+            try:
+                faultline.check("kvstore.kv")
+            # mxlint: disable=swallowed-exception -- a real store's stamp read retries transients away inside _kv_try_get; the emulated read only needs the arrival (dead_node specs fire on it), not the value
+            except Exception:
+                pass
+            if r not in faultline.dead_ranks():
+                self._stale_counts.pop(r, None)
+                continue
+            n = self._stale_counts.get(r, 0) + 1
+            self._stale_counts[r] = n
+            if n >= 2:
+                dead.append(r)
+        return dead
+
+
+class ElasticSupervisor:
+    """Owns the recover-and-continue loop around a training job.
+
+    ``build(world)`` is the user's factory: given an
+    :class:`ElasticWorld` it constructs the job against that topology —
+    model, ``Trainer`` (kvstore + bucketer + compression), readers
+    (``num_parts = world.size``, ``part_index = world.part_index(r)``),
+    ``FusedTrainStep`` — and returns a *handle* with:
+
+    * ``.trainer`` — the ``gluon.Trainer`` (required),
+    * ``.run_step(t)`` — run training step ``t``; step ``t`` must be a
+      pure function of ``(restored state, t)`` so a replay after
+      restore is bitwise (required),
+    * ``.scaler`` — the amp ``LossScaler``, if any (optional),
+    * ``.readers`` — long-lived partitioned iterators the supervisor
+      re-derives with :func:`rederive_reader` after a re-shard
+      (optional; readers built fresh inside ``build`` need nothing),
+    * ``.close()`` — release stores/threads before a rebuild (optional).
+
+    ``manager`` is the :class:`~.checkpoint.CheckpointManager` (one per
+    host; under an :class:`EmulatedPod` the supervisor also commits the
+    other emulated hosts' shards so torn-save detection is exercised
+    for real).  ``pod`` is the liveness oracle — a ``TPUICIStore`` on a
+    real pod, an :class:`EmulatedPod` in CI, or ``None`` to disable
+    peer checks.
+
+    Knobs (env defaults, see ``env.py``): ``elastic``
+    (``MXNET_ELASTIC``) gates re-sharding at all; ``min_world``
+    (``MXNET_ELASTIC_MIN_WORLD``) refuses to shrink below a floor —
+    both failure modes re-raise :class:`DeadNodeError`, the
+    abort-to-checkpoint path; ``scaling`` (``MXNET_ELASTIC_SCALING``)
+    picks the lr rule applied by :func:`scaled_lr`.
+    """
+
+    def __init__(self, build, manager, *, world=None, pod=None,
+                 elastic=None, min_world=None, scaling=None,
+                 check_every=1, liveness_timeout=60):
+        self._build = build
+        self._manager = manager
+        self._pod = pod
+        if world is None:
+            ranks = getattr(pod, "ranks", None)
+            world = (ElasticWorld(tuple(ranks), len(tuple(ranks)))
+                     if ranks else ElasticWorld.fresh(1))
+        self.world = world
+        self._emulated = isinstance(pod, EmulatedPod)
+        self._elastic = (_env.elastic_enabled() if elastic is None
+                         else bool(elastic))
+        self._min_world = (_env.elastic_min_world() if min_world is None
+                           else max(1, int(min_world)))
+        self._scaling = _env.elastic_scaling() if scaling is None \
+            else scaling
+        if self._scaling not in SCALING_RULES:
+            raise ValueError(f"unknown scaling rule {self._scaling!r}; "
+                             f"one of {SCALING_RULES}")
+        self._check_every = max(1, int(check_every))
+        self._liveness_timeout = liveness_timeout
+        self._base_lr = None
+        self.handle = None
+        self.reshards = 0
+
+    # -- lifecycle --------------------------------------------------------
+    def _construct(self):
+        handle = self._build(self.world)
+        if self._base_lr is None:
+            self._base_lr = float(handle.trainer.learning_rate)
+        self.handle = handle
+        _world_gauge().set(self.world.size)
+        return handle
+
+    def _teardown(self):
+        if self.handle is not None:
+            close = getattr(self.handle, "close", None)
+            if close is not None:
+                close()
+            self.handle = None
+
+    def _save(self, handle, step):
+        arrays, meta = _checkpoint.gather_training_state(
+            handle.trainer, step, scaler=getattr(handle, "scaler", None))
+        self._manager.save(step, arrays, meta)
+        if self._emulated:
+            # one process stands in for every host: commit the other
+            # emulated ranks' shards too, so all-ranks-complete restore
+            # (and its torn-save fallback) is exercised for real
+            for r in self.world.ranks:
+                if r != self._manager._rank:
+                    _checkpoint.save_checkpoint(
+                        self._manager.root, step, arrays, meta, rank=r)
+
+    def _restore(self, handle, reshard=False):
+        """Restore the newest checkpoint complete across the live world;
+        returns the step to resume FROM (0 when no checkpoint)."""
+        self._manager.wait()
+        ranks = self.world.ranks if self._emulated else None
+        out = self._manager.restore_latest(ranks=ranks)
+        if out is None:
+            return 0
+        step, arrays, meta = out
+        _checkpoint.restore_training_state(
+            arrays, meta, handle.trainer,
+            scaler=getattr(handle, "scaler", None), reshard=reshard)
+        return int(step)
+
+    def _apply_scaling(self, handle):
+        """Apply — and LOG — the batch/lr rule after a world change."""
+        lr = scaled_lr(self._base_lr, self.world, self._scaling)
+        if self._scaling == "linear":
+            handle.trainer.set_learning_rate(lr)
+        _log.warning(
+            "elastic re-shard (generation %d): world %d -> %d of base %d; "
+            "global batch scaled by %.3f (per-host batch constant); "
+            "rule '%s': lr %s %.6g; loss scale untouched (rescale_grad "
+            "normalizes by global batch, so gradient magnitudes are "
+            "world-size-invariant)",
+            self.world.generation, self.world.base_size, self.world.size,
+            self.world.base_size, self.world.scale, self._scaling,
+            "set to" if self._scaling == "linear" else "kept at", lr)
+
+    def _rederive_readers(self, handle):
+        readers = getattr(handle, "readers", None) or ()
+        rank = (self._manager._rank if self._manager._rank
+                in self.world.ranks else self.world.ranks[0])
+        for it in readers:
+            rederive_reader(it, self.world, rank)
+
+    # -- the loop ---------------------------------------------------------
+    def run(self, total_steps, checkpoint_every=1):
+        """Train to ``total_steps``, surviving preemptions (same-world
+        bitwise resume) and — in elastic mode — permanent host loss
+        (re-shard onto survivors).  Returns the final handle."""
+        handle = self.handle or self._construct()
+        t = self._restore(handle)
+        while t < total_steps:
+            try:
+                if self._pod is not None and t % self._check_every == 0:
+                    check_peers(self._pod, self._manager,
+                                timeout=self._liveness_timeout)
+                handle.run_step(t)
+                t += 1
+                if t % checkpoint_every == 0 or t == total_steps:
+                    self._save(handle, t)
+            except faultline.InjectedPreemption as e:
+                # same-topology host restart: rebuild against the SAME
+                # world, restore bitwise, replay from the checkpoint
+                _log.warning("preemption at step %d (%s); resuming from "
+                             "last checkpoint on the same topology", t, e)
+                self._teardown()
+                handle = self._construct()
+                t = self._restore(handle)
+                faultline.recovered(e.site, e.kind)
+            except DeadNodeError as e:
+                survivors = [r for r in self.world.ranks
+                             if r not in set(e.ranks)]
+                if not self._elastic:
+                    _log.error(
+                        "dead nodes %s and elastic mode is off "
+                        "(MXNET_ELASTIC=0): aborting to checkpoint %s",
+                        e.ranks, e.checkpoint_step)
+                    raise
+                if len(survivors) < self._min_world:
+                    _log.error(
+                        "dead nodes %s leave %d survivor(s), below "
+                        "min_world=%d (MXNET_ELASTIC_MIN_WORLD): refusing "
+                        "to shrink; aborting to checkpoint %s",
+                        e.ranks, len(survivors), self._min_world,
+                        e.checkpoint_step)
+                    raise
+                t = self._reshard(survivors)
+                handle = self.handle
+        return handle
+
+    def _reshard(self, survivors):
+        """Shrink to ``survivors``, rebuild, restore onto the new
+        topology; returns the step to resume from."""
+        self._teardown()
+        self.world = self.world.shrink(survivors)
+        if self._pod is not None and hasattr(self._pod, "shrink"):
+            self._pod.shrink(self.world.ranks)
+        handle = self._construct()
+        self._rederive_readers(handle)
+        t = self._restore(handle, reshard=True)
+        self._apply_scaling(handle)
+        self.reshards += 1
+        _reshards_counter().inc()
+        faultline.recovered("kvstore.kv", "dead_node")
+        return t
+
+    def close(self):
+        self._teardown()

@@ -26,10 +26,15 @@ Kinds: ``timeout`` (raises :class:`InjectedTimeout`, a ``TimeoutError`` —
 the transient class every retry policy handles), ``error``
 (:class:`InjectedError` — non-transient), ``preempt``
 (:class:`InjectedPreemption` — the "host died" class; chaos tests catch
-it where a real preemption would kill the process), and ``nan_grad``
+it where a real preemption would kill the process), ``nan_grad``
 (only meaningful at ``train.grads``: the hook poisons the gradient
 rescale factor instead of raising, exercising the finite-grad
-step-guard end to end).
+step-guard end to end), and ``dead_node`` (only meaningful at
+``kvstore.kv``: the spec's required ``rank`` is registered as
+permanently dead — its heartbeat stamp reads stale forever after — so
+elastic recovery is drivable from a seeded plan; the liveness pollers
+consult :func:`dead_ranks`.  Never raises: a dead peer is something the
+*other* hosts observe, not an exception at the reader).
 
 Registration::
 
@@ -59,12 +64,13 @@ __all__ = [
     "InjectedPreemption",
     "plan", "clear", "active_plan", "seeded_plan",
     "check", "poll", "recovered", "arrivals", "raise_fault",
+    "dead_ranks",
 ]
 
 SITES = ("kvstore.kv", "kvstore.pushpull", "collective.dispatch",
          "serve.model_call", "serve.replica", "data.iterator",
          "checkpoint.write", "train.grads")
-KINDS = ("timeout", "error", "preempt", "nan_grad")
+KINDS = ("timeout", "error", "preempt", "nan_grad", "dead_node")
 
 
 class InjectedFault(RuntimeError):
@@ -99,15 +105,19 @@ _EXC_BY_KIND = {
 
 
 class _Spec:
-    __slots__ = ("site", "kind", "at", "times", "fired")
+    __slots__ = ("site", "kind", "at", "times", "fired", "rank")
 
-    def __init__(self, site, kind, at=None, times=1):
+    def __init__(self, site, kind, at=None, times=1, rank=None):
         if site not in SITES:
             raise ValueError(f"unknown faultline site {site!r}; "
                              f"one of {SITES}")
         if kind not in KINDS:
             raise ValueError(f"unknown faultline kind {kind!r}; "
                              f"one of {KINDS}")
+        if kind == "dead_node" and rank is None:
+            raise ValueError(
+                "faultline kind 'dead_node' needs an explicit 'rank' "
+                "(which peer's heartbeat goes permanently stale)")
         self.site = site
         self.kind = kind
         # `at` is the 1-based arrival index at the site; None = next
@@ -116,6 +126,7 @@ class _Spec:
         self.at = None if at is None else int(at)
         self.times = max(1, int(times))
         self.fired = 0
+        self.rank = None if rank is None else int(rank)
 
     def matches(self, arrival):
         start = self.at if self.at is not None else 1
@@ -123,8 +134,11 @@ class _Spec:
             start <= arrival < start + self.times
 
     def to_dict(self):
-        return {"site": self.site, "kind": self.kind,
-                "at": self.at, "times": self.times, "fired": self.fired}
+        d = {"site": self.site, "kind": self.kind,
+             "at": self.at, "times": self.times, "fired": self.fired}
+        if self.rank is not None:
+            d["rank"] = self.rank
+        return d
 
 
 class _State:
@@ -132,6 +146,7 @@ class _State:
         self.lock = threading.Lock()
         self.specs = None       # None = env not consulted yet
         self.counts = {}        # site -> arrivals seen
+        self.dead_ranks = set()  # ranks killed by fired dead_node specs
 
 
 _state = _State()
@@ -159,10 +174,11 @@ def _parse_plan(entries):
     specs = []
     for e in entries:
         if isinstance(e, _Spec):
-            specs.append(_Spec(e.site, e.kind, e.at, e.times))
+            specs.append(_Spec(e.site, e.kind, e.at, e.times, e.rank))
             continue
         at = e.get("at", e.get("step"))
-        specs.append(_Spec(e["site"], e["kind"], at, e.get("times", 1)))
+        specs.append(_Spec(e["site"], e["kind"], at, e.get("times", 1),
+                           e.get("rank")))
     return specs
 
 
@@ -186,6 +202,7 @@ def plan(entries):
     with _state.lock:
         _state.specs = _parse_plan(entries)
         _state.counts = {}
+        _state.dead_ranks = set()
 
 
 def clear():
@@ -195,6 +212,7 @@ def clear():
     with _state.lock:
         _state.specs = []
         _state.counts = {}
+        _state.dead_ranks = set()
 
 
 def active_plan():
@@ -244,8 +262,21 @@ def _arrive(site):
         for s in _state.specs:
             if s.site == site and s.matches(n):
                 s.fired += 1
+                if s.kind == "dead_node":
+                    # permanent: the rank stays dead until the plan is
+                    # replaced/cleared — every later liveness poll sees it
+                    _state.dead_ranks.add(s.rank)
                 return s
         return None
+
+
+def dead_ranks():
+    """Ranks killed by fired ``dead_node`` specs (permanently stale
+    heartbeats).  Consulted by the liveness pollers —
+    ``TPUICIStore.get_dead_nodes`` and ``elastic.EmulatedPod`` — so a
+    planned host death is observed exactly like a real one."""
+    with _state.lock:
+        return frozenset(_state.dead_ranks)
 
 
 def poll(site):

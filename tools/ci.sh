@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI entry (reference: ci/build.py + runtime_functions.sh stages).
 # Stages: lint | import | hloscan | census | smoke | test | chaos
-# | storm | perf | dryrun | all (default: all).
+# | storm | endure | perf | dryrun | all (default: all).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -191,6 +191,20 @@ run_storm() {
     python -m tools.storm --gate
   fi
 }
+run_endure() {
+  # elastic endurance gate (ISSUE 13): one emulated 3-host pod driven
+  # through two preemptions (same topology -> bitwise trajectory parity
+  # vs the fault-free oracle) and one PERMANENT host kill (dead_node
+  # fault -> re-shard onto the 2 survivors, linear lr rule, per-host
+  # throughput back to >=95% of pre-fault within the recovery window),
+  # visible in mxtpu_elastic_reshards_total and
+  # mxtpu_faults_recovered_total{kvstore.kv,dead_node}
+  # (docs/RESILIENCE.md "Elastic recovery"; opt out with
+  # MXTPU_CHAOS_ENDURE=0)
+  if [ "${MXTPU_CHAOS_ENDURE:-1}" != "0" ]; then
+    python -m tools.endure --gate
+  fi
+}
 run_perf()   { python benchmark/opperf/opperf.py --smoke; }
 run_dryrun() {
   # pytest already runs the 4-process launcher test; skip it inside the
@@ -210,9 +224,11 @@ case "$stage" in
   test)    run_test ;;
   chaos)   run_chaos ;;
   storm)   run_storm ;;
+  endure)  run_endure ;;
   perf)    run_perf ;;
   dryrun)  run_dryrun ;;
   all)     run_lint; run_import; run_hloscan; run_census; run_smoke
-           run_test; run_chaos; run_storm; run_perf; run_dryrun ;;
+           run_test; run_chaos; run_storm; run_endure; run_perf
+           run_dryrun ;;
   *) echo "unknown stage $stage" >&2; exit 2 ;;
 esac

@@ -1,0 +1,276 @@
+"""Endurance gate for elastic training (``tools/ci.sh endure``).
+
+One emulated 3-host pod (rank r trains on virtual device ``cpu(r)``,
+block-scaled int8 compressed allreduce) is driven through a seeded
+faultline plan in two phases:
+
+1. **Preempt x2, same topology** — two mid-run preemptions inside the
+   bucketed collective.  The :class:`ElasticSupervisor` rebuilds against
+   the SAME world and resumes from the last checkpoint; the final
+   parameters must match a fault-free oracle **bitwise** (the PR 9
+   trajectory-parity fence, now owned by the supervisor), with
+   ``mxtpu_faults_recovered_total{collective.dispatch,preempt}`` += 2
+   and zero re-shards.
+2. **Permanent host kill** — a ``dead_node`` fault kills rank 1's
+   heartbeat mid-run.  The supervisor must re-shard 3 -> 2 (survivors
+   keep their own devices AND their own per-rank data streams), apply
+   the linear lr scaling rule (lr x 2/3, logged), tick
+   ``mxtpu_elastic_reshards_total`` and
+   ``mxtpu_faults_recovered_total{kvstore.kv,dead_node}``, finish the
+   run with finite parameters, and recover **per-host** throughput to
+   >= 95% of the pre-fault rate within the recovery window (global
+   throughput necessarily drops with the dead host — the gate is that
+   each survivor keeps its own pace; measured on the last
+   ``RECOVER_WINDOW`` steps so the one-off re-shard cost — rebuild,
+   restore, recompile — is excluded, which is the "within N steps"
+   clause).
+
+Deterministic: data is a pure function of (rank, step), faults are
+arrival-indexed plans, checkpoints are every-step — a failing run
+replays exactly.  Run directly::
+
+    python -m tools.endure --gate
+
+Prints one ``endure_verdict: PASS|FAIL`` line; ``--gate`` exits nonzero
+on FAIL.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import statistics
+import sys
+import time
+
+# standalone process: same virtual-device rig as tests/conftest.py, and
+# it must be set before jax initializes its backends
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.utils import split_and_load
+from mxnet_tpu.resilience import (CheckpointManager, ElasticSupervisor,
+                                  ElasticWorld, EmulatedPod, faultline)
+
+HOSTS = 3
+IN_UNITS = 12
+PER_HOST_BATCH = 2
+SEED = 4242
+BASE_LR = 0.05
+
+STEPS_A = 6          # phase 1 run length
+STEPS_B = 14         # phase 2 run length
+KILL_POLL = 6        # liveness poll on which rank 1's heartbeat dies
+RECOVER_WINDOW = 4   # post-reshard steps the throughput gate averages
+WARMUP = 2           # leading compile steps excluded from the baseline
+THROUGHPUT_FLOOR = 0.95
+
+
+def _host_batch(t, rank):
+    # keyed by RANK, not by position in the world: a survivor keeps its
+    # own data stream across a re-shard
+    rs = onp.random.RandomState(1000 + 997 * rank + t)
+    return rs.randn(PER_HOST_BATCH, IN_UNITS).astype(onp.float32)
+
+
+def _global_batch(t, ranks):
+    return onp.concatenate([_host_batch(t, r) for r in ranks], axis=0)
+
+
+class _Job:
+    """One incarnation of the emulated pod job: the ``build(world)``
+    handle the supervisor expects (``.trainer`` / ``.run_step``)."""
+
+    def __init__(self, world):
+        mx.random.seed(SEED)
+        self.world = world
+        self.ctxs = [mx.cpu(r) for r in world.ranks]
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, in_units=IN_UNITS, activation="relu"))
+        net.add(nn.Dense(8, in_units=16))
+        net.initialize(ctx=self.ctxs)
+        self.net = net
+        self.trainer = gluon.Trainer(
+            net.collect_params(), "sgd",
+            {"learning_rate": BASE_LR, "momentum": 0.9},
+            kvstore="tpu_ici",
+            compression_params={"type": "int8", "block": 64})
+        self.step_seconds = []  # (step, wall_seconds, world_size)
+
+    def run_step(self, t):
+        t0 = time.perf_counter()
+        x = mx.np.array(_global_batch(t, self.world.ranks))
+        xs = split_and_load(x, self.ctxs)
+        with autograd.record():
+            ls = [(self.net(xb) ** 2).mean() for xb in xs]
+        autograd.backward(ls)
+        self.trainer.step(PER_HOST_BATCH * len(self.ctxs))
+        mx.waitall()
+        self.step_seconds.append(
+            (t, time.perf_counter() - t0, self.world.size))
+
+    def params_np(self):
+        return {k: onp.asarray(p.data()._data)
+                for k, p in self.net.collect_params().items()}
+
+
+def _phase_preempt(root):
+    """Two preemptions, same topology: bitwise trajectory parity."""
+    faultline.clear()
+    world = ElasticWorld.fresh(HOSTS)
+
+    oracle = _Job(world)
+    for t in range(STEPS_A):
+        oracle.run_step(t)
+    want = oracle.params_np()
+
+    reg = telemetry.default_registry()
+    labels = {"site": "collective.dispatch", "kind": "preempt"}
+    rec0 = reg.get_sample_value(
+        "mxtpu_faults_recovered_total", labels) or 0
+    res0 = reg.get_sample_value("mxtpu_elastic_reshards_total") or 0
+    # one bucket dispatch per step (the whole model fits one bucket):
+    # arrival 3 preempts step 2, the replay re-arrives as 4, arrival 5
+    # then preempts step 3 — two distinct preempt/resume cycles
+    faultline.plan([
+        {"site": "collective.dispatch", "kind": "preempt", "at": 3},
+        {"site": "collective.dispatch", "kind": "preempt", "at": 5},
+    ])
+    mgr = CheckpointManager(os.path.join(root, "preempt"),
+                            async_write=False, rank=0)
+    sup = ElasticSupervisor(_Job, mgr, world=world,
+                            pod=EmulatedPod(world.ranks), elastic=True,
+                            min_world=1, scaling="linear")
+    handle = sup.run(STEPS_A, checkpoint_every=1)
+    faultline.clear()
+    mgr.close()
+
+    got = handle.params_np()
+    recovered = (reg.get_sample_value(
+        "mxtpu_faults_recovered_total", labels) or 0) - rec0
+    reshards = (reg.get_sample_value(
+        "mxtpu_elastic_reshards_total") or 0) - res0
+    sup.close()
+    return {
+        "preempt_bitwise": all(
+            got[k].tobytes() == want[k].tobytes() for k in want),
+        "preempt_recovered_2": recovered == 2,
+        "preempt_no_reshard": reshards == 0,
+    }, {"preempts_recovered": recovered}
+
+
+def _phase_dead_node(root):
+    """Permanent host kill: re-shard 3 -> 2 and keep training."""
+    faultline.clear()
+    world = ElasticWorld.fresh(HOSTS)
+    pod = EmulatedPod(world.ranks)
+    # one kvstore.kv arrival per live rank per liveness poll (one poll
+    # per step): rank 1's stamp goes stale on poll KILL_POLL; the
+    # two-observation rule declares it dead one poll later
+    faultline.plan([{"site": "kvstore.kv", "kind": "dead_node",
+                     "rank": 1, "at": HOSTS * (KILL_POLL - 1) + 2}])
+
+    reg = telemetry.default_registry()
+    labels = {"site": "kvstore.kv", "kind": "dead_node"}
+    rec0 = reg.get_sample_value(
+        "mxtpu_faults_recovered_total", labels) or 0
+    res0 = reg.get_sample_value("mxtpu_elastic_reshards_total") or 0
+
+    times = []  # shared across job incarnations
+
+    def build(w):
+        job = _Job(w)
+        job.step_seconds = times
+        return job
+
+    mgr = CheckpointManager(os.path.join(root, "dead"),
+                            async_write=False, rank=0)
+    sup = ElasticSupervisor(build, mgr, world=world, pod=pod,
+                            elastic=True, min_world=2, scaling="linear")
+    handle = sup.run(STEPS_B, checkpoint_every=1)
+    faultline.clear()
+    mgr.close()
+
+    reshards = (reg.get_sample_value(
+        "mxtpu_elastic_reshards_total") or 0) - res0
+    recovered = (reg.get_sample_value(
+        "mxtpu_faults_recovered_total", labels) or 0) - rec0
+    world_size = reg.get_sample_value("mxtpu_elastic_world_size")
+
+    # per-host throughput: pre-fault steady median vs the last
+    # RECOVER_WINDOW post-reshard steps (both in seconds per step; one
+    # step is one global batch, per-host batch constant)
+    pre = [dt for _t, dt, size in times if size == HOSTS][WARMUP:]
+    post = [dt for _t, dt, size in times if size == HOSTS - 1]
+    post = post[-RECOVER_WINDOW:]
+    ratio = (statistics.median(pre) / statistics.median(post)
+             if pre and post else 0.0)
+
+    finite = all(onp.isfinite(a).all()
+                 for a in handle.params_np().values())
+    lr = float(handle.trainer.learning_rate)
+    want_lr = BASE_LR * (HOSTS - 1) / HOSTS
+    sup.close()
+    checks = {
+        "resharded_once": reshards == 1,
+        "dead_node_recovered": recovered >= 1,
+        "survivor_world": sup.world.ranks == (0, 2),
+        "world_gauge": world_size == HOSTS - 1,
+        "lr_linear_rule": abs(lr - want_lr) < 1e-12,
+        "params_finite": finite,
+        "throughput_recovered": ratio >= THROUGHPUT_FLOOR,
+    }
+    extra = {"reshards": reshards, "throughput_ratio": ratio, "lr": lr,
+             "post_steps": len(post)}
+    return checks, extra
+
+
+def run_endure(root):
+    t0 = time.perf_counter()
+    checks_a, extra_a = _phase_preempt(root)
+    checks_b, extra_b = _phase_dead_node(root)
+    checks = dict(checks_a, **checks_b)
+    ok = all(checks.values())
+    wall = time.perf_counter() - t0
+    fail_bits = "" if ok else " FAILED: " + ",".join(
+        k for k, v in checks.items() if not v)
+    verdict = (
+        f"endure_verdict: {'PASS' if ok else 'FAIL'} — "
+        f"preempts recovered={extra_a['preempts_recovered']:.0f}/2 "
+        f"bitwise={'yes' if checks['preempt_bitwise'] else 'NO'}, "
+        f"reshards={extra_b['reshards']:.0f} (3->2 on dead rank 1), "
+        f"lr={extra_b['lr']:.4g} (linear rule), per-host throughput "
+        f"{extra_b['throughput_ratio']:.2f}x pre-fault over last "
+        f"{extra_b['post_steps']} steps (floor {THROUGHPUT_FLOOR}), "
+        f"wall={wall:.1f}s{fail_bits}")
+    summary = dict(checks, **extra_a, **extra_b, wall=wall)
+    return verdict, ok, summary
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--gate", action="store_true",
+                    help="exit nonzero when the gate fails")
+    ap.add_argument("--root", default=None,
+                    help="checkpoint scratch dir (default: a tempdir)")
+    args = ap.parse_args(argv)
+    import tempfile
+    if args.root:
+        verdict, ok, _ = run_endure(args.root)
+    else:
+        with tempfile.TemporaryDirectory(prefix="mxtpu-endure-") as root:
+            verdict, ok, _ = run_endure(root)
+    print(verdict)
+    return 1 if (args.gate and not ok) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
