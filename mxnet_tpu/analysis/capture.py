@@ -245,6 +245,74 @@ def _capture_allreduce_blockwise(qtype):
               "block": DEFAULT_QBLOCK, "devices": 8})
 
 
+@_entrypoint("allreduce.bucket_dense_integrity")
+def _capture_allreduce_dense_integrity():
+    """The DECLARED integrity-mode variant of `allreduce.bucket_dense`
+    (``MXNET_KVSTORE_INTEGRITY=1``): the same bucket psum plus the
+    in-program digest sideband — a pmax over the packed ``[d, -d]``
+    digest pair (max and min agreement in ONE collective) riding the
+    SAME launch.  Pinned at 2 all-reduce ops so integrity mode is a
+    contract variant, not a launch-count violation; the default dense
+    contract above stays at 1."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+
+    from mxnet_tpu.kvstore.tpu_ici import _allreduce_fn
+
+    devices = _ici_devices()
+    shape = (16384,)
+    allreduce, sharding, _mesh = _allreduce_fn(
+        devices, shape, onp.dtype(onp.float32), True)
+    spec = jax.ShapeDtypeStruct((len(devices),) + shape, jnp.float32,
+                                sharding=sharding)
+    flip = jax.ShapeDtypeStruct((len(devices), 1), jnp.float32,
+                                sharding=sharding)
+    return _capture_jit(
+        allreduce, (spec, flip), "allreduce.bucket_dense_integrity",
+        "allreduce",
+        contract={
+            # payload psum + digest-agreement pmax, one launch
+            "expected_collectives": {"all-reduce": 2},
+            "resharding_free": True,
+        },
+        meta={"shape": list(shape), "dtype": "float32", "devices": 8,
+              "mode": "integrity"})
+
+
+@_entrypoint("allreduce.bucket_int8_integrity")
+def _capture_allreduce_int8_integrity():
+    """The DECLARED integrity-mode variant of `allreduce.bucket_int8`:
+    scale-agreement pmax + payload psum + digest-agreement pmax, all in
+    the one fused launch — 3 all-reduce ops pinned (the default
+    blockwise contract stays at 2)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.kvstore.tpu_ici import (DEFAULT_QBLOCK,
+                                           _blockwise_allreduce_fn)
+
+    devices = _ici_devices()
+    numel = 16384
+    allreduce, sharding, _mesh = _blockwise_allreduce_fn(
+        devices, numel, "float32", "int8", DEFAULT_QBLOCK, True)
+    spec = jax.ShapeDtypeStruct((len(devices), numel), jnp.float32,
+                                sharding=sharding)
+    tok_spec = jax.ShapeDtypeStruct((len(devices), 1), jnp.float32,
+                                    sharding=sharding)
+    return _capture_jit(
+        allreduce, (spec, spec, tok_spec, tok_spec),
+        "allreduce.bucket_int8_integrity", "allreduce",
+        contract={
+            # pmax (scales) + psum (payload) + pmax (digest), one launch
+            "expected_collectives": {"all-reduce": 3},
+            "resharding_free": True,
+        },
+        meta={"numel": numel, "dtype": "float32->int8->int16",
+              "block": DEFAULT_QBLOCK, "devices": 8,
+              "mode": "integrity"})
+
+
 @_entrypoint("allreduce.bucket_int8")
 def _capture_allreduce_int8():
     """Block-scaled int8 bucket reduce (see

@@ -139,6 +139,34 @@ MXNET_ELASTIC_SCALING        batch/lr scaling rule across a world-size
                              step size changed).  Read at supervisor
                              creation; the applied rule is always
                              logged, never silent
+MXNET_SENTINEL_SLOW_FACTOR   straggler-demotion threshold for
+                             ``resilience.sentinel.StragglerPolicy``: a
+                             rank whose step-time EMA exceeds factor x
+                             the pod median for M consecutive
+                             observations is declared DEGRADED and
+                             resharded away exactly like a dead node
+                             (default 3.0; read when a policy is
+                             created)
+MXNET_SENTINEL_LOSS_FACTOR   divergence-rollback threshold for
+                             ``resilience.sentinel.DivergenceSentinel``:
+                             a synced loss above factor x the warmed-up
+                             EMA (or non-finite) trips an automatic
+                             rollback to the newest complete checkpoint
+                             (default 10.0; read when a sentinel is
+                             created)
+MXNET_SENTINEL_ROLLBACKS     divergence rollbacks the supervisor takes
+                             before surfacing ``DivergenceError``
+                             (default 2; read at supervisor creation)
+MXNET_KVSTORE_INTEGRITY      ``1`` turns on the allreduce integrity
+                             sideband: a per-device digest of each
+                             bucket's psum result is agreement-checked
+                             in-program (pmax-vs-pmin, same launch);
+                             disagreement ticks
+                             ``mxtpu_integrity_violations_total`` and
+                             the step-guard skips the update so a
+                             flipped bit never reaches the optimizer
+                             (default 0; read when a store's bucketer
+                             is created)
 =========================== =================================================
 """
 from __future__ import annotations
@@ -148,7 +176,9 @@ import os
 __all__ = ["apply", "describe", "is_naive_engine", "cpu_worker_nthreads",
            "decode_threads", "prefetch_depth", "io_error_tolerance",
            "serve_replicas", "serve_deadline_ms", "serve_eject_after",
-           "elastic_enabled", "elastic_min_world", "elastic_scaling"]
+           "elastic_enabled", "elastic_min_world", "elastic_scaling",
+           "sentinel_slow_factor", "sentinel_loss_factor",
+           "sentinel_rollbacks", "kvstore_integrity"]
 
 _naive_engine = False
 
@@ -240,6 +270,42 @@ def elastic_scaling(default="linear"):
     return v
 
 
+def sentinel_slow_factor(default=3.0):
+    """Straggler-demotion threshold: step-time EMA over pod-median
+    ratio above which a rank is suspected (see StragglerPolicy)."""
+    v = os.environ.get("MXNET_SENTINEL_SLOW_FACTOR")
+    if v is None:
+        return default
+    return max(1.0, float(v))
+
+
+def sentinel_loss_factor(default=10.0):
+    """Divergence threshold: loss over warmed-up EMA ratio above which
+    the DivergenceSentinel trips an auto-rollback."""
+    v = os.environ.get("MXNET_SENTINEL_LOSS_FACTOR")
+    if v is None:
+        return default
+    return max(1.0, float(v))
+
+
+def sentinel_rollbacks(default=2):
+    """Divergence rollbacks the supervisor takes before surfacing
+    ``DivergenceError``."""
+    v = os.environ.get("MXNET_SENTINEL_ROLLBACKS")
+    if v is None:
+        return default
+    return max(0, int(v))
+
+
+def kvstore_integrity(default=False):
+    """Whether the bucketed allreduce runs the in-program integrity
+    sideband (digest agreement check inside the same launch)."""
+    v = os.environ.get("MXNET_KVSTORE_INTEGRITY")
+    if v is None:
+        return default
+    return v not in ("0", "")
+
+
 def apply():
     """Read the environment once at package import."""
     global _naive_engine
@@ -293,5 +359,7 @@ def describe():
              "MXNET_PREFETCH_DEPTH", "MXNET_IO_ERROR_TOLERANCE",
              "MXNET_SERVE_REPLICAS", "MXNET_SERVE_DEADLINE_MS",
              "MXNET_SERVE_EJECT_AFTER", "MXNET_ELASTIC",
-             "MXNET_ELASTIC_MIN_WORLD", "MXNET_ELASTIC_SCALING"]
+             "MXNET_ELASTIC_MIN_WORLD", "MXNET_ELASTIC_SCALING",
+             "MXNET_SENTINEL_SLOW_FACTOR", "MXNET_SENTINEL_LOSS_FACTOR",
+             "MXNET_SENTINEL_ROLLBACKS", "MXNET_KVSTORE_INTEGRITY"]
     return [(n, os.environ.get(n), n in __doc__) for n in names]

@@ -156,6 +156,19 @@ class Trainer:
         _telemetry.mark_step()
         with _telemetry.step_phase("allreduce"):
             self._allreduce_grads()
+        # integrity step-guard (MXNET_KVSTORE_INTEGRITY=1): the digest
+        # sideband flagged a corrupted bucket reduction — the reduced
+        # grads are poisoned, so skip the update (params/states bitwise
+        # untouched) exactly like a non-finite step.  The violation
+        # counter was already ticked inside consume_integrity.
+        consume = getattr(self._kvstore, "consume_integrity_violations",
+                          None) if self._kvstore is not None else None
+        if consume is not None and consume() > 0:
+            from ..resilience import faultline as _faultline
+            from ..resilience.policies import step_skip_counter
+            step_skip_counter().inc()
+            _faultline.recovered("collective.dispatch", "bitflip")
+            return
         # finite-grad step-guard (eager path): when amp attached a loss
         # scaler, consult it BEFORE the update — a poisoned step skips
         # the optimizer entirely (params/states untouched) and only backs

@@ -173,14 +173,33 @@ class GradBucketer:
     a previous device set is never applied).
 
     Env knobs are read when the bucketer is constructed:
-    ``MXNET_KVSTORE_BUCKET_BYTES`` (cap) — constructor args override.
+    ``MXNET_KVSTORE_BUCKET_BYTES`` (cap) and
+    ``MXNET_KVSTORE_INTEGRITY`` (digest sideband) — constructor args
+    override.
+
+    Integrity mode (``integrity=True``) threads the in-program digest
+    agreement check (`tpu_ici._integrity_sideband`) through the dense
+    and block-scaled RING paths — the paths where a payload actually
+    crosses the interconnect.  The 2-bit wire format and the same-device
+    fallback keep their default programs: 2bit's int32 level sum has no
+    flat-f32 result to digest in place, and the fallback never leaves
+    one device.  Violations accumulate as in-program (1, 1) flags and
+    are host-synced ONCE per step by :meth:`consume_integrity` (the
+    trainer's step-guard) — integrity mode's only host round-trip.
     """
 
-    def __init__(self, bucket_bytes=None, quantum_bytes=None):
+    def __init__(self, bucket_bytes=None, quantum_bytes=None,
+                 integrity=None):
+        from .. import env as _env
+
         self.bucket_bytes = int(bucket_bytes) if bucket_bytes is not None \
             else globals()["bucket_bytes"]()
         self.quantum_bytes = int(quantum_bytes) if quantum_bytes is not None \
             else DEFAULT_QUANTUM_BYTES
+        self.integrity = _env.kvstore_integrity() if integrity is None \
+            else bool(integrity)
+        self._violations = []  # in-program (1, 1) violation flags, unsynced
+        self._flip_zeros = {}  # device-ring -> cached all-zeros flip input
         self._plans = {}      # signature -> list[_Bucket]
         self._residuals = {}  # (signature, bucket_idx, copy_idx) -> jax.Array
         self._pending_residuals = {}  # checkpoint-restored, pre-adoption
@@ -346,17 +365,72 @@ class GradBucketer:
                       for j, lvl in enumerate(levels)]
         else:
             allreduce, sharding, _mesh = _allreduce_fn(
-                devs, shape, str(dtype))
+                devs, shape, str(dtype), self.integrity)
             pieces = [jax.device_put(a.reshape((1,) + shape), devs[j])
                       for j, a in enumerate(arrs)]
         stacked = jax.make_array_from_single_device_arrays(
             (n,) + shape, sharding, pieces)
-        summed = self._dispatch_allreduce(devs, allreduce, stacked)
+        flip = self._flip_input(devs, sharding) \
+            if self.integrity and compression is None else None
+        summed = self._dispatch_allreduce(devs, allreduce, stacked, flip)
         by_dev = {s.device: s.data for s in summed.addressable_shards}
         for j, v in enumerate(vals):
             NDArray(by_dev[devs[j]].reshape(shape), ctx=v.ctx).copyto(v)
 
-    def _dispatch_allreduce(self, devices, allreduce, stacked):
+    def _flip_input(self, devs, sharding):
+        """The (n_dev, 1) flip input for an integrity-mode launch.
+        Clean steady state returns a cached all-zeros array (the flip is
+        then a bitwise no-op inside the program — see
+        `tpu_ici._integrity_sideband`); when a ``bitflip`` chaos plan has
+        an arrival due at ``collective.dispatch``, ONE device's shard
+        instead carries a seeded magnitude, emulating a payload bit
+        flipped in flight on that device's ring hop."""
+        from ..resilience import faultline as _faultline
+        from .tpu_ici import _fresh_chain_token
+
+        info = _faultline.poll_payload("collective.dispatch")
+        if info is None:
+            flip = self._flip_zeros.get(devs)
+            if flip is None:
+                flip = self._flip_zeros[devs] = \
+                    _fresh_chain_token(devs, sharding)
+            return flip
+        import random as _random
+
+        n = len(devs)
+        # string seed -> deterministic sha512 path, never process-salted
+        rng = _random.Random(f"bitflip:{int(info['seed'])}")
+        mag = rng.uniform(1.0, 2.0) * (2.0 ** rng.randrange(0, 16))
+        rank = info.get("rank")
+        dev_idx = (int(rank) if rank is not None else rng.randrange(n)) % n
+        pieces = [
+            jax.device_put(
+                onp.full((1, 1), mag if j == dev_idx else 0.0, onp.float32),
+                devs[j])
+            for j in range(n)]
+        return jax.make_array_from_single_device_arrays(
+            (n, 1), sharding, pieces)
+
+    def consume_integrity(self):
+        """Host-sync every integrity flag accumulated since the last
+        call and return how many launches disagreed (0 in integrity-off
+        mode or a clean step).  A nonzero count ticks
+        ``mxtpu_integrity_violations_total{site="collective.dispatch"}``
+        — the trainer's step-guard calls this once per step and skips
+        the optimizer update when it fires, so the corrupted reduction
+        never reaches the parameters."""
+        if not self._violations:
+            return 0
+        pending, self._violations = self._violations, []
+        count = sum(1 for v in pending if onp.asarray(v).any())
+        if count:
+            from ..resilience import sentinel as _sentinel
+
+            _sentinel.integrity_violations_counter().labels(
+                site="collective.dispatch").inc(count)
+        return count
+
+    def _dispatch_allreduce(self, devices, allreduce, stacked, flip=None):
         """Dispatch one bucket's psum.  On the host-CPU platform at most
         ONE collective stays in flight: the emulated all-reduce deadlocks
         when several independent rendezvous share one thread pool (XLA
@@ -375,12 +449,17 @@ class GradBucketer:
         # launch — break the chains so the next blockwise dispatch
         # re-fences and re-seeds instead of overlapping with this psum
         self._chain_tokens.clear()
-        summed = allreduce(stacked)
+        if flip is None:
+            summed = allreduce(stacked)
+        else:
+            summed, viol = allreduce(stacked, flip)
+            self._violations.append(viol)
         if on_cpu:
             self._inflight = summed
         return summed
 
-    def _dispatch_blockwise(self, devices, sharding, allreduce, gs, rs):
+    def _dispatch_blockwise(self, devices, sharding, allreduce, gs, rs,
+                            flip=None):
         """Dispatch one bucket's fused block-scaled launch, ordered by
         the launch-chain token instead of the host fence: every device's
         sub-execution of launch i+1 consumes the (1, 1) token shard that
@@ -413,7 +492,11 @@ class GradBucketer:
             # loses to the fence: queued buffers and pack programs
             # contend with the draining chain for the same cores)
             jax.block_until_ready(older)
-        summed, new_res, tok_out = allreduce(gs, rs, tok)
+        if flip is None:
+            summed, new_res, tok_out = allreduce(gs, rs, tok)
+        else:
+            summed, new_res, tok_out, viol = allreduce(gs, rs, tok, flip)
+            self._violations.append(viol)
         self._chain_tokens[devices] = (tok, tok_out)
         if on_cpu:
             self._inflight = summed
@@ -443,12 +526,14 @@ class GradBucketer:
                       for j, lvl in enumerate(levels)]
         else:
             allreduce, sharding, _mesh = _allreduce_fn(
-                devs, (cap,), str(b.dtype))
+                devs, (cap,), str(b.dtype), self.integrity)
             pieces = [jax.device_put(flat.reshape((1, cap)), devs[j])
                       for j, flat in enumerate(packed)]
         stacked = jax.make_array_from_single_device_arrays(
             (n, cap), sharding, pieces)
-        summed = self._dispatch_allreduce(devs, allreduce, stacked)
+        flip = self._flip_input(devs, sharding) \
+            if self.integrity and compression is None else None
+        summed = self._dispatch_allreduce(devs, allreduce, stacked, flip)
         by_dev = {s.device: s.data for s in summed.addressable_shards}
         return [by_dev[devs[j]].reshape((cap,)) for j in range(n)]
 
@@ -463,7 +548,7 @@ class GradBucketer:
         n = len(packed)
         allreduce, sharding, _mesh = _blockwise_allreduce_fn(
             devs, cap, str(dtype), compression["type"],
-            compression["block"])
+            compression["block"], self.integrity)
         gs = jax.make_array_from_single_device_arrays(
             (n, cap), sharding,
             [jax.device_put(f.reshape(1, cap), devs[j])
@@ -472,8 +557,9 @@ class GradBucketer:
             (n, cap), sharding,
             [self._residual_shard(sig, bidx, j, packed[j], devs[j], cap,
                                   dtype) for j in range(n)])
+        flip = self._flip_input(devs, sharding) if self.integrity else None
         summed, new_res = self._dispatch_blockwise(devs, sharding,
-                                                   allreduce, gs, rs)
+                                                   allreduce, gs, rs, flip)
         # store the NEW residuals as the raw (1, capacity) device shards:
         # next step reinjects them with zero host-side staging (no
         # reshape, no device_put) — export_residuals flattens at

@@ -66,8 +66,37 @@ def _value_devices(vals):
     return devs
 
 
+def _integrity_sideband(total, f, axis="dev"):
+    """The in-program integrity check (``MXNET_KVSTORE_INTEGRITY=1``):
+    consume this device's (1, 1) ``f`` flip shard (0.0 = clean; a chaos
+    plan puts a seeded magnitude on ONE device to emulate a payload bit
+    flipped in flight) and agreement-check a cheap per-device digest of
+    the reduced result — the same shard_map-sideband shape as the
+    blockwise scale-agreement pmax, inside the SAME launch.
+
+    The flip applies via ``where(f != 0, x + f, x)`` on element 0, a
+    bitwise no-op when clean (``.add(f)`` would not be: -0.0 + 0.0 is
+    +0.0).  The digest is the wrapping int32 sum of the result's f32
+    bit pattern — bit-exact agreement across devices unless some
+    device's copy of the "same" allreduce result differs.  Agreement
+    rides ONE packed collective: ``pmax([d, -d])`` gives (max, -min),
+    so ``max != min`` — some device disagreeing — is a single compare.
+    Returns ``(result, violation (1, 1) int32)``."""
+    flat = total.reshape(-1)
+    first = jnp.where(f[0, 0] != 0.0,
+                      flat[0] + f[0, 0].astype(flat.dtype), flat[0])
+    total = flat.at[0].set(first).reshape(total.shape)
+    with jax.named_scope("integrity"):
+        bits = jax.lax.bitcast_convert_type(  # mxlint: disable=bits-as-float -- f32 -> int32 one way; the bits land in an integer array and stay integer (wrapping sum, pmax, compare) — no float op ever touches a reinterpreted pattern
+            total.reshape(-1).astype(jnp.float32), jnp.int32)
+        d = jnp.sum(bits, dtype=jnp.int32)
+        m = jax.lax.pmax(jnp.stack([d, -d]), axis)
+        viol = (m[0] != -m[1]).astype(jnp.int32).reshape(1, 1)
+    return total, viol
+
+
 @functools.lru_cache(maxsize=None)
-def _allreduce_fn(devices, shape, dtype):
+def _allreduce_fn(devices, shape, dtype, integrity=False):
     """Compile a sum-allreduce over a 1-d mesh of ``devices`` (the
     devices the copies live on, one each).
 
@@ -75,11 +104,29 @@ def _allreduce_fn(devices, shape, dtype):
     ``shard_map`` + ``psum`` makes XLA emit a ring all-reduce over ICI,
     and the output keeps the same sharding — every device holds the sum
     locally, so writing back to the per-device copies is transfer-free.
+
+    ``integrity=True`` compiles the sideband variant: an extra
+    (n_dev, 1) flip input and a (n_dev, 1) int32 violation output ride
+    the same launch (`_integrity_sideband`) — 2 all-reduce ops in the
+    HLO (payload psum + digest pmax), still one launch per bucket.
     """
     from .._compat import shard_map
 
     mesh = Mesh(onp.asarray(devices), ("dev",))
     sharding = NamedSharding(mesh, P("dev"))
+
+    if integrity:
+        def local(x, f):
+            total = jax.lax.psum(x, "dev")
+            return _integrity_sideband(total, f)
+
+        reduce_local = shard_map(
+            local, mesh, in_specs=(P("dev"), P("dev")),
+            out_specs=(P("dev"), P("dev")))
+        allreduce = jax.jit(reduce_local,
+                            in_shardings=(sharding, sharding),
+                            out_shardings=(sharding, sharding))
+        return allreduce, sharding, mesh
 
     reduce_local = shard_map(
         lambda x: jax.lax.psum(x, "dev"), mesh,
@@ -282,7 +329,8 @@ def _blockwise_shard_body(numel, out_dtype, qtype, block, n_dev,
 
 
 @functools.lru_cache(maxsize=None)
-def _blockwise_allreduce_fn(devices, numel, dtype, qtype, block):
+def _blockwise_allreduce_fn(devices, numel, dtype, qtype, block,
+                            integrity=False):
     """Compile the fused block-scaled quantized all-reduce: ONE launch
     per bucket doing quantize -> scale-agreement pmax -> payload psum ->
     dequantize -> residual update (`_blockwise_shard_body` is the math).
@@ -291,13 +339,30 @@ def _blockwise_allreduce_fn(devices, numel, dtype, qtype, block):
     shard per device; outputs are the dequantized SUM and the new
     error-feedback residual with the same sharding — every device holds
     its own reduced shard, so write-back is transfer-free (the exact
-    `_allreduce_fn` shape)."""
+    `_allreduce_fn` shape).
+
+    ``integrity=True`` appends the `_integrity_sideband` to the same
+    launch: a 4th (n_dev, 1) flip input, a 4th (n_dev, 1) int32
+    violation output, and a 3rd all-reduce op in the HLO (scale pmax +
+    payload psum + digest pmax — the declared integrity-mode
+    contract)."""
     from .._compat import shard_map
 
     mesh = Mesh(onp.asarray(devices), ("dev",))
     sharding = NamedSharding(mesh, P("dev"))
     body = _blockwise_shard_body(numel, onp.dtype(dtype), qtype, block,
                                  len(devices))
+    if integrity:
+        def body_i(g, res, tok, f):
+            out, new_res, tok_out = body(g, res, tok)
+            out, viol = _integrity_sideband(out, f)
+            return out, new_res, tok_out, viol
+
+        fn = shard_map(body_i, mesh,
+                       in_specs=(P("dev"),) * 4, out_specs=(P("dev"),) * 4)
+        allreduce = jax.jit(fn, in_shardings=(sharding,) * 4,
+                            out_shardings=(sharding,) * 4)
+        return allreduce, sharding, mesh
     fn = shard_map(body, mesh, in_specs=(P("dev"), P("dev"), P("dev")),
                    out_specs=(P("dev"), P("dev"), P("dev")))
     allreduce = jax.jit(fn, in_shardings=(sharding, sharding, sharding),
@@ -421,10 +486,16 @@ class TPUICIStore(KVStoreBase):
             return client.blocking_key_value_get(key, 200)  # ms
 
         try:
-            return retry_transient(attempt, site="kvstore.kv")
+            out = retry_transient(attempt, site="kvstore.kv")
         # mxlint: disable=swallowed-exception -- absent-key probes are the normal case on the pinned jax line (blocking get raises NOT_FOUND); after the transient retry budget, unreachable and absent both mean "no stamp"
         except Exception:
             return None
+        if isinstance(out, str):
+            # payload channel: a planned `bitflip` corrupts the stamp in
+            # flight — a forged heartbeat then reads stale (ValueError in
+            # get_dead_nodes), a forged steptime is dropped by the reader
+            out = _faultline.corrupt("kvstore.kv", out)
+        return out
 
     def _start_heartbeat(self):
         import os
@@ -510,6 +581,60 @@ class TPUICIStore(KVStoreBase):
             if n >= 2:
                 dead.append(r)
         return dead
+
+    # -- step-time stamps (straggler detection) -----------------------------
+    # The sentinel's StragglerPolicy needs every rank's per-step wall
+    # time; each rank stamps its own next to its heartbeat in the same
+    # coordination KV.  Writes are delete+set like the heartbeat (the
+    # pinned jax line's KV is write-once per key).
+
+    def record_steptime(self, seconds):
+        """Stamp this rank's last step wall time (``mxtpu/steptime/<rank>``)
+        for the pod's straggler policy to read.  Best-effort: a rank that
+        cannot stamp looks like a rank with no stamp, which the policy
+        skips (liveness is the heartbeat's job, not this stamp's)."""
+        client = self._kv_client()
+        if client is None:
+            return
+        key = f"mxtpu/steptime/{self._rank}"
+        try:
+            try:
+                client.key_value_delete(key)
+            # mxlint: disable=swallowed-exception -- pre-set delete is advisory (first stamp has nothing to delete); the set below is the operation that matters
+            except Exception:
+                pass
+            client.key_value_set(key, repr(float(seconds)))
+        # mxlint: disable=swallowed-exception -- best-effort stamp: a coordinator hiccup must not fail the training step that just completed; the policy tolerates a missing window
+        except Exception:
+            pass
+
+    def read_steptimes(self):
+        """Every rank's last stamped step time, ``{rank: seconds}`` —
+        ranks with no (or unparseable) stamp are absent.  Fed to
+        ``sentinel.StragglerPolicy.observe`` at the liveness cadence."""
+        client = self._kv_client()
+        if client is None or self._size <= 1:
+            return {}
+        out = {}
+        for r in range(self._size):
+            stamp = self._kv_try_get(client, f"mxtpu/steptime/{r}")
+            if stamp is None:
+                continue
+            try:
+                out[r] = float(stamp)
+            except ValueError:
+                continue  # corrupt stamp: treated as absent, never 0.0
+        return out
+
+    def consume_integrity_violations(self):
+        """Host-sync and return the bucketer's accumulated integrity
+        flags (``GradBucketer.consume_integrity``) — 0 when bucketing
+        never ran or integrity mode is off.  The trainer's step-guard
+        calls this once per step to decide whether to suppress the
+        optimizer update."""
+        if self._bucketer is None:
+            return 0
+        return self._bucketer.consume_integrity()
 
     def close(self):
         """Stop AND reap the heartbeat thread.  Setting the event alone

@@ -17,8 +17,8 @@ import threading
 import jax
 import jax.numpy as jnp
 
-__all__ = ["seed", "new_key", "key_stream_scope", "uniform", "normal",
-           "randint", "host_rng"]
+__all__ = ["seed", "new_key", "advance", "key_stream_scope", "uniform",
+           "normal", "randint", "host_rng"]
 
 
 class _KeyState(threading.local):
@@ -94,6 +94,17 @@ def new_key():
         return _state.stack[-1].next()
     _state.counter += 1
     return jax.random.fold_in(_state.root, _state.counter)
+
+
+def advance(n):
+    """Skip the global stream forward by ``n`` draws without dispatching
+    anything — the next `new_key()` returns what the (n+1)-th call would
+    have.  The divergence auto-rollback uses this: after restoring a
+    checkpoint the supervisor jumps the stream PAST the poisoned window,
+    so the re-run samples a different trajectory instead of
+    deterministically reproducing the spike (checkpoint restore already
+    put root/counter back to the snapshot values)."""
+    _state.counter += int(n)
 
 
 def root_and_counter():

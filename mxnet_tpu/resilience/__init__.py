@@ -32,29 +32,45 @@ recovery path:
   kvstore/bucketer/readers, checkpoint restored with ``reshard=True``
   (residual debt re-bucketed, never dropped) and an explicit, logged
   batch/lr scaling rule.
+* :mod:`~mxnet_tpu.resilience.sentinel` — the GRAY-failure layer above
+  the crash-stop machinery: straggler demotion
+  (:class:`~mxnet_tpu.resilience.sentinel.StragglerPolicy` →
+  ``DegradedNodeError``, resharded like a death), the allreduce
+  integrity sideband's violation counter
+  (``MXNET_KVSTORE_INTEGRITY=1``), and divergence auto-rollback
+  (:class:`~mxnet_tpu.resilience.sentinel.DivergenceSentinel`, bounded
+  by ``MXNET_SENTINEL_ROLLBACKS``).  The matching injectable kinds —
+  ``slow`` / ``flaky`` / ``bitflip`` — live in faultline.
 
 See docs/RESILIENCE.md for the fault model and the recovery matrix.
 """
 from __future__ import annotations
 
-from . import elastic, faultline
+from . import elastic, faultline, sentinel
 from .checkpoint import (CheckpointCorrupt, CheckpointManager,
                          CheckpointTopologyError, complete_steps,
                          gather_training_state, load_checkpoint,
                          restore_training_state, save_checkpoint)
 from .elastic import ElasticSupervisor, ElasticWorld, EmulatedPod, scaled_lr
-from .faultline import (InjectedError, InjectedFault, InjectedPreemption,
-                        InjectedTimeout)
+from .faultline import (InjectedError, InjectedFault, InjectedFlaky,
+                        InjectedPreemption, InjectedTimeout)
 from .policies import (DeadNodeError, TRANSIENT_EXCEPTIONS,
-                       abort_to_checkpoint, check_peers, retry_transient)
+                       abort_to_checkpoint, backoff_delay, check_peers,
+                       fault_kind, retry_transient)
+from .sentinel import (DegradedNodeError, DivergenceError,
+                       DivergenceSentinel, StragglerPolicy)
 
 __all__ = [
-    "faultline", "elastic",
+    "faultline", "elastic", "sentinel",
     "InjectedFault", "InjectedTimeout", "InjectedError", "InjectedPreemption",
+    "InjectedFlaky",
     "CheckpointManager", "CheckpointCorrupt", "CheckpointTopologyError",
     "save_checkpoint", "load_checkpoint", "complete_steps",
     "gather_training_state", "restore_training_state",
     "ElasticSupervisor", "ElasticWorld", "EmulatedPod", "scaled_lr",
     "retry_transient", "abort_to_checkpoint", "check_peers",
+    "backoff_delay", "fault_kind",
     "DeadNodeError", "TRANSIENT_EXCEPTIONS",
+    "DegradedNodeError", "DivergenceError",
+    "StragglerPolicy", "DivergenceSentinel",
 ]

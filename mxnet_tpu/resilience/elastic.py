@@ -50,12 +50,16 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 
 from .. import env as _env
 from .. import telemetry as _telemetry
 from . import checkpoint as _checkpoint
 from . import faultline
-from .policies import DeadNodeError, check_peers
+from .policies import DeadNodeError, abort_to_checkpoint, check_peers
+from .sentinel import (DegradedNodeError, DivergenceError,
+                       DivergenceSentinel, StragglerPolicy,
+                       rollbacks_counter)
 
 __all__ = ["ElasticWorld", "ElasticSupervisor", "EmulatedPod",
            "scaled_lr", "rederive_reader", "SCALING_RULES"]
@@ -169,6 +173,7 @@ class EmulatedPod:
     def __init__(self, ranks):
         self.ranks = tuple(sorted(int(r) for r in ranks))
         self._stale_counts = {}
+        self._steptimes = {}
 
     def shrink(self, survivors):
         """Forget dead ranks after a re-shard: only survivors are
@@ -177,6 +182,26 @@ class EmulatedPod:
         for r in list(self._stale_counts):
             if r not in self.ranks:
                 self._stale_counts.pop(r)
+        for r in list(self._steptimes):
+            if r not in self.ranks:
+                self._steptimes.pop(r)
+
+    def record_steptime(self, seconds, rank=None):
+        """Stamp a step wall time — the emulated analogue of
+        ``TPUICIStore.record_steptime``.  ``rank=None`` stamps every
+        live rank (one process stands in for the whole pod; the
+        supervisor's own timing applies to all of them), a gray-failure
+        scenario stamps per rank to build a straggler."""
+        seconds = float(seconds)
+        for r in (self.ranks if rank is None else (int(rank),)):
+            if r in self.ranks:
+                self._steptimes[r] = seconds
+
+    def read_steptimes(self):
+        """``{rank: seconds}`` of the last stamps — same contract as
+        ``TPUICIStore.read_steptimes`` (ranks never stamped are
+        absent)."""
+        return dict(self._steptimes)
 
     def get_dead_nodes(self, timeout=60):
         """Same contract as ``TPUICIStore.get_dead_nodes`` (``timeout``
@@ -235,10 +260,25 @@ class ElasticSupervisor:
 
     def __init__(self, build, manager, *, world=None, pod=None,
                  elastic=None, min_world=None, scaling=None,
-                 check_every=1, liveness_timeout=60):
+                 check_every=1, liveness_timeout=60,
+                 straggler=None, divergence=None):
         self._build = build
         self._manager = manager
         self._pod = pod
+        # gray-failure sentinels (resilience.sentinel): straggler
+        # demotion needs a pod that stamps step times; divergence
+        # watching is free (it only sees the loss run_step returns, and
+        # a handle that returns None opts out implicitly).  Pass False
+        # to disable either explicitly.
+        if straggler is None:
+            straggler = (StragglerPolicy()
+                         if hasattr(pod, "read_steptimes") else False)
+        self._straggler = straggler or None
+        if divergence is None:
+            divergence = DivergenceSentinel()
+        self._divergence = divergence or None
+        self._rollbacks = 0
+        self._rollback_budget = _env.sentinel_rollbacks()
         if world is None:
             ranks = getattr(pod, "ranks", None)
             world = (ElasticWorld(tuple(ranks), len(tuple(ranks)))
@@ -337,7 +377,16 @@ class ElasticSupervisor:
                 if self._pod is not None and t % self._check_every == 0:
                     check_peers(self._pod, self._manager,
                                 timeout=self._liveness_timeout)
-                handle.run_step(t)
+                    self._check_stragglers()
+                started = time.monotonic()
+                loss = handle.run_step(t)
+                self._stamp_steptime(handle, time.monotonic() - started)
+                # divergence check BEFORE advancing/checkpointing: a
+                # spiked step must neither count nor be snapshotted
+                if self._diverged(loss):
+                    t = self._rollback(loss, t)
+                    handle = self.handle
+                    continue
                 t += 1
                 if t % checkpoint_every == 0 or t == total_steps:
                     self._save(handle, t)
@@ -371,6 +420,88 @@ class ElasticSupervisor:
                 handle = self.handle
         return handle
 
+    # -- gray-failure response (resilience.sentinel) -----------------------
+    def _stamp_steptime(self, handle, seconds):
+        """Publish this step's wall time for the pod's straggler policy
+        — skipped when the handle stamps per-rank times itself
+        (``handle.stamps_steptimes``, the gray chaos scenarios) or the
+        pod has no stamp channel."""
+        if self._pod is None or getattr(handle, "stamps_steptimes", False):
+            return
+        record = getattr(self._pod, "record_steptime", None)
+        if record is not None:
+            record(seconds)
+
+    def _check_stragglers(self):
+        """Fold the pod's stamped step times into the straggler policy;
+        a demotion aborts to the newest survivor-complete checkpoint
+        with :class:`~.sentinel.DegradedNodeError` — a
+        :class:`DeadNodeError` subclass, so the except clause in
+        :meth:`run` reshards it exactly like a death."""
+        if self._straggler is None or self._pod is None:
+            return
+        read = getattr(self._pod, "read_steptimes", None)
+        if read is None:
+            return
+        times = read()
+        if not times:
+            return
+        degraded = self._straggler.observe(times)
+        if not degraded:
+            return
+        survivors = [r for r in self.world.ranks
+                     if r not in set(degraded)]
+        _log.warning(
+            "straggler demotion: ranks %s DEGRADED (step-time EMA > "
+            "%.2fx pod median for %d consecutive windows); demoting to "
+            "dead and re-sharding onto %s",
+            degraded, self._straggler.factor, self._straggler.windows,
+            survivors)
+        abort_to_checkpoint(degraded, self._manager, ranks=survivors,
+                            error_cls=DegradedNodeError)
+
+    def _diverged(self, loss):
+        """True when the loss ``run_step`` returned just tripped the
+        divergence sentinel (handles returning None opt out)."""
+        if self._divergence is None or loss is None:
+            return False
+        try:
+            loss = float(loss)
+        except (TypeError, ValueError):
+            return False
+        return self._divergence.observe(loss)
+
+    def _rollback(self, loss, step):
+        """Roll back to the newest complete checkpoint after a
+        divergence trip: rebuild, restore, jump the ``mx.random`` stream
+        past the poisoned window (so the replay samples a different
+        trajectory instead of deterministically reproducing the spike),
+        and reset the sentinel's baseline.  Exhausting
+        ``MXNET_SENTINEL_ROLLBACKS`` raises :class:`DivergenceError`."""
+        from .. import random as _mxrandom
+
+        ema = self._divergence.ema
+        ema = float("nan") if ema is None else float(ema)
+        if self._rollbacks >= self._rollback_budget:
+            raise DivergenceError(float(loss), ema, self._rollbacks)
+        self._rollbacks += 1
+        rollbacks_counter().inc()
+        _log.warning(
+            "divergence at step %d (loss %g vs EMA %g): rolling back to "
+            "the newest complete checkpoint and advancing the RNG "
+            "stream past the poisoned window (rollback %d of %d)",
+            step, float(loss), ema, self._rollbacks,
+            self._rollback_budget)
+        self._teardown()
+        handle = self._construct()
+        t = self._restore(handle)
+        # deterministic skip: restore put the stream back to the
+        # snapshot, so without this the replay re-draws the exact keys
+        # that fed the spike
+        _mxrandom.advance(997)
+        self._divergence.reset()
+        return t
+
     def _reshard(self, survivors):
         """Shrink to ``survivors``, rebuild, restore onto the new
         topology; returns the step to resume from."""
@@ -378,6 +509,8 @@ class ElasticSupervisor:
         self.world = self.world.shrink(survivors)
         if self._pod is not None and hasattr(self._pod, "shrink"):
             self._pod.shrink(self.world.ranks)
+        if self._straggler is not None:
+            self._straggler.reset()
         handle = self._construct()
         self._rederive_readers(handle)
         t = self._restore(handle, reshard=True)

@@ -36,6 +36,27 @@ elastic recovery is drivable from a seeded plan; the liveness pollers
 consult :func:`dead_ranks`.  Never raises: a dead peer is something the
 *other* hosts observe, not an exception at the reader).
 
+Gray kinds (ISSUE 14 — failures where the process stays alive):
+
+* ``slow`` — the hook sleeps ``delay`` seconds (outside the faultline
+  lock) and then proceeds normally: a straggling host, not a dead one.
+  Only the straggler-demotion policy can see it.
+* ``flaky`` — a seeded intermittent-error pattern over the spec's
+  ``times``-arrival window: each arrival in the window independently
+  raises :class:`InjectedFlaky` (a ``ConnectionError`` — transient, so
+  ``retry_transient`` absorbs it) or passes, per a bit pattern derived
+  ONLY from (``seed``, ``times``) — bit-reproducible across fresh plan
+  constructions.  At least one arrival in the window always fires.
+* ``bitflip`` — corrupts ONE element of a payload the site hands over.
+  Bitflip specs live on a separate *payload* arrival channel (counted
+  as ``<site>#payload``) so they never perturb the regular arrival
+  indices other specs are planned against.  Two hook styles: sites
+  holding the payload on host call :func:`corrupt(site, payload)
+  <corrupt>`; sites that keep the payload on device (the bucketed
+  allreduce) call :func:`poll_payload` and apply the seeded flip
+  in-program.  The element/bit are picked from ``seed`` unless the
+  spec pins ``index``/``bit`` explicitly.
+
 Registration::
 
     faultline.plan([{"site": "kvstore.pushpull", "kind": "timeout",
@@ -61,16 +82,17 @@ from .. import telemetry as _telemetry
 __all__ = [
     "SITES", "KINDS",
     "InjectedFault", "InjectedTimeout", "InjectedError",
-    "InjectedPreemption",
+    "InjectedPreemption", "InjectedFlaky",
     "plan", "clear", "active_plan", "seeded_plan",
     "check", "poll", "recovered", "arrivals", "raise_fault",
-    "dead_ranks",
+    "dead_ranks", "poll_payload", "corrupt",
 ]
 
 SITES = ("kvstore.kv", "kvstore.pushpull", "collective.dispatch",
          "serve.model_call", "serve.replica", "data.iterator",
          "checkpoint.write", "train.grads")
-KINDS = ("timeout", "error", "preempt", "nan_grad", "dead_node")
+KINDS = ("timeout", "error", "preempt", "nan_grad", "dead_node",
+         "slow", "flaky", "bitflip")
 
 
 class InjectedFault(RuntimeError):
@@ -97,17 +119,43 @@ class InjectedPreemption(InjectedFault):
     it at the training-loop boundary and resume from checkpoint."""
 
 
+class InjectedFlaky(InjectedFault, ConnectionError):
+    """A flapping link: transient like a timeout (``ConnectionError`` is
+    in ``TRANSIENT_EXCEPTIONS`` so the retry policy absorbs it) but
+    distinguishable in the recovery counters — ``.kind == "flaky"``."""
+
+
 _EXC_BY_KIND = {
     "timeout": InjectedTimeout,
     "error": InjectedError,
     "preempt": InjectedPreemption,
+    "flaky": InjectedFlaky,
 }
 
 
-class _Spec:
-    __slots__ = ("site", "kind", "at", "times", "fired", "rank")
+def _flaky_pattern(seed, times):
+    """The intermittent fire/pass bit pattern for a flaky spec: one bit
+    per arrival in the window, derived ONLY from (seed, times) via the
+    stdlib Mersenne generator (stable across Python versions and fresh
+    constructions).  Forced nonempty: a flaky spec that never fires is a
+    misconfigured test, not a fault."""
+    import random as _random
 
-    def __init__(self, site, kind, at=None, times=1, rank=None):
+    # string seeds go through the deterministic sha512 path (int tuples
+    # would go through process-salted hash())
+    rng = _random.Random(f"flaky:{int(seed)}:{int(times)}")
+    bits = tuple(rng.getrandbits(1) for _ in range(int(times)))
+    if not any(bits):
+        bits = (1,) + bits[1:]
+    return bits
+
+
+class _Spec:
+    __slots__ = ("site", "kind", "at", "times", "fired", "rank",
+                 "delay", "seed", "index", "bit", "pattern")
+
+    def __init__(self, site, kind, at=None, times=1, rank=None,
+                 delay=None, seed=0, index=None, bit=None):
         if site not in SITES:
             raise ValueError(f"unknown faultline site {site!r}; "
                              f"one of {SITES}")
@@ -127,17 +175,37 @@ class _Spec:
         self.times = max(1, int(times))
         self.fired = 0
         self.rank = None if rank is None else int(rank)
+        # gray-kind knobs: `delay` (slow, seconds), `seed` (flaky
+        # pattern / bitflip element+bit choice), `index`/`bit` (bitflip
+        # pins: flat element index and bit-within-element, little-endian)
+        self.delay = 0.05 if delay is None else float(delay)
+        self.seed = int(seed)
+        self.index = None if index is None else int(index)
+        self.bit = None if bit is None else int(bit)
+        self.pattern = (_flaky_pattern(self.seed, self.times)
+                        if kind == "flaky" else None)
 
     def matches(self, arrival):
         start = self.at if self.at is not None else 1
-        return self.fired < self.times and \
+        in_window = self.fired < self.times and \
             start <= arrival < start + self.times
+        if in_window and self.pattern is not None:
+            return bool(self.pattern[arrival - start])
+        return in_window
 
     def to_dict(self):
         d = {"site": self.site, "kind": self.kind,
              "at": self.at, "times": self.times, "fired": self.fired}
         if self.rank is not None:
             d["rank"] = self.rank
+        if self.kind == "slow":
+            d["delay"] = self.delay
+        if self.kind in ("flaky", "bitflip"):
+            d["seed"] = self.seed
+        if self.index is not None:
+            d["index"] = self.index
+        if self.bit is not None:
+            d["bit"] = self.bit
         return d
 
 
@@ -174,11 +242,14 @@ def _parse_plan(entries):
     specs = []
     for e in entries:
         if isinstance(e, _Spec):
-            specs.append(_Spec(e.site, e.kind, e.at, e.times, e.rank))
+            specs.append(_Spec(e.site, e.kind, e.at, e.times, e.rank,
+                               e.delay, e.seed, e.index, e.bit))
             continue
         at = e.get("at", e.get("step"))
         specs.append(_Spec(e["site"], e["kind"], at, e.get("times", 1),
-                           e.get("rank")))
+                           e.get("rank"), e.get("delay"),
+                           e.get("seed", 0), e.get("index"),
+                           e.get("bit")))
     return specs
 
 
@@ -249,18 +320,25 @@ def seeded_plan(seed, sites=("kvstore.pushpull", "kvstore.kv"),
     return entries
 
 
-def _arrive(site):
+def _arrive(site, payload=False):
     """Advance the site's arrival counter; return the matched spec or
-    None.  Lazily consults MXNET_FAULTLINE on the first arrival ever."""
+    None.  Lazily consults MXNET_FAULTLINE on the first arrival ever.
+
+    ``payload=True`` is the separate payload-arrival channel (counted
+    under ``<site>#payload``): only ``bitflip`` specs match it, and
+    bitflip specs match ONLY it — so adding a payload hook to a site
+    never shifts the regular arrival indices existing plans target."""
+    key = f"{site}#payload" if payload else site
     with _state.lock:
         if _state.specs is None:
             _state.specs = _load_env_plan()
-        n = _state.counts.get(site, 0) + 1
-        _state.counts[site] = n
+        n = _state.counts.get(key, 0) + 1
+        _state.counts[key] = n
         if not _state.specs:
             return None
         for s in _state.specs:
-            if s.site == site and s.matches(n):
+            if s.site == site and (s.kind == "bitflip") == payload \
+                    and s.matches(n):
                 s.fired += 1
                 if s.kind == "dead_node":
                     # permanent: the rank stays dead until the plan is
@@ -287,20 +365,103 @@ def poll(site):
     if spec is None:
         return None
     _injected_counter().labels(site=site, kind=spec.kind).inc()
+    if spec.kind == "slow":
+        _sleep_slow(spec)
     return spec.kind
 
 
 def check(site):
     """Raising hook: no-op when no fault matches this arrival, else
     raises the kind's exception class (``nan_grad`` never raises — it is
-    returned by :func:`poll` at the one site that understands it)."""
+    returned by :func:`poll` at the one site that understands it;
+    ``slow`` sleeps the spec's delay and returns normally)."""
     spec = _arrive(site)
     if spec is None:
         return
     _injected_counter().labels(site=site, kind=spec.kind).inc()
+    if spec.kind == "slow":
+        _sleep_slow(spec)
+        return
     exc = _EXC_BY_KIND.get(spec.kind)
     if exc is not None:
         raise exc(site, spec.kind, _state.counts[site])
+
+
+def _sleep_slow(spec):
+    """The straggler delay — always OUTSIDE the faultline lock (a slow
+    site must not serialize every other site's hooks behind it)."""
+    import time
+
+    time.sleep(spec.delay)
+
+
+def poll_payload(site):
+    """Payload-channel hook for sites that keep the payload on device:
+    advances the ``<site>#payload`` arrival counter and, when a
+    ``bitflip`` spec fires, returns its targeting knobs
+    ``{"seed", "index", "bit", "rank"}`` (else None).  The caller
+    applies the seeded corruption itself — the bucketed allreduce turns
+    this into an in-program perturbation input so injection never
+    forces a host round-trip."""
+    spec = _arrive(site, payload=True)
+    if spec is None:
+        return None
+    _injected_counter().labels(site=site, kind="bitflip").inc()
+    return {"seed": spec.seed, "index": spec.index, "bit": spec.bit,
+            "rank": spec.rank}
+
+
+def corrupt(site, payload):
+    """Payload-channel hook for sites holding the payload on host:
+    advances the ``<site>#payload`` arrival counter and, when a
+    ``bitflip`` spec fires, returns a copy of ``payload`` with ONE bit
+    of ONE element flipped (seeded choice unless the spec pins
+    ``index``/``bit``).  Otherwise returns ``payload`` unchanged.
+    Handles numpy arrays, tuples/lists of them (first array corrupted),
+    bytes, and str."""
+    spec = _arrive(site, payload=True)
+    if spec is None:
+        return payload
+    _injected_counter().labels(site=site, kind="bitflip").inc()
+    return _flip(payload, spec)
+
+
+def _flip(payload, spec):
+    import random as _random
+
+    import numpy as onp
+
+    rng = _random.Random(f"bitflip:{spec.seed}")
+    if isinstance(payload, (tuple, list)):
+        out = list(payload)
+        for i, item in enumerate(out):
+            if isinstance(item, onp.ndarray):
+                out[i] = _flip(item, spec)
+                break
+        return type(payload)(out) if isinstance(payload, tuple) else out
+    if isinstance(payload, onp.ndarray):
+        flat = onp.array(payload, copy=True).reshape(-1)
+        idx = spec.index if spec.index is not None \
+            else rng.randrange(flat.size)
+        nbits = flat.itemsize * 8
+        bit = spec.bit if spec.bit is not None else rng.randrange(nbits)
+        raw = flat.view(onp.uint8)  # mxlint: disable=bits-as-float -- the corruption injector: a host-side numpy COPY gets one bit XORed through a uint8 view; producing an arbitrary (possibly NaN-encoded) float is the fault being injected, and the copy never enters traced code
+        # little-endian bit order within the element: bit 30 of a
+        # float32 is the exponent MSB — the classic silent-corruption
+        # magnitude explosion
+        raw[idx * flat.itemsize + bit // 8] ^= onp.uint8(1 << (bit % 8))
+        return flat.reshape(payload.shape)
+    if isinstance(payload, (bytes, bytearray)):
+        buf = bytearray(payload)
+        idx = spec.index if spec.index is not None \
+            else rng.randrange(len(buf))
+        bit = spec.bit if spec.bit is not None else rng.randrange(8)
+        buf[idx] ^= 1 << (bit % 8)
+        return bytes(buf)
+    if isinstance(payload, str):
+        enc = _flip(payload.encode("utf-8", "surrogatepass"), spec)
+        return enc.decode("utf-8", "replace")
+    return payload
 
 
 def raise_fault(site, kind, arrival=None):

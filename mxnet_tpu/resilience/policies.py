@@ -24,7 +24,7 @@ from . import faultline
 
 __all__ = ["TRANSIENT_EXCEPTIONS", "retry_transient", "DeadNodeError",
            "check_peers", "abort_to_checkpoint", "kv_retries",
-           "step_skip_counter"]
+           "step_skip_counter", "backoff_delay", "fault_kind"]
 
 # the transient class: deadline misses and connection hiccups.  Real
 # XLA/jax execution errors are NOT here — retrying a poisoned program
@@ -67,13 +67,55 @@ def step_skip_counter():
         "bitwise untouched and the loss scaler backed off")
 
 
+def _local_rank():
+    """This process's rank for jitter seeding — jax.process_index()
+    when the runtime is up, 0 otherwise (single-host tests)."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # mxlint: disable=swallowed-exception -- jitter seeding must never be the reason a retry path dies; rank 0 is a safe default
+        return 0
+
+
+def backoff_delay(attempt, base_delay=0.05, max_delay=2.0, rank=None):
+    """The capped exponential delay for retry ``attempt`` (0-based) with
+    deterministic per-rank jitter: the base ``min(max, base*2^k)``
+    schedule scaled by a factor in [0.5, 1.0] derived ONLY from
+    (rank, attempt).  Without it every host in the pod sleeps the
+    identical schedule and a flapping coordinator eats a synchronized
+    retry storm; with it the schedules decorrelate while each host
+    stays bit-reproducible run to run."""
+    import random as _random
+
+    if rank is None:
+        rank = _local_rank()
+    # string seed -> deterministic sha512 path, never process-salted
+    rng = _random.Random(f"backoff:{int(rank)}:{int(attempt)}")
+    jitter = 0.5 + 0.5 * rng.random()
+    return min(max_delay, base_delay * (2 ** attempt)) * jitter
+
+
+def fault_kind(e):
+    """Map an exception to the recovery-counter kind: an explicit
+    ``.kind`` (faultline's injected classes) wins; otherwise a
+    ``ConnectionError`` is a flaky link, anything else transient is a
+    deadline miss — so the counters tell the two gray classes apart."""
+    kind = getattr(e, "kind", None)
+    if kind is not None:
+        return kind
+    return "flaky" if isinstance(e, ConnectionError) else "timeout"
+
+
 def retry_transient(fn, site, retries=None, base_delay=0.05, max_delay=2.0,
-                    retry_on=TRANSIENT_EXCEPTIONS, sleep=time.sleep):
+                    retry_on=TRANSIENT_EXCEPTIONS, sleep=time.sleep,
+                    rank=None):
     """Call ``fn()``; on a transient exception retry up to ``retries``
-    times with capped exponential backoff (base, 2*base, 4*base, ...
-    capped at ``max_delay``).  A retry that then succeeds ticks
-    ``mxtpu_faults_recovered_total{site}``; exhausting the budget
-    re-raises the last exception."""
+    times with capped, per-rank-jittered exponential backoff
+    (:func:`backoff_delay`).  A retry that then succeeds ticks
+    ``mxtpu_faults_recovered_total{site,kind}`` with the kind from
+    :func:`fault_kind`; exhausting the budget re-raises the last
+    exception."""
     if retries is None:
         retries = kv_retries()
     attempt = 0
@@ -83,10 +125,10 @@ def retry_transient(fn, site, retries=None, base_delay=0.05, max_delay=2.0,
         except retry_on as e:
             if attempt >= retries:
                 raise
-            delay = min(max_delay, base_delay * (2 ** attempt))
+            delay = backoff_delay(attempt, base_delay, max_delay, rank)
             attempt += 1
             _retries_counter().labels(site=site).inc()
-            last_kind = getattr(e, "kind", "timeout")
+            last_kind = fault_kind(e)
             sleep(delay)
             continue
         if attempt:
@@ -110,6 +152,20 @@ class DeadNodeError(MXNetError):
         self.checkpoint_step = checkpoint_step
 
 
+def _survivor_ranks(store, dead):
+    """The ranks that will restore together after ``dead`` are dropped —
+    the rank set ``restore_latest(ranks=...)`` validates against.  From
+    the pod's explicit rank tuple (``EmulatedPod.ranks``) or the store's
+    world size; None when the store exposes neither."""
+    ranks = getattr(store, "ranks", None)
+    if ranks is None:
+        size = getattr(store, "num_workers", None)
+        if size is None:
+            return None
+        ranks = range(int(size))
+    return [int(r) for r in ranks if int(r) not in set(dead)]
+
+
 def check_peers(store, manager=None, timeout=60):
     """Poll ``store.get_dead_nodes`` and, when it fires, abort to the
     last checkpoint: flush ``manager``'s queued writes and raise
@@ -118,19 +174,32 @@ def check_peers(store, manager=None, timeout=60):
     dead = store.get_dead_nodes(timeout=timeout)
     if not dead:
         return []
-    abort_to_checkpoint(dead, manager)
+    abort_to_checkpoint(dead, manager, ranks=_survivor_ranks(store, dead))
 
 
-def abort_to_checkpoint(dead_ranks, manager=None):
+def abort_to_checkpoint(dead_ranks, manager=None, ranks=None,
+                        error_cls=DeadNodeError):
     """Flush the checkpoint manager (the last snapshot must actually be
-    on disk before the process gives up) and raise
-    :class:`DeadNodeError` for the launcher to act on."""
-    from .checkpoint import latest_step
+    on disk before the process gives up) and raise ``error_cls`` (a
+    :class:`DeadNodeError` — the sentinel passes its
+    ``DegradedNodeError`` subclass) for the launcher to act on.
+
+    ``checkpoint_step`` is the newest step COMPLETE across ``ranks``
+    (``complete_steps``) — a host that died mid-save leaves its newest
+    step torn, and ``latest_step`` would name a checkpoint
+    ``restore_latest`` then refuses to load.  Without a rank set the
+    torn-save-blind ``latest_step`` is still reported (single-host
+    callers, where torn == corrupt and restore falls back anyway)."""
+    from .checkpoint import complete_steps, latest_step
 
     step = None
     if manager is not None:
         try:
             manager.wait()
         finally:
-            step = latest_step(manager.root)
-    raise DeadNodeError(dead_ranks, checkpoint_step=step)
+            if ranks:
+                steps = complete_steps(manager.root, ranks)
+                step = steps[-1] if steps else None
+            else:
+                step = latest_step(manager.root)
+    raise error_cls(dead_ranks, checkpoint_step=step)

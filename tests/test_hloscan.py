@@ -350,12 +350,32 @@ def test_real_artifact_inventory(real_artifacts):
     names = {a.name for a in real_artifacts}
     assert names == {"fused_train_step.dp", "allreduce.bucket_dense",
                      "allreduce.bucket_2bit", "allreduce.bucket_int8",
-                     "allreduce.bucket_fp8", "allreduce.bucketed_step",
+                     "allreduce.bucket_fp8",
+                     "allreduce.bucket_dense_integrity",
+                     "allreduce.bucket_int8_integrity",
+                     "allreduce.bucketed_step",
                      "allreduce.bucketed_step_int8",
                      "flash_attention.fwd", "flash_attention.bwd",
                      "serve.endpoint"}
     for a in real_artifacts:
         assert a.best_module is not None, f"{a.name}: no HLO captured"
+
+
+def test_integrity_artifacts_pin_one_extra_collective(real_artifacts):
+    """The ISSUE 14 integrity sideband is a declared contract variant:
+    the digest-agreement pmax rides INSIDE the same program — exactly
+    one collective beyond the non-integrity twin, zero extra launches
+    (defaults unchanged: the plain artifacts keep their counts)."""
+    by_name = {a.name: a for a in real_artifacts}
+    dense = by_name["allreduce.bucket_dense_integrity"]
+    assert dense.contract["expected_collectives"] == {"all-reduce": 2}
+    assert hlo.collective_counts(dense.best_module) == {"all-reduce": 2}
+    assert dense.meta["mode"] == "integrity"
+    int8 = by_name["allreduce.bucket_int8_integrity"]
+    assert int8.contract["expected_collectives"] == {"all-reduce": 3}
+    assert hlo.collective_counts(int8.best_module) == {"all-reduce": 3}
+    assert by_name["allreduce.bucket_dense"].contract[
+        "expected_collectives"] == {"all-reduce": 1}
 
 
 def test_dp_step_census_locks_bucket_collapse(real_artifacts):

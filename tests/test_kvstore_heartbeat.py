@@ -79,3 +79,50 @@ def test_close_without_heartbeat_is_a_noop():
     s = TPUICIStore()   # single process: no thread started
     assert s._hb_thread is None
     s.close()
+
+
+def test_liveness_tolerates_clock_skew_under_half_timeout(monkeypatch):
+    """Heartbeat stamps carry the SENDER's wall clock, so a peer whose
+    clock is off by s makes its beats look s older (or newer).  With a
+    beat interval <= timeout/2, any skew under timeout/2 keeps the
+    worst-case apparent age (one full interval + skew) below the
+    timeout — no rank is ever suspected, let alone declared dead."""
+    import time
+
+    client = _FakeKVClient()
+    monkeypatch.setattr(TPUICIStore, "_kv_client", lambda self: client)
+    s = TPUICIStore()
+    monkeypatch.setattr(s, "_size", 2)
+    timeout, skew = 10.0, 4.9          # tolerated: skew < timeout/2
+    # rank 0's clock runs AHEAD (stamp from the future), rank 1's runs
+    # BEHIND and its freshest beat is already a full interval old
+    client.kv["mxtpu/heartbeat/0"] = repr(time.time() + skew)
+    client.kv["mxtpu/heartbeat/1"] = repr(
+        time.time() - (timeout / 2 + skew))
+    for _ in range(3):
+        assert s.get_dead_nodes(timeout=timeout) == []
+    s.close()
+
+
+def test_liveness_two_observation_rule_absorbs_one_poll_transient(
+        monkeypatch):
+    import time
+
+    client = _FakeKVClient()
+    monkeypatch.setattr(TPUICIStore, "_kv_client", lambda self: client)
+    s = TPUICIStore()
+    monkeypatch.setattr(s, "_size", 2)
+    client.kv["mxtpu/heartbeat/0"] = repr(time.time())
+    # one stale poll (beat thread descheduled past the deadline, or
+    # skew beyond tolerance for a moment): SUSPECT only
+    client.kv["mxtpu/heartbeat/1"] = repr(time.time() - 61)
+    assert s.get_dead_nodes(timeout=60) == []
+    # the next beat lands: suspicion cleared, no residue
+    client.kv["mxtpu/heartbeat/1"] = repr(time.time())
+    assert s.get_dead_nodes(timeout=60) == []
+    # genuinely dead: stale for two CONSECUTIVE polls — and the earlier
+    # transient did not pre-load the counter
+    client.kv["mxtpu/heartbeat/1"] = repr(time.time() - 61)
+    assert s.get_dead_nodes(timeout=60) == []
+    assert s.get_dead_nodes(timeout=60) == [1]
+    s.close()

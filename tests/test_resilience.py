@@ -174,10 +174,18 @@ def test_retry_backoff_is_capped_exponential():
 
     with pytest.raises(TimeoutError):
         retry_transient(always, site="kvstore.kv", retries=7,
-                        base_delay=0.05, max_delay=0.2, sleep=delays.append)
-    assert delays[:3] == [0.05, 0.1, 0.2]
-    assert all(d == 0.2 for d in delays[2:])
+                        base_delay=0.05, max_delay=0.2, sleep=delays.append,
+                        rank=0)
+    # jittered schedule: each delay is the capped-exponential base value
+    # scaled by a deterministic per-(rank, attempt) factor in [0.5, 1.0]
+    bases = [0.05, 0.1, 0.2, 0.2, 0.2, 0.2, 0.2]
     assert len(delays) == 7
+    for d, base in zip(delays, bases):
+        assert 0.5 * base <= d <= base
+    # bit-reproducible: the exact same schedule on a re-run
+    from mxnet_tpu.resilience.policies import backoff_delay
+    assert delays == [backoff_delay(k, 0.05, 0.2, rank=0)
+                      for k in range(7)]
 
 
 # -- shard-level checkpoint io ------------------------------------------------

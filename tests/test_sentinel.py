@@ -1,0 +1,482 @@
+"""Gray-failure resilience (ISSUE 14): straggler demotion, slow/flaky/
+bitflip injection, the allreduce integrity sideband, and divergence
+auto-rollback.
+
+The fences: the ``StragglerPolicy`` M-consecutive-windows rule (one GC
+pause never costs a reshard) and its post-reshard reset; the
+``DivergenceSentinel`` warmup / spike / non-finite semantics, with the
+tripping value NOT folded into the EMA; the three gray faultline kinds
+fire bit-reproducibly from fresh plan constructions, and the bitflip
+payload channel never shifts a site's regular arrival indices; the
+retry policy's per-rank jitter decorrelates hosts while staying
+deterministic, and a recovered ``ConnectionError`` is booked under
+kind="flaky", not "timeout"; ``abort_to_checkpoint`` names the newest
+step COMPLETE across the survivors, not a torn save; the in-program
+integrity sideband makes the trainer skip the poisoned step with
+params bitwise untouched; and the supervisor demotes a straggler onto
+the survivor mesh and rolls a divergence back within the
+``MXNET_SENTINEL_ROLLBACKS`` budget.
+"""
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.utils import split_and_load
+from mxnet_tpu.resilience import (CheckpointManager, DeadNodeError,
+                                  DegradedNodeError, DivergenceError,
+                                  DivergenceSentinel, ElasticSupervisor,
+                                  ElasticWorld, EmulatedPod, InjectedFlaky,
+                                  StragglerPolicy, backoff_delay, fault_kind,
+                                  faultline, retry_transient, save_checkpoint)
+from mxnet_tpu.resilience.policies import abort_to_checkpoint
+from mxnet_tpu.resilience.sentinel import degraded_counter
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faultline.clear()
+    yield
+    faultline.clear()
+
+
+def _sample(name, labels=None):
+    v = telemetry.default_registry().get_sample_value(name, labels)
+    return 0.0 if v is None else v
+
+
+# -- StragglerPolicy ----------------------------------------------------------
+
+def test_straggler_demotes_after_consecutive_windows():
+    p = StragglerPolicy(factor=3.0, windows=2, alpha=0.5)
+    d0 = _sample("mxtpu_node_degraded_total", {"rank": "1"})
+    healthy = {0: 0.01, 1: 0.01, 2: 0.01}
+    assert p.observe(healthy) == []
+    slow = {0: 0.01, 1: 0.5, 2: 0.01}
+    assert p.observe(slow) == []          # first suspicious window
+    assert p.observe(slow) == [1]         # second: demoted
+    assert _sample("mxtpu_node_degraded_total", {"rank": "1"}) == d0 + 1
+    # demotion fires exactly once at the threshold crossing
+    assert p.observe(slow) == []
+
+
+def test_straggler_clean_window_resets_suspicion():
+    p = StragglerPolicy(factor=3.0, windows=2, alpha=1.0)  # no smoothing
+    slow = {0: 0.01, 1: 0.5, 2: 0.01}
+    healthy = {0: 0.01, 1: 0.01, 2: 0.01}
+    assert p.observe(slow) == []
+    assert p.observe(healthy) == []       # back under: suspicion cleared
+    assert p.observe(slow) == []          # counting restarts at 1
+    assert p.observe(slow) == [1]
+
+
+def test_straggler_single_rank_and_reset():
+    p = StragglerPolicy(factor=3.0, windows=1)
+    # a 1-rank pod has no median to be slower than
+    assert p.observe({0: 9.9}) == []
+    p.observe({0: 0.01, 1: 0.01})
+    assert p._ema
+    p.reset()                              # post-reshard fresh baseline
+    assert p._ema == {} and p._suspect == {}
+
+
+def test_straggler_publishes_steptime_ratio():
+    p = StragglerPolicy(factor=3.0, windows=5, alpha=1.0)
+    p.observe({0: 0.01, 1: 0.08, 2: 0.01})
+    assert _sample("mxtpu_steptime_ratio", {"rank": "1"}) == pytest.approx(8.0)
+    assert _sample("mxtpu_steptime_ratio", {"rank": "0"}) == pytest.approx(1.0)
+
+
+# -- DivergenceSentinel -------------------------------------------------------
+
+def test_divergence_warmup_then_spike_trips():
+    s = DivergenceSentinel(factor=10.0, warmup=3, alpha=0.3)
+    assert not s.observe(1.0)
+    assert not s.observe(100.0)   # inside warmup: folded, never trips
+    for _ in range(4):
+        s.observe(1.0)
+    assert s.observe(1e6)
+
+
+def test_divergence_nonfinite_always_trips():
+    s = DivergenceSentinel(factor=10.0, warmup=3)
+    assert s.observe(float("inf"))     # even as the very first observation
+    assert s.observe(float("nan"))
+
+
+def test_divergence_trip_not_folded_into_ema():
+    s = DivergenceSentinel(factor=10.0, warmup=2, alpha=0.3)
+    for _ in range(4):
+        s.observe(1.0)
+    ema = s.ema
+    assert s.observe(1e6)
+    # the spike must not drag the baseline up and mask the next one
+    assert s.ema == ema
+    assert s.observe(1e6)
+
+
+def test_divergence_reset_rewarms():
+    s = DivergenceSentinel(factor=10.0, warmup=2)
+    for _ in range(3):
+        s.observe(1.0)
+    s.reset()
+    assert s.ema is None
+    assert not s.observe(1e6)   # warming up again: finite spike tolerated
+
+
+def test_degraded_is_a_dead_node_error():
+    e = DegradedNodeError([1], checkpoint_step=7)
+    assert isinstance(e, DeadNodeError)
+    assert e.ranks == [1] and e.checkpoint_step == 7
+
+
+# -- gray faultline kinds -----------------------------------------------------
+
+def test_slow_kind_sleeps_then_passes():
+    faultline.plan([{"site": "data.iterator", "kind": "slow",
+                     "delay": 0.15, "at": 1}])
+    t0 = time.monotonic()
+    faultline.check("data.iterator")   # fires: sleeps, never raises
+    assert time.monotonic() - t0 >= 0.15
+    t0 = time.monotonic()
+    faultline.check("data.iterator")   # past the window: no delay
+    assert time.monotonic() - t0 < 0.1
+
+
+def _flaky_firing_sequence(seed, times, arrivals):
+    faultline.clear()
+    faultline.plan([{"site": "kvstore.pushpull", "kind": "flaky",
+                     "at": 1, "times": times, "seed": seed}])
+    fired = []
+    for _ in range(arrivals):
+        try:
+            faultline.check("kvstore.pushpull")
+            fired.append(0)
+        except InjectedFlaky as e:
+            assert isinstance(e, ConnectionError)
+            assert e.kind == "flaky"
+            fired.append(1)
+    return fired
+
+
+def test_flaky_pattern_reproducible_across_fresh_plans():
+    a = _flaky_firing_sequence(seed=7, times=4, arrivals=6)
+    b = _flaky_firing_sequence(seed=7, times=4, arrivals=6)
+    assert a == b                       # bit-reproducible reconstruction
+    assert sum(a) >= 1                  # a flaky spec that never fires
+    assert a[4:] == [0, 0]              # is a bug; beyond the window: clean
+    c = _flaky_firing_sequence(seed=8, times=4, arrivals=6)
+    assert c[:4] != a[:4] or sum(c) != sum(a) or c == a  # seed-derived
+
+
+def test_flaky_retry_recovers_under_kind_flaky():
+    faultline.plan([{"site": "kvstore.pushpull", "kind": "flaky",
+                     "at": 1, "times": 1, "seed": 0}])
+    ret0 = _sample("mxtpu_kvstore_retries_total",
+                   {"site": "kvstore.pushpull"})
+    rec0 = _sample("mxtpu_faults_recovered_total",
+                   {"site": "kvstore.pushpull", "kind": "flaky"})
+    tmo0 = _sample("mxtpu_faults_recovered_total",
+                   {"site": "kvstore.pushpull", "kind": "timeout"})
+    out = retry_transient(lambda: faultline.check("kvstore.pushpull") or 42,
+                          site="kvstore.pushpull", sleep=lambda s: None)
+    assert out == 42
+    assert _sample("mxtpu_kvstore_retries_total",
+                   {"site": "kvstore.pushpull"}) == ret0 + 1
+    # satellite: the recovery is booked as a flaky link, NOT a timeout
+    assert _sample("mxtpu_faults_recovered_total",
+                   {"site": "kvstore.pushpull", "kind": "flaky"}) == rec0 + 1
+    assert _sample("mxtpu_faults_recovered_total",
+                   {"site": "kvstore.pushpull", "kind": "timeout"}) == tmo0
+
+
+def test_fault_kind_mapping():
+    assert fault_kind(ConnectionError("link flap")) == "flaky"
+    assert fault_kind(TimeoutError("deadline")) == "timeout"
+    assert fault_kind(InjectedFlaky("s", "flaky", 1)) == "flaky"
+    assert fault_kind(OSError("disk")) == "timeout"   # the legacy default
+
+
+def test_bitflip_corrupt_pinned_bit_is_exact():
+    # bit 30 of f32 is the exponent MSB: 1.0 (0x3F800000) -> +inf
+    faultline.plan([{"site": "data.iterator", "kind": "bitflip",
+                     "at": 1, "seed": 9, "index": 0, "bit": 30}])
+    arr = onp.ones(4, dtype=onp.float32)
+    out = faultline.corrupt("data.iterator", arr)
+    assert onp.isinf(out[0]) and (out[1:] == 1.0).all()
+    assert (arr == 1.0).all()           # input untouched: corrupt copies
+
+
+def _corrupt_once(seed):
+    faultline.clear()
+    faultline.plan([{"site": "data.iterator", "kind": "bitflip",
+                     "at": 1, "seed": seed}])
+    return faultline.corrupt("data.iterator",
+                             onp.arange(16, dtype=onp.float32))
+
+
+def test_bitflip_seeded_choice_reproducible_and_single_bit():
+    a, b = _corrupt_once(3), _corrupt_once(3)
+    assert a.tobytes() == b.tobytes()   # fresh plans, identical corruption
+    clean = onp.arange(16, dtype=onp.float32)
+    xor = onp.bitwise_xor(a.view(onp.uint8), clean.view(onp.uint8))
+    assert int(onp.unpackbits(xor).sum()) == 1   # exactly one bit flipped
+    c = _corrupt_once(4)
+    assert c.tobytes() != a.tobytes()
+
+
+def test_bitflip_payload_channel_never_shifts_regular_arrivals():
+    faultline.plan([
+        {"site": "data.iterator", "kind": "bitflip", "at": 1, "seed": 2},
+        {"site": "data.iterator", "kind": "timeout", "at": 2},
+    ])
+    faultline.check("data.iterator")               # arrival 1: clean —
+    # bitflip specs match ONLY the payload channel
+    out = faultline.corrupt("data.iterator",
+                            onp.ones(4, dtype=onp.float32))
+    assert out.tobytes() != onp.ones(4, dtype=onp.float32).tobytes()
+    with pytest.raises(TimeoutError):
+        faultline.check("data.iterator")           # arrival 2, unshifted
+    assert faultline.arrivals("data.iterator") == 2
+    assert faultline.arrivals("data.iterator#payload") == 1
+
+
+def test_plan_reproducible_across_fresh_constructions():
+    entries = [
+        {"site": "kvstore.pushpull", "kind": "flaky", "at": 3, "times": 5,
+         "seed": 11},
+        {"site": "collective.dispatch", "kind": "bitflip", "at": 1,
+         "seed": 5, "rank": 1},
+        {"site": "data.iterator", "kind": "slow", "delay": 0.25, "at": 2},
+    ]
+    faultline.plan(entries)
+    a = faultline.active_plan()
+    faultline.clear()
+    faultline.plan(entries)
+    assert faultline.active_plan() == a
+
+
+# -- retry jitter / abort-to-checkpoint satellites ----------------------------
+
+def test_backoff_jitter_per_rank_deterministic_and_bounded():
+    sched = {r: [backoff_delay(k, 0.05, 2.0, rank=r) for k in range(6)]
+             for r in (0, 1, 2)}
+    # reproducible: same (rank, attempt) -> same delay, fresh call
+    assert sched[1] == [backoff_delay(k, 0.05, 2.0, rank=1)
+                        for k in range(6)]
+    # decorrelated: no two ranks sleep the identical schedule
+    assert sched[0] != sched[1] and sched[1] != sched[2]
+    # bounded: jitter in [0.5, 1.0] x the capped exponential
+    for delays in sched.values():
+        for k, d in enumerate(delays):
+            base = min(2.0, 0.05 * 2 ** k)
+            assert 0.5 * base <= d <= base
+
+
+def test_abort_to_checkpoint_reports_survivor_complete_step(tmp_path):
+    root = str(tmp_path / "ck")
+    arrays = {"w": onp.arange(4, dtype=onp.float32)}
+    for r in (0, 1):
+        save_checkpoint(root, 1, arrays, {"step": 1}, rank=r)
+    # rank 1 died mid-save of step 2: its shard never committed
+    save_checkpoint(root, 2, arrays, {"step": 2}, rank=0)
+    mgr = CheckpointManager(root, async_write=False, rank=0)
+    with pytest.raises(DeadNodeError) as ei:
+        abort_to_checkpoint([2], mgr, ranks=[0, 1])
+    # the torn step 2 is NOT advertised — restore would refuse it
+    assert ei.value.checkpoint_step == 1
+    with pytest.raises(DegradedNodeError) as ei:
+        abort_to_checkpoint([2], mgr, ranks=[0, 1],
+                            error_cls=DegradedNodeError)
+    assert ei.value.checkpoint_step == 1
+    mgr.close()
+
+
+# -- the integrity sideband through the trainer -------------------------------
+
+def test_integrity_sideband_trainer_skips_poisoned_step(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_INTEGRITY", "1")
+    ctxs = [mx.cpu(i) for i in range(4)]
+    net = nn.Dense(4, in_units=6)
+    net.initialize(ctx=ctxs)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="tpu_ici")
+
+    def dp_step():
+        rs = onp.random.RandomState(1)
+        xs = split_and_load(
+            mx.np.array(rs.randn(8, 6).astype(onp.float32)), ctxs)
+        with autograd.record():
+            ls = [(net(xb) ** 2).mean() for xb in xs]
+        autograd.backward(ls)
+        tr.step(8)
+
+    def params_bytes():
+        return {k: p.data().asnumpy().tobytes()
+                for k, p in net.collect_params().items()}
+
+    dp_step()   # kv init + broadcast + first traced integrity launch
+    before = params_bytes()
+    skip0 = _sample("mxtpu_train_steps_skipped_total")
+    vio0 = _sample("mxtpu_integrity_violations_total",
+                   {"site": "collective.dispatch"})
+    rec0 = _sample("mxtpu_faults_recovered_total",
+                   {"site": "collective.dispatch", "kind": "bitflip"})
+    faultline.plan([{"site": "collective.dispatch", "kind": "bitflip",
+                     "at": 1, "seed": 5, "rank": 1}])
+    dp_step()   # the poisoned bucket: caught in-program, update skipped
+    faultline.clear()
+    assert _sample("mxtpu_integrity_violations_total",
+                   {"site": "collective.dispatch"}) == vio0 + 1
+    assert _sample("mxtpu_train_steps_skipped_total") == skip0 + 1
+    assert _sample("mxtpu_faults_recovered_total",
+                   {"site": "collective.dispatch", "kind": "bitflip"}) \
+        == rec0 + 1
+    assert params_bytes() == before   # bitwise untouched by the bad step
+    dp_step()   # clean step: training resumes, params move again
+    assert params_bytes() != before
+    assert _sample("mxtpu_train_steps_skipped_total") == skip0 + 1
+
+
+# -- the supervisor: straggler demotion + divergence rollback -----------------
+
+IN_UNITS = 6
+PER_HOST = 2
+
+
+class _Job:
+    def __init__(self, world, seed=11):
+        mx.random.seed(seed)
+        self.world = world
+        self.ctxs = [mx.cpu(r) for r in world.ranks]
+        self.net = nn.Dense(4, in_units=IN_UNITS)
+        self.net.initialize(ctx=self.ctxs)
+        self.trainer = gluon.Trainer(self.net.collect_params(), "sgd",
+                                     {"learning_rate": 0.1},
+                                     kvstore="tpu_ici")
+
+    def run_step(self, t):
+        rs = onp.random.RandomState(500 + t)
+        x = rs.randn(PER_HOST * len(self.ctxs), IN_UNITS).astype(onp.float32)
+        xs = split_and_load(mx.np.array(x), self.ctxs)
+        with autograd.record():
+            ls = [(self.net(xb) ** 2).mean() for xb in xs]
+        autograd.backward(ls)
+        self.trainer.step(PER_HOST * len(self.ctxs))
+
+    def params_np(self):
+        return {k: onp.asarray(p.data()._data)
+                for k, p in self.net.collect_params().items()}
+
+
+class _StragglerJob(_Job):
+    # the job stamps per-rank wall times itself (one process emulates
+    # the pod), so the supervisor's own wall timing must not overwrite
+    stamps_steptimes = True
+
+    def __init__(self, world, pod, slow_rank=1, slow_from=2):
+        super().__init__(world)
+        self._pod = pod
+        self._slow_rank = slow_rank
+        self._slow_from = slow_from
+
+    def run_step(self, t):
+        super().run_step(t)
+        for r in self.world.ranks:
+            slow = r == self._slow_rank and t >= self._slow_from
+            self._pod.record_steptime(0.5 if slow else 0.01, rank=r)
+
+
+def test_supervisor_demotes_straggler_and_reshards(tmp_path):
+    world = ElasticWorld.fresh(3)
+    pod = EmulatedPod(world.ranks)
+    d0 = _sample("mxtpu_node_degraded_total", {"rank": "1"})
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_write=False, rank=0)
+    sup = ElasticSupervisor(
+        lambda w: _StragglerJob(w, pod), mgr, world=world, pod=pod,
+        elastic=True, min_world=2, scaling="linear",
+        straggler=StragglerPolicy(factor=3.0, windows=2))
+    handle = sup.run(6, checkpoint_every=1)
+    mgr.close()
+    # rank 1 was never DEAD — only slow — yet the demotion rode the
+    # dead-node reshard path onto the survivors
+    assert sup.world.ranks == (0, 2) and sup.reshards == 1
+    assert _sample("mxtpu_node_degraded_total", {"rank": "1"}) == d0 + 1
+    assert all(onp.isfinite(a).all() for a in handle.params_np().values())
+    sup.close()
+
+
+def _diverging_build(script, spike_at, spike=1e9):
+    def build(world):
+        job = _Job(world)
+        real = job.run_step
+
+        def run_step(t):
+            i = script["calls"]
+            script["calls"] += 1
+            real(t)
+            return spike if i == spike_at else 1.0
+        job.run_step = run_step
+        return job
+    return build
+
+
+def test_supervisor_divergence_rolls_back_and_completes(tmp_path):
+    script = {"calls": 0}
+    rb0 = _sample("mxtpu_sentinel_rollbacks_total")
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_write=False, rank=0)
+    sup = ElasticSupervisor(
+        _diverging_build(script, spike_at=4), mgr,
+        world=ElasticWorld.fresh(1),
+        divergence=DivergenceSentinel(factor=10.0, warmup=3))
+    handle = sup.run(6, checkpoint_every=1)
+    mgr.close()
+    assert _sample("mxtpu_sentinel_rollbacks_total") == rb0 + 1
+    # 4 clean + 1 spiked (not counted, not snapshotted) + 2 replayed
+    assert script["calls"] == 7
+    assert all(onp.isfinite(a).all() for a in handle.params_np().values())
+    sup.close()
+
+
+def test_supervisor_divergence_budget_exhausted_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_SENTINEL_ROLLBACKS", "0")
+    script = {"calls": 0}
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_write=False, rank=0)
+    sup = ElasticSupervisor(
+        _diverging_build(script, spike_at=4), mgr,
+        world=ElasticWorld.fresh(1),
+        divergence=DivergenceSentinel(factor=10.0, warmup=3))
+    with pytest.raises(DivergenceError) as ei:
+        sup.run(6, checkpoint_every=1)
+    mgr.close()
+    assert ei.value.rollbacks == 0
+    assert ei.value.loss == pytest.approx(1e9)
+    sup.close()
+
+
+def test_mx_random_advance_jumps_the_stream():
+    def draw():
+        return mx.random.uniform(shape=(4,)).asnumpy()
+
+    mx.random.seed(3)
+    a1 = draw()
+    mx.random.advance(997)
+    a2 = draw()
+
+    mx.random.seed(3)
+    b1 = draw()
+    b2 = draw()
+    assert a1.tobytes() == b1.tobytes()
+    # the jump changes the continuation — the poisoned window's keys
+    # are never re-drawn after a rollback
+    assert a2.tobytes() != b2.tobytes()
+
+    # and the jump itself is deterministic
+    mx.random.seed(3)
+    draw()
+    mx.random.advance(997)
+    c2 = draw()
+    assert c2.tobytes() == a2.tobytes()

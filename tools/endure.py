@@ -25,14 +25,36 @@ faultline plan in two phases:
    restore, recompile — is excluded, which is the "within N steps"
    clause).
 
+Three GRAY-failure phases follow (``MXTPU_CHAOS_GRAY=0`` opts out,
+ISSUE 14):
+
+3. **Straggler demotion** — seeded ``slow`` faults delay rank 1's data
+   fetch for two consecutive steps; the per-rank step-time stamps make
+   the :class:`StragglerPolicy` declare it DEGRADED and the supervisor
+   re-shards 3 -> 2 exactly like a death, with
+   ``mxtpu_node_degraded_total{rank="1"}`` ticked and per-host
+   throughput back to >= 95% of the pre-fault clean baseline.
+4. **Bitflip caught in-program** — ``MXNET_KVSTORE_INTEGRITY=1`` plus a
+   planned ``bitflip`` at ``collective.dispatch``: the digest sideband
+   trips inside the fused launch, the trainer's step-guard skips the
+   update with params BITWISE unchanged, and
+   ``mxtpu_integrity_violations_total`` /
+   ``mxtpu_train_steps_skipped_total`` tick.
+5. **Divergence auto-rollback** — a ``bitflip`` on the data iterator
+   (exponent bit of element 0) spikes the loss; the
+   :class:`DivergenceSentinel` trips, the supervisor rolls back to the
+   newest complete checkpoint (``mxtpu_sentinel_rollbacks_total`` += 1,
+   within ``MXNET_SENTINEL_ROLLBACKS``) and the run completes with
+   finite parameters.
+
 Deterministic: data is a pure function of (rank, step), faults are
 arrival-indexed plans, checkpoints are every-step — a failing run
 replays exactly.  Run directly::
 
     python -m tools.endure --gate
 
-Prints one ``endure_verdict: PASS|FAIL`` line; ``--gate`` exits nonzero
-on FAIL.
+Prints an ``endure_verdict: PASS|FAIL`` line (and a ``gray_verdict``
+line unless opted out); ``--gate`` exits nonzero when either fails.
 """
 from __future__ import annotations
 
@@ -72,6 +94,14 @@ KILL_POLL = 6        # liveness poll on which rank 1's heartbeat dies
 RECOVER_WINDOW = 4   # post-reshard steps the throughput gate averages
 WARMUP = 2           # leading compile steps excluded from the baseline
 THROUGHPUT_FLOOR = 0.95
+
+# gray phases
+CLEAN_STEPS = 4      # straggler phase: clean baseline before the slow window
+SLOW_STEPS = 2       # consecutive slow fetches = StragglerPolicy windows
+SLOW_DELAY = 0.25    # injected per-fetch delay (seconds) on the straggler
+BASE_STAMP = 0.01    # deterministic stamp floor so micro-jitter on the
+                     # healthy ranks' ~us fetches can never fake a 3x ratio
+DIVERGE_STEP = 5     # step whose batch the exponent bitflip poisons
 
 
 def _host_batch(t, rank):
@@ -233,6 +263,246 @@ def _phase_dead_node(root):
     return checks, extra
 
 
+class _GrayJob(_Job):
+    """The straggler-phase job: stamps per-RANK step times itself (each
+    rank's data fetch is timed around the ``data.iterator`` faultline
+    hook, where the planned ``slow`` specs fire), so the supervisor's
+    own wall timing — which cannot tell ranks apart in one process —
+    stays out of the way (``stamps_steptimes``)."""
+
+    stamps_steptimes = True
+
+    def __init__(self, world, pod):
+        super().__init__(world)
+        self._pod = pod
+
+    def run_step(self, t):
+        t0 = time.perf_counter()
+        parts, fetch = [], {}
+        for r in self.world.ranks:
+            f0 = time.perf_counter()
+            faultline.check("data.iterator")
+            parts.append(_host_batch(t, r))
+            fetch[r] = time.perf_counter() - f0
+        x = mx.np.array(onp.concatenate(parts, axis=0))
+        xs = split_and_load(x, self.ctxs)
+        with autograd.record():
+            ls = [(self.net(xb) ** 2).mean() for xb in xs]
+        autograd.backward(ls)
+        self.trainer.step(PER_HOST_BATCH * len(self.ctxs))
+        mx.waitall()
+        for r in self.world.ranks:
+            self._pod.record_steptime(BASE_STAMP + fetch[r], rank=r)
+        self.step_seconds.append(
+            (t, time.perf_counter() - t0, self.world.size))
+
+
+def _phase_straggler(root):
+    """Gray phase: rank 1 turns 25x slower, gets demoted and resharded
+    away, and the survivors keep their pre-fault per-host pace."""
+    faultline.clear()
+    world = ElasticWorld.fresh(HOSTS)
+    pod = EmulatedPod(world.ranks)
+    # one data.iterator arrival per rank per step (ranks in sorted
+    # order): step t, rank r arrives as 3t + r + 1.  Rank 1's fetch is
+    # slowed for SLOW_STEPS consecutive steps right after the clean
+    # baseline — exactly the StragglerPolicy's window count, so the
+    # demotion lands on the check after the second slow step and no
+    # slow spec is left to hit a survivor's arrivals post-reshard.
+    faultline.plan([
+        {"site": "data.iterator", "kind": "slow", "delay": SLOW_DELAY,
+         "at": HOSTS * (CLEAN_STEPS + k) + 2}
+        for k in range(SLOW_STEPS)])
+
+    reg = telemetry.default_registry()
+    deg0 = reg.get_sample_value(
+        "mxtpu_node_degraded_total", {"rank": "1"}) or 0
+    res0 = reg.get_sample_value("mxtpu_elastic_reshards_total") or 0
+
+    times = []
+
+    def build(w):
+        job = _GrayJob(w, pod)
+        job.step_seconds = times
+        return job
+
+    mgr = CheckpointManager(os.path.join(root, "straggler"),
+                            async_write=False, rank=0)
+    sup = ElasticSupervisor(build, mgr, world=world, pod=pod,
+                            elastic=True, min_world=2, scaling="linear")
+    handle = sup.run(STEPS_B, checkpoint_every=1)
+    faultline.clear()
+    mgr.close()
+
+    degraded = (reg.get_sample_value(
+        "mxtpu_node_degraded_total", {"rank": "1"}) or 0) - deg0
+    reshards = (reg.get_sample_value(
+        "mxtpu_elastic_reshards_total") or 0) - res0
+    # pre-fault clean baseline (full world, before the slow window,
+    # compile warmup excluded) vs the last RECOVER_WINDOW survivor steps
+    pre = [dt for t, dt, size in times
+           if size == HOSTS and WARMUP <= t < CLEAN_STEPS]
+    post = [dt for _t, dt, size in times if size == HOSTS - 1]
+    post = post[-RECOVER_WINDOW:]
+    ratio = (statistics.median(pre) / statistics.median(post)
+             if pre and post else 0.0)
+    finite = all(onp.isfinite(a).all()
+                 for a in handle.params_np().values())
+    sup.close()
+    checks = {
+        "straggler_demoted": degraded == 1,
+        "straggler_resharded": reshards == 1,
+        "straggler_survivors": sup.world.ranks == (0, 2),
+        "straggler_params_finite": finite,
+        "straggler_throughput": ratio >= THROUGHPUT_FLOOR,
+    }
+    return checks, {"straggler_ratio": ratio}
+
+
+def _phase_bitflip(root):
+    """Gray phase: a payload bit flips inside the bucketed allreduce;
+    the integrity sideband catches it IN-PROGRAM and the step-guard
+    keeps the parameters bitwise untouched that step."""
+    del root  # no checkpoints needed: the guard must prevent the damage
+    faultline.clear()
+    reg = telemetry.default_registry()
+    vio0 = reg.get_sample_value(
+        "mxtpu_integrity_violations_total",
+        {"site": "collective.dispatch"}) or 0
+    skip0 = reg.get_sample_value("mxtpu_train_steps_skipped_total") or 0
+    rec0 = reg.get_sample_value(
+        "mxtpu_faults_recovered_total",
+        {"site": "collective.dispatch", "kind": "bitflip"}) or 0
+
+    # mxlint: disable=env-read-at-trace-time -- host-side save/restore of the chaos scenario's knob, before any trace exists for this phase's fresh job
+    prev = os.environ.get("MXNET_KVSTORE_INTEGRITY")
+    os.environ["MXNET_KVSTORE_INTEGRITY"] = "1"
+    try:
+        job = _Job(ElasticWorld.fresh(HOSTS))
+        for t in range(2):          # clean steps: integrity mode is quiet
+            job.run_step(t)
+        before = {k: v.tobytes() for k, v in job.params_np().items()}
+        # the payload channel counts bitflip arrivals separately, so
+        # at=1 is the NEXT bucket launch — rank 1's shard gets the flip
+        faultline.plan([{"site": "collective.dispatch", "kind": "bitflip",
+                         "at": 1, "seed": 5, "rank": 1}])
+        job.run_step(2)             # corrupted: caught, update skipped
+        after = {k: v.tobytes() for k, v in job.params_np().items()}
+        faultline.clear()
+        job.run_step(3)             # clean again: training resumes
+        resumed = {k: v.tobytes() for k, v in job.params_np().items()}
+    finally:
+        if prev is None:
+            # mxlint: disable=env-read-at-trace-time -- host-side restore of the saved knob on scenario exit; nothing traces here
+            os.environ.pop("MXNET_KVSTORE_INTEGRITY", None)
+        else:
+            os.environ["MXNET_KVSTORE_INTEGRITY"] = prev
+        faultline.clear()
+
+    violations = (reg.get_sample_value(
+        "mxtpu_integrity_violations_total",
+        {"site": "collective.dispatch"}) or 0) - vio0
+    skipped = (reg.get_sample_value(
+        "mxtpu_train_steps_skipped_total") or 0) - skip0
+    recovered = (reg.get_sample_value(
+        "mxtpu_faults_recovered_total",
+        {"site": "collective.dispatch", "kind": "bitflip"}) or 0) - rec0
+    checks = {
+        "bitflip_caught": violations >= 1,
+        "bitflip_step_skipped": skipped == 1,
+        "bitflip_params_unchanged": before == after,
+        "bitflip_recovered": recovered == 1,
+        "bitflip_training_resumed": resumed != after,
+    }
+    return checks, {"bitflip_violations": violations}
+
+
+class _DivergeJob(_Job):
+    """The divergence-phase job: the global batch passes through the
+    ``data.iterator`` corruption hook (where the planned ``bitflip``
+    flips an exponent bit), and ``run_step`` returns the synced loss so
+    the supervisor's :class:`DivergenceSentinel` sees it."""
+
+    def run_step(self, t):
+        t0 = time.perf_counter()
+        batch = faultline.corrupt("data.iterator",
+                                  _global_batch(t, self.world.ranks))
+        x = mx.np.array(batch)
+        xs = split_and_load(x, self.ctxs)
+        with autograd.record():
+            ls = [(self.net(xb) ** 2).mean() for xb in xs]
+        autograd.backward(ls)
+        self.trainer.step(PER_HOST_BATCH * len(self.ctxs))
+        mx.waitall()
+        loss = float(sum(float(l.asnumpy()) for l in ls) / len(ls))
+        self.step_seconds.append(
+            (t, time.perf_counter() - t0, self.world.size))
+        return loss
+
+
+def _phase_divergence(root):
+    """Gray phase: a poisoned batch spikes the loss; the supervisor
+    rolls back to the newest complete checkpoint once and the run
+    completes with finite parameters."""
+    faultline.clear()
+    world = ElasticWorld.fresh(HOSTS)
+    reg = telemetry.default_registry()
+    rb0 = reg.get_sample_value("mxtpu_sentinel_rollbacks_total") or 0
+
+    # flip the exponent MSB of element 0 of step DIVERGE_STEP's batch
+    # (one corrupt call per step, so the payload arrival IS step+1):
+    # ~1e38 activations square into an inf/huge loss — a spike the
+    # sentinel must catch BEFORE the step is counted or checkpointed
+    faultline.plan([{"site": "data.iterator", "kind": "bitflip",
+                     "at": DIVERGE_STEP + 1, "seed": 9,
+                     "index": 0, "bit": 30}])
+    mgr = CheckpointManager(os.path.join(root, "diverge"),
+                            async_write=False, rank=0)
+    sup = ElasticSupervisor(_DivergeJob, mgr, world=world,
+                            pod=EmulatedPod(world.ranks), elastic=True,
+                            min_world=2, scaling="linear")
+    handle = sup.run(STEPS_B, checkpoint_every=1)
+    faultline.clear()
+    mgr.close()
+
+    rollbacks = (reg.get_sample_value(
+        "mxtpu_sentinel_rollbacks_total") or 0) - rb0
+    finite = all(onp.isfinite(a).all()
+                 for a in handle.params_np().values())
+    steps_run = max(t for t, _dt, _s in handle.step_seconds) + 1
+    sup.close()
+    checks = {
+        "diverge_rolled_back_once": rollbacks == 1,
+        "diverge_run_completed": steps_run == STEPS_B,
+        "diverge_params_finite": finite,
+    }
+    return checks, {"diverge_rollbacks": rollbacks}
+
+
+def run_gray(root):
+    t0 = time.perf_counter()
+    checks_s, extra_s = _phase_straggler(root)
+    checks_f, extra_f = _phase_bitflip(root)
+    checks_d, extra_d = _phase_divergence(root)
+    checks = dict(checks_s, **checks_f, **checks_d)
+    ok = all(checks.values())
+    wall = time.perf_counter() - t0
+    fail_bits = "" if ok else " FAILED: " + ",".join(
+        k for k, v in checks.items() if not v)
+    verdict = (
+        f"gray_verdict: {'PASS' if ok else 'FAIL'} — straggler rank 1 "
+        f"demoted+resharded (per-host throughput "
+        f"{extra_s['straggler_ratio']:.2f}x pre-fault, floor "
+        f"{THROUGHPUT_FLOOR}), bitflip caught in-program "
+        f"({extra_f['bitflip_violations']:.0f} violation(s), params "
+        f"bitwise-unchanged that step), divergence rolled back "
+        f"{extra_d['diverge_rollbacks']:.0f}x and completed, "
+        f"wall={wall:.1f}s{fail_bits}")
+    summary = dict(checks, **extra_s, **extra_f, **extra_d,
+                   gray_wall=wall)
+    return verdict, ok, summary
+
+
 def run_endure(root):
     t0 = time.perf_counter()
     checks_a, extra_a = _phase_preempt(root)
@@ -255,6 +525,17 @@ def run_endure(root):
     return verdict, ok, summary
 
 
+def _run_all(root):
+    verdict, ok, _ = run_endure(root)
+    print(verdict)
+    # mxlint: disable=env-read-at-trace-time -- CI gate opt-out read once per endure run, host-side only
+    if os.environ.get("MXTPU_CHAOS_GRAY", "1") != "0":
+        gray_verdict, gray_ok, _ = run_gray(root)
+        print(gray_verdict)
+        ok = ok and gray_ok
+    return ok
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--gate", action="store_true",
@@ -264,11 +545,10 @@ def main(argv=None):
     args = ap.parse_args(argv)
     import tempfile
     if args.root:
-        verdict, ok, _ = run_endure(args.root)
+        ok = _run_all(args.root)
     else:
         with tempfile.TemporaryDirectory(prefix="mxtpu-endure-") as root:
-            verdict, ok, _ = run_endure(root)
-    print(verdict)
+            ok = _run_all(root)
     return 1 if (args.gate and not ok) else 0
 
 

@@ -231,6 +231,40 @@ assert _spa > _spb, "sharded feed must account bytes under kind=shard_put"
 _it.close()
 print("smoke: input pipeline ok (sharded readers + dp global feed)")
 
+# 2e. flaky-kv retry-storm gate (ISSUE 14): a burst of intermittent
+# ConnectionErrors at the pushpull site must be absorbed by the
+# per-rank-jittered bounded-backoff retry policy — every pushpull
+# completes, the storm is visible in mxtpu_kvstore_retries_total, and
+# the recoveries are booked under kind="flaky" (not "timeout") — all
+# inside a 10 s wall budget
+import time as _time
+_fl.clear()
+_fl.plan([{"site": "kvstore.pushpull", "kind": "flaky",
+           "at": 3 * _k + 1, "times": 2, "seed": _k} for _k in range(6)])
+_ret_b = _reg.get_sample_value(
+    "mxtpu_kvstore_retries_total", {"site": "kvstore.pushpull"}) or 0.0
+_rec_b = _reg.get_sample_value(
+    "mxtpu_faults_recovered_total",
+    {"site": "kvstore.pushpull", "kind": "flaky"}) or 0.0
+_skv = _kvs.create("tpu_ici")
+_sval = mx.np.array(onp.ones(8, dtype=onp.float32))
+_t0 = _time.monotonic()
+for _i in range(12):
+    _skv.pushpull(_i, _sval)
+_storm_wall = _time.monotonic() - _t0
+_fl.clear()
+_ret_d = (_reg.get_sample_value(
+    "mxtpu_kvstore_retries_total", {"site": "kvstore.pushpull"}) or 0.0
+    ) - _ret_b
+_rec_d = (_reg.get_sample_value(
+    "mxtpu_faults_recovered_total",
+    {"site": "kvstore.pushpull", "kind": "flaky"}) or 0.0) - _rec_b
+assert _ret_d >= 1, "flaky storm produced no retries"
+assert _rec_d >= 1, "recoveries not booked under kind=flaky"
+assert _storm_wall < 10.0, f"retry storm blew the wall budget: {_storm_wall}"
+print(f"smoke: flaky-kv retry storm ok ({int(_ret_d)} retries, "
+      f"{int(_rec_d)} flaky recoveries, {_storm_wall:.1f}s)")
+
 # 3. bench.py must at least import (its main guard must not run)
 import importlib.util as _u
 spec = _u.spec_from_file_location("bench", "bench.py")
@@ -244,9 +278,13 @@ EOF
 # HLO level; the full artifact set runs in ci.sh's hloscan stage.  The
 # block-scaled programs (ISSUE 11) are pinned here too: quantize +
 # scale-agreement pmax + payload psum + dequantize must stay ONE launch
-# per bucket (2 all-reduce ops, zero extra dispatches)
+# per bucket (2 all-reduce ops, zero extra dispatches).  The integrity
+# variants (ISSUE 14) are pinned too: the digest-agreement sideband must
+# cost exactly one extra collective in the SAME program, never a second
+# launch
 python -m tools.hloscan allreduce.bucket_dense allreduce.bucket_2bit \
   allreduce.bucket_int8 allreduce.bucket_fp8 \
+  allreduce.bucket_dense_integrity allreduce.bucket_int8_integrity \
   allreduce.bucketed_step allreduce.bucketed_step_int8 \
   --verdicts --no-metrics
 echo "smoke: hloscan allreduce contracts ok"
@@ -275,7 +313,7 @@ EOF
 # 4. the driver entry points compile on the virtual mesh (the full
 # hloscan + census dryrun riders run in ci.sh's dryrun stage, not here)
 MXTPU_DRYRUN_HLOSCAN=0 MXTPU_DRYRUN_CENSUS=0 MXTPU_DRYRUN_RESILIENCE=0 \
-  MXTPU_DRYRUN_FLEET=0 \
+  MXTPU_DRYRUN_FLEET=0 MXTPU_DRYRUN_GRAY=0 \
   python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
