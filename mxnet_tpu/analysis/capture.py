@@ -144,6 +144,74 @@ def _capture_fused_train_step():
     }
 
 
+def build_recipe_fused_step():
+    """The recipe-built dp2.tp2 FusedTrainStep: the same small MLP as
+    `build_dp_fused_step`, but the whole SPMD setup comes from the one
+    config string — mesh, collected Dense rules, strict coverage audit,
+    input spec.  d2 takes a row-split override (Megatron column->row
+    pair), exercising user-override precedence over the block defaults.
+    Returns ``(fused, (x, y), batch_size, meta)``."""
+    import numpy as onp
+    from jax.sharding import PartitionSpec as P
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import FusedTrainStep, Trainer, loss as gloss, nn
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class _NetWithLoss(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d1 = nn.Dense(16, in_units=8)
+            self.d2 = nn.Dense(8, in_units=16)
+            self.loss_fn = gloss.SoftmaxCrossEntropyLoss()
+
+        def forward(self, x, y):
+            return self.loss_fn(self.d2(self.d1(x)), y)
+
+    rng = onp.random.RandomState(7)
+    mod = _NetWithLoss()
+    mod.initialize()
+    tr = Trainer(mod.collect_params(), "sgd",
+                 {"learning_rate": 0.1, "momentum": 0.9})
+    fused = FusedTrainStep(
+        mod, tr, recipe="dp2.tp2",
+        partition_rules=[(r"d2\.weight$", P(None, "tp")),
+                         (r"d2\.bias$", P())])
+    x = mx.np.array(rng.uniform(-1, 1, (16, 8)).astype(onp.float32))
+    y = mx.np.array(rng.randint(0, 8, (16,)), dtype="int32")
+    return fused, (x, y), 16, {"mesh": "dp:2,tp:2", "recipe": "dp2.tp2",
+                               "params": 4, "batch": 16}
+
+
+@_entrypoint("fused_train_step.recipe_tp2")
+def _capture_recipe_fused_step():
+    """FusedTrainStep(recipe="dp2.tp2") on the small MLP: the compiled
+    tensor-parallel step a recipe builds, captured through the same
+    `_prepare` path a live step dispatches.  The resharding_free pin is
+    the recipe subsystem's compile-time fence: if rule collection or
+    placement ever disagrees with what the program computes, GSPMD
+    inserts reshard transfers and this artifact fails the scan."""
+    fused, args, batch_size, meta = build_recipe_fused_step()
+    traced = fused.trace(*args, batch_size=batch_size)
+    jaxpr, low, opt = _stage_texts(traced)
+    # census: one gradient psum per trainable tensor (4 — tp-sharded
+    # grads still psum, over the dp axis only) plus the Megatron pair's
+    # activation all-reduces in forward and backward (row-split d2
+    # partial outputs, column-split d1 input grads, and the loss
+    # reduction), as XLA schedules them on the 2x2 mesh: 8 issues, no
+    # all-gather / all-to-all / collective-permute (resharding-free).
+    return {
+        "name": "fused_train_step.recipe_tp2", "kind": "train_step",
+        "jaxpr": jaxpr, "lowered": low, "optimized": opt,
+        "contract": {
+            "expect_overlap": True,
+            "resharding_free": True,
+            "expected_collectives": {"all-reduce": 8},
+        },
+        "meta": meta,
+    }
+
+
 # --------------------------------------------------------------------------
 # kvstore collectives
 # --------------------------------------------------------------------------

@@ -157,6 +157,21 @@ MXNET_SENTINEL_LOSS_FACTOR   divergence-rollback threshold for
 MXNET_SENTINEL_ROLLBACKS     divergence rollbacks the supervisor takes
                              before surfacing ``DivergenceError``
                              (default 2; read at supervisor creation)
+MXNET_PARALLEL_RECIPE        default sharding recipe string
+                             (``"dp2.tp2"`` etc., grammar in
+                             docs/SHARDING.md) used by
+                             ``FusedTrainStep``/dryrun when the caller
+                             passes neither ``mesh`` nor ``recipe``
+                             (default unset: plain dp over all devices;
+                             read when a fused step is constructed)
+MXNET_RECIPE_STRICT          overrides the recipe's auto strict-coverage
+                             policy: ``1`` forces the placement audit to
+                             raise on any non-scalar param no partition
+                             rule matched, ``0`` always allows the
+                             replicated fallback (default unset = auto:
+                             strict whenever the recipe has a non-dp
+                             axis of size > 1; read when a recipe's
+                             strictness is resolved)
 MXNET_KVSTORE_INTEGRITY      ``1`` turns on the allreduce integrity
                              sideband: a per-device digest of each
                              bucket's psum result is agreement-checked
@@ -178,7 +193,8 @@ __all__ = ["apply", "describe", "is_naive_engine", "cpu_worker_nthreads",
            "serve_replicas", "serve_deadline_ms", "serve_eject_after",
            "elastic_enabled", "elastic_min_world", "elastic_scaling",
            "sentinel_slow_factor", "sentinel_loss_factor",
-           "sentinel_rollbacks", "kvstore_integrity"]
+           "sentinel_rollbacks", "kvstore_integrity",
+           "parallel_recipe", "recipe_strict"]
 
 _naive_engine = False
 
@@ -306,6 +322,26 @@ def kvstore_integrity(default=False):
     return v not in ("0", "")
 
 
+def parallel_recipe(default=None):
+    """Default sharding recipe string for FusedTrainStep/dryrun when the
+    caller passes neither mesh nor recipe (None = plain dp)."""
+    v = os.environ.get("MXNET_PARALLEL_RECIPE")
+    if v is None or not v.strip():
+        return default
+    return v.strip()
+
+
+def recipe_strict(default=None):
+    """Tri-state strict-coverage override for sharding recipes: None
+    (unset — the recipe's auto policy applies), True (``1``: the audit
+    raises on uncovered non-scalar params), or False (``0``: always
+    allow the replicated fallback)."""
+    v = os.environ.get("MXNET_RECIPE_STRICT")
+    if v is None or v == "":
+        return default
+    return v != "0"
+
+
 def apply():
     """Read the environment once at package import."""
     global _naive_engine
@@ -361,5 +397,6 @@ def describe():
              "MXNET_SERVE_EJECT_AFTER", "MXNET_ELASTIC",
              "MXNET_ELASTIC_MIN_WORLD", "MXNET_ELASTIC_SCALING",
              "MXNET_SENTINEL_SLOW_FACTOR", "MXNET_SENTINEL_LOSS_FACTOR",
-             "MXNET_SENTINEL_ROLLBACKS", "MXNET_KVSTORE_INTEGRITY"]
+             "MXNET_SENTINEL_ROLLBACKS", "MXNET_KVSTORE_INTEGRITY",
+             "MXNET_PARALLEL_RECIPE", "MXNET_RECIPE_STRICT"]
     return [(n, os.environ.get(n), n in __doc__) for n in names]

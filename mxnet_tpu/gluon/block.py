@@ -121,6 +121,44 @@ class Block:
         tooling like Monitor walks this instead of `_children`)."""
         return dict(self._children)
 
+    # -- partition-rule collection (parallel.recipe) -----------------------
+    def collect_partition_rules(self, axes, prefix=""):
+        """Gather per-block ``partition_rules()`` over the child tree,
+        anchored at each block's parameter structure path — the rule
+        source a :class:`~mxnet_tpu.parallel.ShardingRecipe` merges with
+        user overrides.
+
+        ``axes`` is the set of mesh axis names the recipe provides.  A
+        block exposing ``partition_rules(axis_name=..., prefix=...)``
+        (MoEFFN, GPipeMLP, nn.Dense, MultiHeadAttention, ...) contributes
+        its rules when its default ``axis_name`` is in ``axes``; a block
+        whose axis is absent (an MoE layer under a dp.tp recipe with no
+        ``ep``) contributes nothing and its params fall through to
+        replicated.  Traversal is pre-order — a parent's rules precede
+        its children's, so a composite layer that knows its children's
+        roles (MultiHeadAttention marking ``proj`` row-parallel) wins
+        over the child's generic default (Dense's column-parallel) under
+        first-match-wins.
+        """
+        import inspect
+
+        axes = set(axes)
+        rules = []
+        fn = getattr(type(self), "partition_rules", None)
+        if callable(fn):
+            try:
+                axis = inspect.signature(fn).parameters["axis_name"].default
+            except (KeyError, ValueError):
+                axis = None
+            if axis in axes:
+                anchor = ("^" + re.escape(prefix) + r"\.") if prefix \
+                    else "^"
+                rules += list(fn(axis_name=axis, prefix=anchor))
+        for name, child in self._children.items():
+            child_prefix = f"{prefix}.{name}" if prefix else name
+            rules += child.collect_partition_rules(axes, child_prefix)
+        return rules
+
     # -- lifecycle ---------------------------------------------------------
     def initialize(self, init=None, ctx=None, verbose=False,
                    force_reinit=False):

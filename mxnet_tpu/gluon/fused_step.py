@@ -51,10 +51,23 @@ class FusedTrainStep:
 
     >>> mesh = parallel.make_mesh({"dp": -1})
     >>> step = FusedTrainStep(mod, trainer, mesh=mesh)
+
+    **Recipes**: pass ``recipe`` (a `parallel.ShardingRecipe` or its
+    config string, e.g. ``"dp2.tp2"``) and the whole SPMD setup derives
+    from it — the mesh is built (unless an explicit ``mesh`` narrows the
+    device set), the partition rules are collected from every block's
+    ``partition_rules()`` over the tree (with ``partition_rules=``
+    overrides checked first), the input spec comes from the recipe's data
+    axes, and placement runs the strict coverage audit under tp/pp
+    recipes.  With neither ``mesh`` nor ``recipe``, the
+    ``MXNET_PARALLEL_RECIPE`` environment default applies (unset: the
+    single-device step).
+
+    >>> step = FusedTrainStep(mod, trainer, recipe="dp2.tp2")
     """
 
     def __init__(self, block, trainer, mesh=None, partition_rules=None,
-                 data_spec=None, scaler=None):
+                 data_spec=None, scaler=None, recipe=None):
         self._block = block
         self._trainer = trainer
         # loss scaler (amp): scales the backward seed in-program, and the
@@ -65,6 +78,17 @@ class FusedTrainStep:
         # finite-grad verdict of the last dispatched step (device scalar;
         # reading it as bool() syncs).  None until the first step.
         self.last_step_finite = None
+        if recipe is None and mesh is None:
+            from .. import env as _env
+            recipe = _env.parallel_recipe()
+        self._recipe = None
+        if recipe is not None:
+            from ..parallel.recipe import ShardingRecipe
+            self._recipe = ShardingRecipe(recipe)
+            if mesh is None:
+                mesh = self._recipe.build_mesh()
+            if data_spec is None:
+                data_spec = self._recipe.data_spec()
         self._mesh = mesh
         self._rules = partition_rules or []
         if mesh is not None and data_spec is None:
@@ -117,7 +141,15 @@ class FusedTrainStep:
 
         self._global_put = global_put
         mesh, trainer = self._mesh, self._trainer
-        specs = shard_parameters(params, mesh, self._rules)
+        if self._recipe is not None:
+            # explicit partition_rules act as overrides: checked before
+            # the block tree's collected rules (first match wins)
+            rules = self._recipe.collect_rules(self._block,
+                                               overrides=self._rules)
+            strict = self._recipe.strict()
+        else:
+            rules, strict = self._rules, False
+        specs = shard_parameters(params, mesh, rules, strict=strict)
         names = sorted(params)
         rep = NamedSharding(mesh, PartitionSpec())
         self._rep = rep

@@ -8,6 +8,7 @@ Sequential...).  Each forward is written in mx ops, so it runs eagerly op-by
 from __future__ import annotations
 
 import numpy as onp
+from jax.sharding import PartitionSpec as _P
 
 from ... import numpy as mxnp
 from ... import numpy_extension as npx
@@ -106,6 +107,26 @@ class Dense(HybridBlock):
             out = self.act(out)
         return out
 
+    @staticmethod
+    def partition_rules(axis_name="tp", prefix=".*", flavor="column"):
+        """Megatron-style tensor-parallel rules (parallel.recipe).  The
+        weight is stored (out, in); the default ``column`` split shards
+        the output dim (dim 0) and the bias with it.  ``row`` — for a
+        layer whose output is summed over the tp group (attention proj,
+        FFN-out) — shards the input dim and replicates the bias; a
+        composite parent (MultiHeadAttention) or a user override picks
+        it, since a lone Dense cannot know its role.  Either placement
+        is numerically identical: shardings steer layout, XLA's SPMD
+        partitioner inserts the collectives."""
+        if flavor == "column":
+            return [(prefix + r"weight$", _P(axis_name, None)),
+                    (prefix + r"bias$", _P(axis_name))]
+        if flavor == "row":
+            return [(prefix + r"weight$", _P(None, axis_name)),
+                    (prefix + r"bias$", _P())]
+        raise ValueError(
+            f"flavor must be 'column' or 'row', got {flavor!r}")
+
     def __repr__(self):
         return (f"Dense({self._units}, linear)" if self.act is None else
                 f"Dense({self._units}, {self._activation})")
@@ -142,6 +163,21 @@ class Embedding(HybridBlock):
         return npx.embedding(x, self.weight.data(), input_dim=self._input_dim,
                              output_dim=self._output_dim,
                              sparse_grad=self._sparse_grad)
+
+    @staticmethod
+    def partition_rules(axis_name="tp", prefix=".*"):
+        """Shard the vocab dim (dim 0) over the tp axis — the Megatron
+        embedding placement `bert_partition_rules` uses, so a tied MLM
+        decoder matmul contracts locally and all-reduces once."""
+        return [(prefix + r"weight$", _P(axis_name, None))]
+
+
+def _norm_partition_rules(prefix):
+    """Explicit replication for per-channel norm vectors: gamma/beta
+    (and BatchNorm moving stats) are genuinely replicated under tensor
+    parallelism, and saying so keeps them COVERED under a strict tp/pp
+    recipe audit instead of reading as forgotten fall-throughs."""
+    return [(prefix + r"(gamma|beta|running_mean|running_var)$", _P())]
 
 
 class BatchNorm(HybridBlock):
@@ -190,6 +226,10 @@ class BatchNorm(HybridBlock):
             momentum=self._momentum, fix_gamma=not self._scale,
             use_global_stats=self._use_global_stats, axis=self._axis)
 
+    @staticmethod
+    def partition_rules(axis_name="tp", prefix=".*"):
+        return _norm_partition_rules(prefix)
+
 
 class SyncBatchNorm(BatchNorm):
     """Reference `contrib/nn/basic_layers.py` SyncBatchNorm: cross-device
@@ -226,6 +266,10 @@ class LayerNorm(HybridBlock):
         return npx.layer_norm(x, self.gamma.data(), self.beta.data(),
                               axis=self._axis, eps=self._epsilon)
 
+    @staticmethod
+    def partition_rules(axis_name="tp", prefix=".*"):
+        return _norm_partition_rules(prefix)
+
 
 class GroupNorm(HybridBlock):
     def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
@@ -251,6 +295,10 @@ class GroupNorm(HybridBlock):
         return npx.group_norm(x, self.gamma.data(), self.beta.data(),
                               num_groups=self._num_groups, eps=self._epsilon)
 
+    @staticmethod
+    def partition_rules(axis_name="tp", prefix=".*"):
+        return _norm_partition_rules(prefix)
+
 
 class InstanceNorm(HybridBlock):
     def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
@@ -274,6 +322,10 @@ class InstanceNorm(HybridBlock):
                 p.finish_deferred_init()
         return npx.instance_norm(x, self.gamma.data(), self.beta.data(),
                                  eps=self._epsilon)
+
+    @staticmethod
+    def partition_rules(axis_name="tp", prefix=".*"):
+        return _norm_partition_rules(prefix)
 
 
 class Flatten(HybridBlock):

@@ -218,12 +218,23 @@ class GradBucketer:
 
     # -- planning ----------------------------------------------------------
     @staticmethod
-    def _signature(items):
+    def _value_spec(vals):
+        """The value's PartitionSpec as a string ("" when unsharded).
+        Recipe-sharded params group buckets by (dtype, devices, spec):
+        packing a tp-column-split tensor with a replicated one into one
+        flat buffer would force an all-gather before the psum — same-spec
+        buckets keep the dp-axis-only reduce the compiled step has."""
+        sharding = getattr(vals[0]._data, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        return "" if spec is None else str(spec)
+
+    @classmethod
+    def _signature(cls, items):
         from .tpu_ici import _value_devices
 
         return tuple(
             (key, tuple(vals[0].shape), str(onp.dtype(vals[0]._data.dtype)),
-             tuple(_value_devices(vals)))
+             tuple(_value_devices(vals)), cls._value_spec(vals))
             for key, vals in items)
 
     def _build_plan(self, items):
@@ -234,7 +245,7 @@ class GradBucketer:
             v0 = vals[0]
             dtype = onp.dtype(v0._data.dtype)
             devs = tuple(_value_devices(vals))
-            gkey = (str(dtype), devs)
+            gkey = (str(dtype), devs, self._value_spec(vals))
             size = int(v0.size)
             nbytes = size * dtype.itemsize
             b = open_by_group.get(gkey)
@@ -671,8 +682,8 @@ class GradBucketer:
         import hashlib
 
         device_free = tuple(
-            (key, shape, dtype, len(devs))
-            for key, shape, dtype, devs in sig)
+            (key, shape, dtype, len(devs), spec)
+            for key, shape, dtype, devs, spec in sig)
         return hashlib.sha1(repr(device_free).encode()).hexdigest()
 
     def export_residuals(self):

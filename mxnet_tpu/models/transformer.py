@@ -139,6 +139,21 @@ class MultiHeadAttention(HybridBlock):
         self._sp_batch_axis = batch_axis
         return self
 
+    @staticmethod
+    def partition_rules(axis_name="tp", prefix=".*"):
+        """Megatron attention sharding: Q/K/V column-split (weight dim 0 +
+        bias), the output projection row-split with a replicated bias.
+        Collected by ``Block.collect_partition_rules`` BEFORE the child
+        Dense blocks' generic rules, so proj gets its row split instead of
+        the Dense default column."""
+        return [
+            (prefix + r"(query|key|value)\.weight$",
+             PartitionSpec(axis_name, None)),
+            (prefix + r"(query|key|value)\.bias$", PartitionSpec(axis_name)),
+            (prefix + r"proj\.weight$", PartitionSpec(None, axis_name)),
+            (prefix + r"proj\.bias$", PartitionSpec()),
+        ]
+
     def _flash_now(self, t, mask):
         """Resolve the use_flash policy for this call (T is trace-static,
         so the choice bakes into the compiled program per shape).  When a
@@ -240,6 +255,18 @@ class PositionwiseFFN(HybridBlock):
 
     def forward(self, x):
         return self.dropout(self.ffn_2(self.act(self.ffn_1(x))))
+
+    @staticmethod
+    def partition_rules(axis_name="tp", prefix=".*"):
+        """Megatron FFN sharding: ffn_1 column-split (weight dim 0 + bias),
+        ffn_2 row-split with a replicated bias — the pair contracts locally
+        and all-reduces once."""
+        return [
+            (prefix + r"ffn_1\.weight$", PartitionSpec(axis_name, None)),
+            (prefix + r"ffn_1\.bias$", PartitionSpec(axis_name)),
+            (prefix + r"ffn_2\.weight$", PartitionSpec(None, axis_name)),
+            (prefix + r"ffn_2\.bias$", PartitionSpec()),
+        ]
 
 
 class TransformerEncoderLayer(HybridBlock):
@@ -359,6 +386,15 @@ class BertModel(HybridBlock):
         self.encoder.bind_sp_mesh(mesh, axis_name, batch_axis)
         return self
 
+    @staticmethod
+    def partition_rules(axis_name="tp", prefix=".*"):
+        """Root-level params the child blocks cannot cover: the position
+        embedding table is explicitly replicated (its sequence dim is not
+        a tensor-parallel axis).  Everything else comes from the child
+        blocks' own rules (Embedding vocab split, attention/FFN Megatron
+        splits, norm replication)."""
+        return [(prefix + r"position_embed$", PartitionSpec())]
+
     def forward(self, tokens, segments=None, valid_mask=None):
         b, t = tokens.shape
         x = self.word_embed(tokens)
@@ -393,6 +429,12 @@ class BertForPretraining(HybridBlock):
     def bind_sp_mesh(self, mesh, axis_name="sp", batch_axis=None):
         self.bert.bind_sp_mesh(mesh, axis_name, batch_axis)
         return self
+
+    @staticmethod
+    def partition_rules(axis_name="tp", prefix=".*"):
+        """The MLM decoder bias shards over the vocab dim to match the
+        tied (vocab-split) word embedding it adds onto."""
+        return [(prefix + r"mlm_bias$", PartitionSpec(axis_name))]
 
     def forward(self, tokens, segments=None, valid_mask=None):
         seq, pooled = self.bert(tokens, segments, valid_mask)

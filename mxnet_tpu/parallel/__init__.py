@@ -8,8 +8,9 @@ collectives (psum/all-gather/reduce-scatter) and schedules them over ICI.
 from .mesh import (
     make_mesh, current_mesh, mesh_scope, data_sharding, replicated_sharding,
     match_partition_rules, shard_parameters, constrain, global_put,
-    shard_put, init_distributed,
+    shard_put, init_distributed, RuleCoverage,
 )
+from .recipe import ShardingRecipe, parse_recipe
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
 from .pipeline import pipeline_apply
@@ -23,4 +24,5 @@ __all__ = [
     "constrain", "ring_attention", "ulysses_attention", "init_distributed",
     "pipeline_apply", "moe_ffn", "init_moe_params", "moe_partition_specs",
     "shard_moe_params", "MoEFFN", "GPipeMLP",
+    "ShardingRecipe", "parse_recipe", "RuleCoverage",
 ]

@@ -7,6 +7,7 @@ links).  On TPU the topology is the mesh: name the axes (`dp`, `tp`, `sp`,
 """
 from __future__ import annotations
 
+import logging
 import re
 import threading
 
@@ -17,10 +18,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 __all__ = [
     "make_mesh", "current_mesh", "mesh_scope", "data_sharding",
     "replicated_sharding", "match_partition_rules", "shard_parameters",
-    "constrain", "PartitionSpec",
+    "constrain", "PartitionSpec", "RuleCoverage",
 ]
 
 _state = threading.local()
+_log = logging.getLogger(__name__)
 
 
 def make_mesh(axes=None, devices=None):
@@ -54,7 +56,14 @@ def make_mesh(axes=None, devices=None):
         raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} "
                          f"devices, have {n}")
     # a mesh may use a subset of devices (e.g. a 4-stage pipeline on an
-    # 8-device host); take the first `total`
+    # 8-device host); take the first `total` — but say so, loudly: a
+    # typo'd recipe (`dp2` on 8 chips) otherwise trains at quarter speed
+    # with no visible symptom
+    if total < n:
+        _log.warning(
+            "mesh %s uses %d of %d devices — %d device(s) idle; "
+            "if unintended, size an axis -1 to absorb the remainder",
+            dict(zip(names, sizes)), total, n, n - total)
     dev_array = onp.asarray(devices[:total]).reshape(sizes)
     return Mesh(dev_array, tuple(names))
 
@@ -85,23 +94,67 @@ def replicated_sharding(mesh):
     return NamedSharding(mesh, PartitionSpec())
 
 
-def match_partition_rules(rules, names_to_shapes):
+class RuleCoverage(dict):
+    """The ``name -> PartitionSpec`` mapping from
+    :func:`match_partition_rules`, with the audit trail attached:
+
+    * ``matched``: name -> the regex pattern that decided its spec
+      (first match wins);
+    * ``replicated``: names of non-scalar params that fell through every
+      rule and defaulted to replicated — the set a tp/pp recipe audit
+      cares about (a fallen-through 4 GB embedding silently replicates
+      onto every chip);
+    * ``scalars``: names short-circuited to replicated because sharding
+      a scalar/size-1 array is meaningless.
+
+    Plain-dict callers are unaffected: this IS the dict they had.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self.matched = {}
+        self.replicated = []
+        self.scalars = []
+
+    def summary(self):
+        return (f"{len(self.matched)} rule-matched, "
+                f"{len(self.replicated)} fell through to replicated, "
+                f"{len(self.scalars)} scalar")
+
+
+def match_partition_rules(rules, names_to_shapes, strict=False):
     """Map parameter names to PartitionSpecs by regex rules.
 
     ``rules``: list of (pattern, PartitionSpec); first match wins; scalars
-    and unmatched params are replicated.
+    and unmatched params are replicated.  Returns a :class:`RuleCoverage`
+    (a dict subclass) recording which rule matched each param and which
+    fell through.  ``strict=True`` raises ``ValueError`` naming every
+    non-scalar param no rule matched — the fmengine-style audit a tp/pp
+    recipe runs so an uncovered tensor cannot silently replicate.
     """
-    out = {}
+    out = RuleCoverage()
     for name, shape in names_to_shapes.items():
         if len(shape) == 0 or int(onp.prod(shape)) == 1:
             out[name] = PartitionSpec()
+            out.scalars.append(name)
             continue
-        spec = PartitionSpec()
+        spec = None
         for pattern, ps in rules:
             if re.search(pattern, name):
                 spec = ps
+                out.matched[name] = pattern
                 break
+        if spec is None:
+            spec = PartitionSpec()
+            out.replicated.append(name)
         out[name] = spec
+    if strict and out.replicated:
+        raise ValueError(
+            "partition rule not found for param(s): "
+            + ", ".join(sorted(out.replicated))
+            + " — every non-scalar parameter must match a rule under a "
+            "strict (tp/pp) recipe; add a block partition_rules() or a "
+            "user override, or pass strict=False to replicate them")
     return out
 
 
@@ -192,7 +245,7 @@ def shard_put(value, sharding, pool=None):
         host.shape, sharding, shards)
 
 
-def shard_parameters(params, mesh, rules=None):
+def shard_parameters(params, mesh, rules=None, strict=False):
     """Place Gluon Parameters onto the mesh.
 
     ``params``: dict name -> Parameter.  Each parameter's array is re-placed
@@ -201,13 +254,32 @@ def shard_parameters(params, mesh, rules=None):
     (`python/mxnet/gluon/trainer.py:164-174`).  Works across processes
     (multi-host mesh): every process holds identical initial values (same
     seed), so `global_put` hands each its local shards.
+
+    The returned :class:`RuleCoverage` says which rule placed each param;
+    the coverage summary is logged and the fell-through-to-replicated
+    count published as the ``mxtpu_recipe_params_replicated_total`` gauge
+    (a nonzero value under a tp/pp recipe is the first thing to check
+    when per-chip memory doesn't drop).  ``strict=True`` raises instead
+    — see :func:`match_partition_rules`.
     """
+    from .. import telemetry as _tm
+
     specs = match_partition_rules(
-        rules or [], {k: p.shape for k, p in params.items()})
+        rules or [], {k: p.shape for k, p in params.items()}, strict=strict)
     for name, p in params.items():
         sharding = NamedSharding(mesh, specs[name])
         arr = p.data()
         arr._rebind(global_put(arr._data, sharding))
+    _log.info("shard_parameters: placed %d param(s) on mesh %s — %s",
+              len(specs), dict(mesh.shape), specs.summary())
+    if specs.replicated:
+        _log.info("shard_parameters: replicated fall-throughs: %s",
+                  ", ".join(sorted(specs.replicated)))
+    _tm.gauge(
+        "mxtpu_recipe_params_replicated_total",
+        "Non-scalar params the last shard_parameters call replicated "
+        "because no partition rule matched them",
+    ).set(len(specs.replicated))
     return specs
 
 

@@ -1,0 +1,197 @@
+"""Declarative 3D-parallel sharding recipes: one config string drives
+mesh, placement, step, and checkpoint.
+
+The recipe grammar (docs/SHARDING.md)::
+
+    recipe   := axis ("." axis)* ("+" modifier)*
+    axis     := name size?          # "dp4", "tp2", "pp2"; no size = -1
+    modifier := "sp"                # sequence parallelism over tp
+
+``"dp4"`` is 4-way data parallelism; ``"dp2.tp2"`` a 2x2 dp-by-tensor
+mesh; ``"dp2.tp2.pp2+sp"`` the full 3D mesh with activations
+sequence-sharded over the tp axis (Megatron-SP style).  One axis may
+omit its size (or use ``-1``) to absorb the remaining devices, so
+``"dp.tp2"`` scales with the host.
+
+A :class:`ShardingRecipe` turns the string into everything the trainer
+stack needs:
+
+* mesh axes for :func:`~mxnet_tpu.parallel.make_mesh`;
+* the merged partition-rule list — per-block ``partition_rules()``
+  collected over the Gluon block tree (``Block.collect_partition_rules``)
+  with user regex overrides FIRST (first match wins, so overrides beat
+  block defaults);
+* the input data spec (batch over ``dp``; ``+sp`` adds the sequence
+  axis) and the dp size for global-batch divisibility;
+* the strict-coverage policy: under a tp/pp recipe every non-scalar
+  param must match a rule (`shard_parameters(strict=True)`), because a
+  fallen-through tensor silently replicates onto every chip.
+
+The reference analogue is kvstore-type selection
+(`python/mxnet/kvstore/kvstore.py create("dist_sync")`) — one string
+picking the whole distribution strategy; here the string also carries
+the mesh geometry and the placement audit.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from jax.sharding import PartitionSpec
+
+__all__ = ["ShardingRecipe", "parse_recipe"]
+
+_log = logging.getLogger(__name__)
+
+_AXIS_RE = re.compile(r"^([a-z][a-z0-9_]*?)(-1|\d+)?$")
+
+#: Modifiers the grammar accepts ("+sp" is Megatron-style sequence
+#: parallelism: activations shard their sequence dim over the tp axis).
+KNOWN_MODIFIERS = ("sp",)
+
+
+def parse_recipe(recipe):
+    """``"dp2.tp2.pp2+sp"`` -> ``({"dp": 2, "tp": 2, "pp": 2}, ("sp",))``.
+
+    Axis order in the string is mesh-axis order (leftmost varies
+    slowest).  At most one axis may omit its size / use ``-1``.
+    """
+    if not isinstance(recipe, str) or not recipe.strip():
+        raise ValueError(f"recipe must be a non-empty string, got {recipe!r}")
+    body = recipe.strip()
+    parts = body.split("+")
+    body, modifiers = parts[0], tuple(parts[1:])
+    for m in modifiers:
+        if m not in KNOWN_MODIFIERS:
+            raise ValueError(
+                f"recipe {recipe!r}: unknown modifier {m!r} "
+                f"(known: {', '.join(KNOWN_MODIFIERS)})")
+    axes = {}
+    for token in body.split("."):
+        m = _AXIS_RE.match(token)
+        if m is None:
+            raise ValueError(
+                f"recipe {recipe!r}: bad axis token {token!r} — expected "
+                "<name><size> like 'dp4' or 'tp2' (size -1 or omitted "
+                "absorbs the remaining devices)")
+        name, size = m.group(1), m.group(2)
+        if name in axes:
+            raise ValueError(
+                f"recipe {recipe!r}: duplicate axis {name!r}")
+        axes[name] = int(size) if size is not None else -1
+    if list(axes.values()).count(-1) > 1:
+        raise ValueError(
+            f"recipe {recipe!r}: at most one axis may omit its size")
+    return axes, modifiers
+
+
+class ShardingRecipe:
+    """One declarative recipe applied end to end.
+
+    >>> recipe = ShardingRecipe("dp2.tp2")
+    >>> step = FusedTrainStep(block, trainer, recipe=recipe)   # or recipe=str
+
+    The fused step builds the mesh, collects every block's
+    ``partition_rules()`` over the tree (plus ``overrides``), places
+    params and optimizer state, and derives its input shardings — the
+    whole 3D-parallel setup from the one string.  Standalone use::
+
+    >>> mesh = recipe.build_mesh()
+    >>> specs = recipe.apply(block, mesh)     # shard_parameters + audit
+
+    ``overrides`` is a list of ``(pattern, PartitionSpec)`` checked
+    BEFORE the collected block rules (first match wins — user intent
+    beats block defaults).  ``strict`` defaults to "auto": enforced
+    whenever the recipe has a non-dp axis of size > 1 (tp/pp/ep — the
+    regimes where an uncovered param replicating is a silent memory
+    regression), off for pure-dp recipes where replication is the
+    correct placement.  ``MXNET_RECIPE_STRICT`` (0/1) overrides auto.
+    """
+
+    def __init__(self, recipe, overrides=None, strict=None):
+        if isinstance(recipe, ShardingRecipe):
+            axes, modifiers = dict(recipe.axes), recipe.modifiers
+            self.recipe = recipe.recipe
+        else:
+            axes, modifiers = parse_recipe(recipe)
+            self.recipe = str(recipe).strip()
+        self.axes = axes
+        self.modifiers = modifiers
+        self.overrides = list(overrides or [])
+        self._strict = strict
+
+    # -- geometry ---------------------------------------------------------
+    @property
+    def dp_axis(self):
+        """The batch axis: ``dp`` when present, else the first axis."""
+        return "dp" if "dp" in self.axes else next(iter(self.axes))
+
+    @property
+    def model_axes(self):
+        """Axes that shard the model rather than the batch (tp/pp/ep/...)."""
+        return tuple(a for a in self.axes if a != self.dp_axis)
+
+    def dp_size(self, mesh):
+        return int(mesh.shape[self.dp_axis])
+
+    @property
+    def sequence_parallel(self):
+        return "sp" in self.modifiers
+
+    def data_spec(self):
+        """Input PartitionSpec: batch over dp; ``+sp`` shards the second
+        (sequence) dim over the sp axis when the mesh has one, else over
+        tp — the Megatron-SP convention of reusing the tensor group."""
+        if not self.sequence_parallel:
+            return PartitionSpec(self.dp_axis)
+        seq = "sp" if "sp" in self.axes else (
+            "tp" if "tp" in self.axes else None)
+        if seq is None:
+            raise ValueError(
+                f"recipe {self.recipe!r}: '+sp' needs an 'sp' or 'tp' "
+                "mesh axis to shard the sequence dim over")
+        return PartitionSpec(self.dp_axis, seq)
+
+    def strict(self):
+        """Resolved strict-coverage policy (see class docstring)."""
+        if self._strict is not None:
+            return bool(self._strict)
+        from .. import env as _env
+
+        env = _env.recipe_strict()
+        if env is not None:
+            return env
+        return any(self.axes[a] != 1 for a in self.model_axes)
+
+    # -- application ------------------------------------------------------
+    def build_mesh(self, devices=None):
+        from .mesh import make_mesh
+
+        return make_mesh(dict(self.axes), devices=devices)
+
+    def collect_rules(self, block, overrides=None):
+        """The merged first-match-wins rule list for ``block``'s tree:
+        ``overrides`` (call-site) + ``self.overrides`` (construction) +
+        per-block ``partition_rules()`` gathered by
+        ``Block.collect_partition_rules`` for the axes this recipe
+        actually has."""
+        rules = list(overrides or []) + list(self.overrides)
+        rules += block.collect_partition_rules(set(self.axes))
+        return rules
+
+    def apply(self, block, mesh, overrides=None):
+        """Shard every parameter of ``block`` onto ``mesh`` under the
+        merged rules, with the coverage audit (strict per
+        :meth:`strict`).  Returns the RuleCoverage spec map."""
+        from .mesh import shard_parameters
+
+        rules = self.collect_rules(block, overrides)
+        specs = shard_parameters(block.collect_params(), mesh, rules,
+                                 strict=self.strict())
+        _log.info("recipe %r applied: mesh %s, %s", self.recipe,
+                  dict(mesh.shape), specs.summary())
+        return specs
+
+    def __repr__(self):
+        return (f"ShardingRecipe({self.recipe!r}, axes={self.axes}, "
+                f"modifiers={list(self.modifiers)})")

@@ -114,6 +114,16 @@ def _last_step_gauge():
         "Step number of the most recently committed checkpoint shard")
 
 
+def _param_bytes_counter():
+    return _counter(
+        "mxtpu_ckpt_param_bytes_total",
+        "Host bytes copied per parameter at checkpoint gather, by mode: "
+        "'replicated' copies the full array, 'shard' copies only each "
+        "unique device shard of a recipe-sharded param (never the "
+        "gathered full array)",
+        labelnames=("mode",))
+
+
 # --------------------------------------------------------------------------
 # dtype encoding: non-native dtypes ride as unsigned views
 # --------------------------------------------------------------------------
@@ -342,10 +352,45 @@ def _as_tuple(x):
     return (x,)
 
 
+def _shard_index_key(index, shape):
+    """Concrete ((start, stop), ...) for a shard's slice-tuple index —
+    the dedupe key across replica devices holding the same tile."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, _step = sl.indices(int(dim))
+        out.append((int(start), int(stop)))
+    return tuple(out)
+
+
+def _stitch_shards(arrays, i, info):
+    """Reassemble a recipe-sharded param's full host array from its
+    saved ``paramshard/{i}/{j}`` tiles (inverse of the gather-side
+    dedupe; the tiles cover the array for a single-controller save)."""
+    first = arrays.get(f"paramshard/{i}/0")
+    if first is None:
+        return None
+    full = onp.zeros(tuple(info["shape"]), dtype=first.dtype)
+    for j in range(int(info["n_shards"])):
+        sl = tuple(slice(b, e) for b, e in info["index"][j])
+        full[sl] = arrays[f"paramshard/{i}/{j}"]
+    return full
+
+
 def gather_training_state(trainer, step, scaler=None, include_rng=True):
     """Snapshot the FULL training state to host numpy: ``(arrays, meta)``
     ready for :func:`save_checkpoint`.  Must be called between steps (no
-    step in flight — donated buffers are rebound by then)."""
+    step in flight — donated buffers are rebound by then).
+
+    Recipe-sharded params (a tp/pp placement where the array is NOT
+    fully replicated) are saved as their unique device shards — one
+    ``paramshard/{i}/{j}`` entry per distinct tile, deduped across
+    replica devices — with the spec, mesh axes, and tile indices in
+    ``meta["sharded_params"]``.  The full array is never gathered to
+    the host; the ``mxtpu_ckpt_param_bytes_total`` counter's
+    ``mode="shard"`` series carries exactly the tile bytes, which is
+    how the no-full-gather contract is audited."""
+    import jax
+
     from .. import random as _rng
 
     trainer._init_states()
@@ -353,15 +398,45 @@ def gather_training_state(trainer, step, scaler=None, include_rng=True):
     # -- params (multi-device copies are kept in sync by the allreduce;
     # shard 0 of each is the canonical value, exactly like save_states)
     names = []
+    sharded = {}
+    rep_bytes = shard_bytes = 0
     for i, p in enumerate(trainer._params):
         names.append(p.name)
-        arrays[f"param/{i}"] = onp.asarray(p.list_data()[0]._data)
+        d = p.list_data()[0]._data
+        if isinstance(d, jax.Array) and not d.is_fully_replicated:
+            tiles = {}
+            for s in d.addressable_shards:
+                tiles.setdefault(_shard_index_key(s.index, d.shape), s)
+            idxs = []
+            for j, (key, s) in enumerate(sorted(tiles.items())):
+                a = onp.asarray(s.data)
+                arrays[f"paramshard/{i}/{j}"] = a
+                shard_bytes += a.nbytes
+                idxs.append([[b, e] for b, e in key])
+            mesh = getattr(d.sharding, "mesh", None)
+            sharded[str(i)] = {
+                "spec": str(getattr(d.sharding, "spec", "")),
+                "mesh_axes": {str(n): int(v) for n, v in
+                              dict(mesh.shape).items()} if mesh is not None
+                else {},
+                "shape": [int(x) for x in d.shape],
+                "n_shards": len(idxs),
+                "index": idxs,
+            }
+        else:
+            a = onp.asarray(d)
+            arrays[f"param/{i}"] = a
+            rep_bytes += a.nbytes
     meta["param_names"] = names
+    if sharded:
+        meta["sharded_params"] = sharded
+    if rep_bytes:
+        _param_bytes_counter().labels(mode="replicated").inc(rep_bytes)
+    if shard_bytes:
+        _param_bytes_counter().labels(mode="shard").inc(shard_bytes)
     # the saved world, named explicitly so restore can detect (and the
     # elastic path can reshard across) a topology change instead of
     # tripping an obscure device/shape error deep in jax
-    import jax
-
     copies = max((len(p.list_data()) for p in trainer._params), default=1)
     meta["world"] = {"copies": int(copies),
                      "processes": int(jax.process_count())}
@@ -457,8 +532,15 @@ def restore_training_state(arrays, meta, trainer, scaler=None,
             "survivor world", saved_world=dict(saved),
             live_world={"copies": live_copies,
                         "processes": int(jax.process_count())})
+    sharded = meta.get("sharded_params") or {}
     for i, p in enumerate(trainer._params):
-        a = arrays.get(f"param/{i}")
+        info = sharded.get(str(i))
+        # sharded saves stitch the full host array from their tiles,
+        # then _nd_put places it under the LIVE sharding — so a restore
+        # across recipe changes (or the elastic reshard path) re-places
+        # rather than assuming the saved layout still applies
+        a = _stitch_shards(arrays, i, info) if info is not None \
+            else arrays.get(f"param/{i}")
         if a is None:
             continue
         if tuple(a.shape) != tuple(p.shape):

@@ -348,7 +348,9 @@ def test_real_entrypoints_scan_clean(real_artifacts):
 
 def test_real_artifact_inventory(real_artifacts):
     names = {a.name for a in real_artifacts}
-    assert names == {"fused_train_step.dp", "allreduce.bucket_dense",
+    assert names == {"fused_train_step.dp",
+                     "fused_train_step.recipe_tp2",
+                     "allreduce.bucket_dense",
                      "allreduce.bucket_2bit", "allreduce.bucket_int8",
                      "allreduce.bucket_fp8",
                      "allreduce.bucket_dense_integrity",

@@ -210,6 +210,10 @@ run_dryrun() {
   # pytest already runs the 4-process launcher test; skip it inside the
   # in-process dryrun to keep ci wall-clock bounded
   export MXTPU_DRYRUN_MULTIPROC=0
+  # the sharding-recipe rider (ISSUE 16) rides the 8-device pass: a
+  # dp2.tp2.pp2 fused step, the tp2 hloscan contract, and the giant-model
+  # placement proof all print recipe_verdict: lines (MXTPU_DRYRUN_RECIPE=0
+  # opts out)
   for n in 8 6 3 2; do
     python -c "import __graft_entry__ as g; g.dryrun_multichip($n); print('dryrun($n) ok')"
   done

@@ -310,10 +310,34 @@ print(f"smoke: layer census ok ({doc['attributed_flops_fraction']:.1%} "
       f"of {doc['totals']['flops']:.0f} FLOPs attributed)")
 EOF
 
+# 3d. sharding-recipe parity gate (ISSUE 16): a dp2.tp2 recipe-built
+# fused step must match the dp-only oracle's 3-step loss trajectory
+# bitwise at the same global batch — sharding annotations never change
+# numerics, so ANY drift means the recipe subsystem broke placement or
+# rule collection.  The full recipe rider (3D step + hloscan contract +
+# giant-model placement) runs in ci.sh's dryrun stage.
+python - <<'EOF'
+import numpy as onp
+import mxnet_tpu.random as _rng
+from mxnet_tpu.analysis.capture import (build_dp_fused_step,
+                                        build_recipe_fused_step)
+
+def run3(builder):
+    _rng.seed(0)
+    fused, (x, y), bs, _meta = builder()
+    return [onp.asarray(fused(x, y, batch_size=bs)._data).sum()
+            for _ in range(3)]
+
+dp, tp = run3(build_dp_fused_step), run3(build_recipe_fused_step)
+assert dp == tp, f"recipe dp2.tp2 diverged from the dp oracle: {dp} vs {tp}"
+print(f"smoke: recipe dp2.tp2 parity ok (3-step losses {tp})")
+EOF
+
 # 4. the driver entry points compile on the virtual mesh (the full
-# hloscan + census dryrun riders run in ci.sh's dryrun stage, not here)
+# hloscan + census + recipe dryrun riders run in ci.sh's dryrun stage,
+# not here — the recipe parity gate above covers 3d's quick check)
 MXTPU_DRYRUN_HLOSCAN=0 MXTPU_DRYRUN_CENSUS=0 MXTPU_DRYRUN_RESILIENCE=0 \
-  MXTPU_DRYRUN_FLEET=0 MXTPU_DRYRUN_GRAY=0 \
+  MXTPU_DRYRUN_FLEET=0 MXTPU_DRYRUN_GRAY=0 MXTPU_DRYRUN_RECIPE=0 \
   python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
