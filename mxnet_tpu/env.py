@@ -182,6 +182,19 @@ MXNET_KVSTORE_INTEGRITY      ``1`` turns on the allreduce integrity
                              flipped bit never reaches the optimizer
                              (default 0; read when a store's bucketer
                              is created)
+MXNET_BLACKBOX               ``0`` disables the ``observe`` flight
+                             recorder entirely — no events recorded, no
+                             postmortem dumps (default on; read when
+                             the recorder is created or ``reset()``)
+MXNET_BLACKBOX_EVENTS        flight-recorder ring capacity in events;
+                             older events are overwritten and counted
+                             in the dump's ``dropped`` field (default
+                             4096; read at recorder creation/reset)
+MXNET_BLACKBOX_DIR           fixed directory for postmortem dumps;
+                             default unset: dumps land next to the
+                             checkpoint step dirs (``<root>/blackbox``)
+                             or ``./blackbox`` with no checkpoint root
+                             (read at each dump)
 =========================== =================================================
 """
 from __future__ import annotations
@@ -194,7 +207,8 @@ __all__ = ["apply", "describe", "is_naive_engine", "cpu_worker_nthreads",
            "elastic_enabled", "elastic_min_world", "elastic_scaling",
            "sentinel_slow_factor", "sentinel_loss_factor",
            "sentinel_rollbacks", "kvstore_integrity",
-           "parallel_recipe", "recipe_strict"]
+           "parallel_recipe", "recipe_strict", "blackbox_enabled",
+           "blackbox_events", "blackbox_dir"]
 
 _naive_engine = False
 
@@ -342,6 +356,32 @@ def recipe_strict(default=None):
     return v != "0"
 
 
+def blackbox_enabled(default=True):
+    """Whether the ``observe`` flight recorder records at all."""
+    v = os.environ.get("MXNET_BLACKBOX")
+    if v is None:
+        return default
+    return v not in ("0", "")
+
+
+def blackbox_events(default=4096):
+    """Flight-recorder ring capacity (events); older events are
+    overwritten."""
+    v = os.environ.get("MXNET_BLACKBOX_EVENTS")
+    if v is None:
+        return default
+    return max(16, int(v))
+
+
+def blackbox_dir(default=None):
+    """Fixed postmortem-dump directory; None = next to the checkpoint
+    dir (``<root>/blackbox``) or ``./blackbox``."""
+    v = os.environ.get("MXNET_BLACKBOX_DIR")
+    if v is None or not v.strip():
+        return default
+    return v.strip()
+
+
 def apply():
     """Read the environment once at package import."""
     global _naive_engine
@@ -398,5 +438,7 @@ def describe():
              "MXNET_ELASTIC_MIN_WORLD", "MXNET_ELASTIC_SCALING",
              "MXNET_SENTINEL_SLOW_FACTOR", "MXNET_SENTINEL_LOSS_FACTOR",
              "MXNET_SENTINEL_ROLLBACKS", "MXNET_KVSTORE_INTEGRITY",
-             "MXNET_PARALLEL_RECIPE", "MXNET_RECIPE_STRICT"]
+             "MXNET_PARALLEL_RECIPE", "MXNET_RECIPE_STRICT",
+             "MXNET_BLACKBOX", "MXNET_BLACKBOX_EVENTS",
+             "MXNET_BLACKBOX_DIR"]
     return [(n, os.environ.get(n), n in __doc__) for n in names]

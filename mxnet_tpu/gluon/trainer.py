@@ -18,6 +18,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .. import observe as _observe
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import optimizer as opt
@@ -26,6 +27,18 @@ from ..kvstore import base as kvstore_base
 from .parameter import Parameter
 
 __all__ = ["Trainer"]
+
+
+def _step_duration_histogram():
+    # whole-step wall time as a proper histogram — the same distribution
+    # the straggler policy sees via the KV steptime stamps, published so
+    # the blackbox step lane and Prometheus read one source of truth
+    # (docs/OBSERVABILITY.md)
+    return _telemetry.histogram(
+        "mxtpu_step_duration_seconds",
+        "End-to-end Trainer.step wall time (allreduce + step-guards + "
+        "optimizer update), including steps the guards skipped — the "
+        "distribution the straggler policy's KV steptime stamps sample")
 
 
 class Trainer:
@@ -151,6 +164,17 @@ class Trainer:
         (reference trainer.py:334).  Both phases publish into the
         telemetry step-phase histogram and, while profiling, emit
         step-trace spans."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            self._step(batch_size, ignore_stale_grad)
+        finally:
+            dt = _time.perf_counter() - t0
+            _step_duration_histogram().observe(dt)
+            _observe.record("step", "trainer.step", seconds=dt)
+
+    def _step(self, batch_size, ignore_stale_grad):
         self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         _telemetry.mark_step()
@@ -163,10 +187,14 @@ class Trainer:
         # counter was already ticked inside consume_integrity.
         consume = getattr(self._kvstore, "consume_integrity_violations",
                           None) if self._kvstore is not None else None
-        if consume is not None and consume() > 0:
+        violations = consume() if consume is not None else 0
+        if violations > 0:
             from ..resilience import faultline as _faultline
             from ..resilience.policies import step_skip_counter
             step_skip_counter().inc()
+            _observe.record("sentinel", "integrity_violation",
+                            site="collective.dispatch",
+                            violations=int(violations))
             _faultline.recovered("collective.dispatch", "bitflip")
             return
         # finite-grad step-guard (eager path): when amp attached a loss

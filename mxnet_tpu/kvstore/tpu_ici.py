@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as onp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import observe as _observe
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..telemetry import collective_span as _collective_span
@@ -426,6 +427,7 @@ class TPUICIStore(KVStoreBase):
 
         self._rank = jax.process_index()
         self._size = jax.process_count()
+        _observe.set_rank(self._rank)
         self._compression = None
         self._residuals = {}
         # device-ring -> live launch-chain token (see _fresh_chain_token)
@@ -520,7 +522,10 @@ class TPUICIStore(KVStoreBase):
                     # mxlint: disable=swallowed-exception -- pre-set delete is advisory (first beat has nothing to delete); the set below is the operation that matters
                     except Exception:
                         pass
-                    client.key_value_set(key, repr(time.time()))
+                    stamp = time.time()
+                    client.key_value_set(key, repr(stamp))
+                    _observe.record("heartbeat", "beat",
+                                    rank=self._rank, stamp=stamp)
                 # mxlint: disable=swallowed-exception -- coordinator going down mid-beat: the beat thread must outlive it quietly (peers see the stale stamp; raising here would just kill the reporter)
                 except Exception:
                     pass
@@ -575,9 +580,17 @@ class TPUICIStore(KVStoreBase):
                     stale = True  # forged/corrupt stamp: not a live beat
             if not stale:
                 self._stale_counts.pop(r, None)
+                if r != self._rank:
+                    try:
+                        _observe.record("heartbeat", "observe", rank=r,
+                                        stamp=float(stamp), stale=False)
+                    except (TypeError, ValueError):  # mxlint: disable=swallowed-exception -- unparseable fresh stamp is impossible by construction (stale would be True); belt-and-braces for the recorder only
+                        pass
                 continue
             n = self._stale_counts.get(r, 0) + 1
             self._stale_counts[r] = n
+            _observe.record("heartbeat", "observe", rank=r, stamp=None,
+                            stale=True, consecutive=n)
             if n >= 2:
                 dead.append(r)
         return dead
@@ -604,6 +617,8 @@ class TPUICIStore(KVStoreBase):
             except Exception:
                 pass
             client.key_value_set(key, repr(float(seconds)))
+            _observe.record("heartbeat", "steptime", rank=self._rank,
+                            seconds=float(seconds))
         # mxlint: disable=swallowed-exception -- best-effort stamp: a coordinator hiccup must not fail the training step that just completed; the policy tolerates a missing window
         except Exception:
             pass

@@ -1,0 +1,17 @@
+"""``mxnet_tpu.observe`` — pod-wide flight recorder + postmortem dumps.
+
+The black box behind every chaos gate: a bounded per-host ring of
+structured events (see ``flightrec``), atomic per-host dumps on terminal
+errors/signals/demand, and the ``tools/blackbox`` analyzer that merges
+N per-host dumps into one clock-skew-corrected pod timeline with a
+root-cause verdict (docs/OBSERVABILITY.md "Black box / postmortem").
+"""
+from .flightrec import (FlightRecorder, SCHEMA_VERSION, configure,
+                        default_recorder, dump, enabled, events,
+                        install_signal_handlers, record, reset,
+                        set_generation, set_rank, set_step, snapshot)
+
+__all__ = ["FlightRecorder", "SCHEMA_VERSION", "configure",
+           "default_recorder", "dump", "enabled", "events",
+           "install_signal_handlers", "record", "reset",
+           "set_generation", "set_rank", "set_step", "snapshot"]

@@ -40,6 +40,7 @@ import time
 
 import numpy as onp
 
+from .. import observe as _observe
 from .. import telemetry as _telemetry
 from . import faultline
 
@@ -781,8 +782,12 @@ class CheckpointManager:
             save_checkpoint(self.root, step, arrays, meta, rank=self._rank)
         except BaseException:
             _saves_counter().labels(outcome="failed").inc()
+            _observe.record("checkpoint", "save", step=int(step),
+                            rank=self._rank, outcome="failed")
             raise
         _saves_counter().labels(outcome="written").inc()
+        _observe.record("checkpoint", "save", step=int(step),
+                        rank=self._rank, outcome="written")
         self.prune()
 
     def wait(self):
@@ -844,6 +849,8 @@ class CheckpointManager:
                 except CheckpointCorrupt as e:
                     _restores_counter().labels(
                         outcome="torn_fallback").inc()
+                    _observe.record("checkpoint", "restore", step=step,
+                                    outcome="torn_fallback")
                     logging.getLogger(__name__).warning(
                         "checkpoint step %d incomplete across ranks %s "
                         "(%s); falling back", step, list(ranks), e)
@@ -852,12 +859,18 @@ class CheckpointManager:
                 out = load_checkpoint(self.root, step, rank=self._rank)
             except CheckpointCorrupt as e:
                 _restores_counter().labels(outcome="corrupt_fallback").inc()
+                _observe.record("checkpoint", "restore", step=step,
+                                outcome="corrupt_fallback")
                 logging.getLogger(__name__).warning(
                     "checkpoint step %d corrupt (%s); falling back", step, e)
                 continue
             except FileNotFoundError:
                 continue
             _restores_counter().labels(outcome="ok").inc()
+            _observe.record("checkpoint", "restore", step=step,
+                            outcome="ok")
             return out
         _restores_counter().labels(outcome="none").inc()
+        _observe.record("checkpoint", "restore", step=None,
+                        outcome="none")
         return None

@@ -53,6 +53,7 @@ import logging
 import time
 
 from .. import env as _env
+from .. import observe as _observe
 from .. import telemetry as _telemetry
 from . import checkpoint as _checkpoint
 from . import faultline
@@ -196,6 +197,8 @@ class EmulatedPod:
         for r in (self.ranks if rank is None else (int(rank),)):
             if r in self.ranks:
                 self._steptimes[r] = seconds
+                _observe.record("heartbeat", "steptime", rank=r,
+                                seconds=seconds)
 
     def read_steptimes(self):
         """``{rank: seconds}`` of the last stamps — same contract as
@@ -219,6 +222,8 @@ class EmulatedPod:
                 continue
             n = self._stale_counts.get(r, 0) + 1
             self._stale_counts[r] = n
+            _observe.record("heartbeat", "observe", rank=r, stamp=None,
+                            stale=True, consecutive=n)
             if n >= 2:
                 dead.append(r)
         return dead
@@ -299,6 +304,10 @@ class ElasticSupervisor:
         self._base_lr = None
         self.handle = None
         self.reshards = 0
+        # black box: postmortem dumps land next to this job's checkpoint
+        # step dirs, and SIGTERM/SIGINT flush the flight record first
+        _observe.configure(root=manager.root)
+        _observe.install_signal_handlers()
 
     # -- lifecycle --------------------------------------------------------
     def _construct(self):
@@ -373,6 +382,7 @@ class ElasticSupervisor:
         handle = self.handle or self._construct()
         t = self._restore(handle)
         while t < total_steps:
+            _observe.set_step(t)
             try:
                 if self._pod is not None and t % self._check_every == 0:
                     check_peers(self._pod, self._manager,
@@ -395,6 +405,8 @@ class ElasticSupervisor:
                 # world, restore bitwise, replay from the checkpoint
                 _log.warning("preemption at step %d (%s); resuming from "
                              "last checkpoint on the same topology", t, e)
+                _observe.record("elastic", "preempt_resume", step=t,
+                                site=e.site, kind=e.kind)
                 self._teardown()
                 handle = self._construct()
                 t = self._restore(handle)
@@ -402,6 +414,11 @@ class ElasticSupervisor:
             except DeadNodeError as e:
                 survivors = [r for r in self.world.ranks
                              if r not in set(e.ranks)]
+                _observe.record(
+                    "elastic", "dead_node", step=t,
+                    ranks=sorted(e.ranks), survivors=survivors,
+                    checkpoint_step=e.checkpoint_step,
+                    degraded=isinstance(e, DegradedNodeError))
                 if not self._elastic:
                     _log.error(
                         "dead nodes %s and elastic mode is off "
@@ -483,9 +500,17 @@ class ElasticSupervisor:
         ema = self._divergence.ema
         ema = float("nan") if ema is None else float(ema)
         if self._rollbacks >= self._rollback_budget:
+            _observe.record("terminal", "DivergenceError",
+                            loss=float(loss), ema=ema,
+                            rollbacks=self._rollbacks, step=step)
+            _observe.dump(reason="DivergenceError",
+                          root=self._manager.root)
             raise DivergenceError(float(loss), ema, self._rollbacks)
         self._rollbacks += 1
         rollbacks_counter().inc()
+        _observe.record("elastic", "rollback", step=step,
+                        loss=float(loss), ema=ema,
+                        rollback=self._rollbacks)
         _log.warning(
             "divergence at step %d (loss %g vs EMA %g): rolling back to "
             "the newest complete checkpoint and advancing the RNG "
@@ -506,7 +531,13 @@ class ElasticSupervisor:
         """Shrink to ``survivors``, rebuild, restore onto the new
         topology; returns the step to resume from."""
         self._teardown()
+        old = self.world
         self.world = self.world.shrink(survivors)
+        _observe.set_generation(self.world.generation)
+        _observe.record("elastic", "reshard",
+                        generation=self.world.generation,
+                        survivors=list(self.world.ranks),
+                        old_size=old.size, new_size=self.world.size)
         if self._pod is not None and hasattr(self._pod, "shrink"):
             self._pod.shrink(self.world.ranks)
         if self._straggler is not None:

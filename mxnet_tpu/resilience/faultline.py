@@ -77,6 +77,7 @@ from __future__ import annotations
 import json
 import threading
 
+from .. import observe as _observe
 from .. import telemetry as _telemetry
 
 __all__ = [
@@ -365,6 +366,9 @@ def poll(site):
     if spec is None:
         return None
     _injected_counter().labels(site=site, kind=spec.kind).inc()
+    _observe.record("fault", f"{site}/{spec.kind}", site=site,
+                    kind=spec.kind, rank=spec.rank,
+                    arrival=_state.counts[site])
     if spec.kind == "slow":
         _sleep_slow(spec)
     return spec.kind
@@ -379,6 +383,9 @@ def check(site):
     if spec is None:
         return
     _injected_counter().labels(site=site, kind=spec.kind).inc()
+    _observe.record("fault", f"{site}/{spec.kind}", site=site,
+                    kind=spec.kind, rank=spec.rank,
+                    arrival=_state.counts[site])
     if spec.kind == "slow":
         _sleep_slow(spec)
         return
@@ -407,6 +414,8 @@ def poll_payload(site):
     if spec is None:
         return None
     _injected_counter().labels(site=site, kind="bitflip").inc()
+    _observe.record("fault", f"{site}/bitflip", site=site, kind="bitflip",
+                    rank=spec.rank, channel="payload")
     return {"seed": spec.seed, "index": spec.index, "bit": spec.bit,
             "rank": spec.rank}
 
@@ -423,6 +432,8 @@ def corrupt(site, payload):
     if spec is None:
         return payload
     _injected_counter().labels(site=site, kind="bitflip").inc()
+    _observe.record("fault", f"{site}/bitflip", site=site, kind="bitflip",
+                    rank=spec.rank, channel="payload")
     return _flip(payload, spec)
 
 
@@ -478,3 +489,4 @@ def recovered(site, kind):
     """Tick ``mxtpu_faults_recovered_total`` — call after a recovery
     policy survived a fault (injected or real) at ``site``."""
     _recovered_counter().labels(site=site, kind=kind).inc()
+    _observe.record("recovery", f"{site}/{kind}", site=site, kind=kind)

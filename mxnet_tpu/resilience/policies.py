@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import time
 
+from .. import observe as _observe
 from ..base import MXNetError
 from . import faultline
 
@@ -202,4 +203,11 @@ def abort_to_checkpoint(dead_ranks, manager=None, ranks=None,
                 step = steps[-1] if steps else None
             else:
                 step = latest_step(manager.root)
+    # the black box's primary trigger: record the terminal transition and
+    # flush the flight record to disk BEFORE the error unwinds the stack
+    _observe.record("terminal", error_cls.__name__,
+                    dead_ranks=sorted(dead_ranks),
+                    checkpoint_step=step)
+    _observe.dump(reason=error_cls.__name__,
+                  root=manager.root if manager is not None else None)
     raise error_cls(dead_ranks, checkpoint_step=step)

@@ -45,6 +45,7 @@ is replaced, not debugged, mid-run.
 from __future__ import annotations
 
 from .. import env as _env
+from .. import observe as _observe
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from .policies import DeadNodeError
@@ -181,6 +182,9 @@ class StragglerPolicy:
                 if n == self.windows:
                     degraded.append(rank)
                     degraded_counter().labels(rank=str(rank)).inc()
+                    _observe.record("sentinel", "straggler_demoted",
+                                    rank=rank, ratio=ratio,
+                                    windows=n, ema=ema, median=median)
             else:
                 self._suspect[rank] = 0
         return sorted(degraded)
@@ -216,9 +220,13 @@ class DivergenceSentinel:
 
         loss = float(loss)
         if not math.isfinite(loss):
+            _observe.record("sentinel", "divergence_trip", loss=loss,
+                            ema=self.ema, finite=False)
             return True
         if self.ema is not None and self._seen >= self.warmup \
                 and loss > self.factor * self.ema:
+            _observe.record("sentinel", "divergence_trip", loss=loss,
+                            ema=self.ema, finite=True)
             return True
         self.ema = loss if self.ema is None else \
             self.alpha * loss + (1.0 - self.alpha) * self.ema

@@ -43,6 +43,7 @@ import warnings
 import numpy as onp
 
 from .. import env as _env
+from .. import observe as _observe
 from .. import telemetry as _telemetry
 from ..resilience import faultline as _faultline
 from ..resilience.policies import TRANSIENT_EXCEPTIONS
@@ -161,21 +162,28 @@ class Replica:
         ejection threshold (caller updates the state gauge)."""
         with self._lock:
             self.consecutive_failures += 1
-            if (self.state == HEALTHY
-                    and self.consecutive_failures >= self.eject_after):
+            crossed = (self.state == HEALTHY
+                       and self.consecutive_failures >= self.eject_after)
+            if crossed:
                 self.state = EJECTED
-                return True
-            return False
+                failures = self.consecutive_failures
+        if crossed:
+            _observe.record("fleet", "replica_ejected",
+                            replica=self.index, failures=failures)
+        return crossed
 
     def record_success(self):
         """Fresh observation clears suspicion; readmits an ejected
         replica (probe success).  Returns True on readmission."""
         with self._lock:
             self.consecutive_failures = 0
-            if self.state == EJECTED:
+            readmitted = self.state == EJECTED
+            if readmitted:
                 self.state = HEALTHY
-                return True
-            return False
+        if readmitted:
+            _observe.record("fleet", "replica_readmitted",
+                            replica=self.index)
+        return readmitted
 
     def set_state(self, state):
         with self._lock:
@@ -503,13 +511,17 @@ class Fleet:
         if req.pending_fault is not None:
             _faultline.recovered("serve.replica", req.pending_fault)
             req.pending_fault = None
+        failover = None
         with self._lock:
             if req.rerouted and self._death_ts is not None:
-                self.metrics.observe_failover(now - self._death_ts)
+                failover = now - self._death_ts
+                self.metrics.observe_failover(failover)
                 self._death_ts = None
             if self._example_arrays is None:
                 # remember a 1-row probe payload for re-admission checks
                 self._example_arrays = [a[:1].copy() for a in req.arrays]
+        if failover is not None:
+            _observe.record("fleet", "failover", seconds=failover)
 
     def _shed(self, req, now):
         if not req.future.done():
@@ -535,6 +547,9 @@ class Fleet:
         if fault_kind is not None:
             req.pending_fault = fault_kind
         self.metrics.event(req.sla.name, "rerouted")
+        _observe.record("fleet", "reroute", replica=failed_target.index,
+                        sla=req.sla.name, fault=fault_kind,
+                        attempts=req.attempts)
         self.router.push(req, req.sla.priority)
 
     # -- health ------------------------------------------------------------
@@ -546,6 +561,7 @@ class Fleet:
         target = self.replicas[index]
         target.set_state(DEAD)
         self.metrics.set_replica_state(index, DEAD)
+        _observe.record("fleet", "replica_dead", replica=index)
         with self._lock:
             if self._death_ts is None:
                 self._death_ts = time.perf_counter()

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import time
 
+from .. import observe as _observe
 from .. import profiler as _profiler
 from . import registry as _registry
 
@@ -82,6 +83,7 @@ class step_phase:
         dt = time.perf_counter() - self._t0
         self._span.__exit__(*exc)
         _phase_histogram().labels(phase=self.phase).observe(dt)
+        _observe.record("phase", self.phase, seconds=dt)
         return False
 
 
@@ -139,4 +141,6 @@ class collective_span:
         dt = time.perf_counter() - self._t0
         self._span.__exit__(*exc)
         _collective_metrics()[2].labels(op=self.op).observe(dt)
+        _observe.record("collective", self.op, seconds=dt,
+                        bytes=self.nbytes)
         return False

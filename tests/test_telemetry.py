@@ -118,6 +118,54 @@ def test_exposition_roundtrip():
     assert prom[("b", frozenset())] == -2.5
 
 
+def _unescape_label_value(v):
+    """Invert text-format 0.0.4 label-value escaping (\\\\, \\", \\n)."""
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            n = v[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(n, c + n))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def test_exposition_hostile_label_values_roundtrip():
+    """Backslashes, double quotes, and newlines in label VALUES must be
+    escaped per the Prometheus text format and parse back to the exact
+    original strings — no label may break the line-oriented exposition
+    (ISSUE 17 satellite)."""
+    hostile = [
+        "back\\slash", 'quo"te', "new\nline",
+        'all\\three" \n mixed', "\\n literal backslash-n",
+        "trailing backslash\\", '"', "\n", "\\",
+        'fake closer"} 9',
+    ]
+    reg = telemetry.MetricsRegistry()
+    c = reg.counter("hostile_total", "hostile labels", ("v",))
+    for i, val in enumerate(hostile):
+        c.labels(v=val).inc(i + 1)
+    text = reg.export_prometheus()
+    # line-oriented: raw newlines inside values never split a sample
+    sample_lines = [ln for ln in text.splitlines()
+                    if ln.startswith("hostile_total{")]
+    assert len(sample_lines) == len(hostile)
+    got = {}
+    prefix = 'hostile_total{v="'
+    for line in sample_lines:
+        assert line.startswith(prefix), line
+        escaped, value = line[len(prefix):].rsplit('"} ', 1)
+        assert "\n" not in escaped
+        got[_unescape_label_value(escaped)] = float(value)
+    assert got == {val: float(i + 1) for i, val in enumerate(hostile)}
+    # and the registry reads every hostile combination back untouched
+    for i, val in enumerate(hostile):
+        assert reg.get_sample_value("hostile_total", {"v": val}) == i + 1
+
+
 def test_registry_thread_safety():
     reg = telemetry.MetricsRegistry()
     c = reg.counter("n_total")

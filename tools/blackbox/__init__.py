@@ -1,0 +1,334 @@
+"""blackbox — merge per-host flight-recorder dumps into one pod
+timeline and name the first domino.
+
+Input: N per-host dumps written by ``mxnet_tpu.observe`` (atomic JSON,
+one per host, each a bounded ring of ``(mono_ns, wall_ns, rank,
+generation, category, name, payload)`` events).  Output:
+
+* a **merged timeline** — events from every host on one axis, ordered
+  by clock-skew-corrected wall time;
+* a **chrome-trace JSON** (``{"traceEvents": [...]}``, pid = host rank)
+  loadable in Perfetto next to the profiler's own traces;
+* a **root-cause verdict** — the earliest anomalous event (injected
+  fault, integrity violation, heartbeat gap, non-finite loss, straggler
+  demotion) preceding the terminal error in merged order, plus the
+  causal chain from it to the outcome.  A clean record yields ``NONE``.
+
+Clock-skew correction: every heartbeat *observation* a host records
+carries the peer's stamp (the peer's wall clock at write time) next to
+the observer's own ``wall_ns`` — a paired reading of two clocks.  The
+median of those pairs estimates each host's offset from the reference
+host (biased low by at most one beat of delivery delay, far below the
+skews that matter).  Hosts with no heartbeat pairs fall back to
+mono-offset alignment on shared generation-bump (``elastic/reshard``)
+events; a host with neither is left uncorrected and REPORTED in the
+verdict's warnings rather than silently mis-ordered.  Skews beyond
+``timeout/2`` — large enough to fool the liveness rule — are corrected
+like any other but also called out.
+
+Pure stdlib: the analyzer must run on a machine that has only the
+dumps, not the training stack.
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+
+__all__ = ["load", "load_dump", "merge", "analyze", "estimate_offsets",
+           "render_timeline", "chrome_trace", "verdict_line",
+           "is_anomalous"]
+
+_ANOMALOUS_SENTINEL = ("integrity_violation", "divergence_trip",
+                       "straggler_demoted")
+_CHAIN_FLEET = ("replica_dead", "replica_ejected", "reroute",
+                "failover", "replica_readmitted")
+
+
+def load_dump(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def load(paths):
+    """Load dumps from a mix of dump dicts, file paths, and directories
+    (directories contribute every ``blackbox-*.json`` inside)."""
+    if isinstance(paths, (str, os.PathLike, dict)):
+        paths = [paths]
+    dumps = []
+    for p in paths:
+        if isinstance(p, dict):
+            dumps.append(p)
+            continue
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                if name.startswith("blackbox-") and name.endswith(".json"):
+                    dumps.append(load_dump(os.path.join(p, name)))
+        else:
+            dumps.append(load_dump(p))
+    return dumps
+
+
+def _streams(dumps):
+    """Per-host event streams, deduped across overlapping dumps of the
+    same host (later dumps of one ring re-contain earlier events)."""
+    streams = {}
+    dropped = 0
+    for d in dumps:
+        host = int(d.get("host", 0))
+        dropped += int(d.get("dropped", 0) or 0)
+        seen = streams.setdefault(host, {})
+        for ev in d.get("events", []):
+            mono, wall, rank, gen, cat, name = ev[:6]
+            payload = ev[6] if len(ev) > 6 else None
+            key = (mono, cat, name)
+            if key not in seen:
+                seen[key] = {"mono_ns": int(mono), "wall_ns": int(wall),
+                             "host": host, "rank": rank, "gen": gen,
+                             "cat": cat, "name": name,
+                             "payload": payload or {}}
+    out = {h: sorted(s.values(), key=lambda e: e["mono_ns"])
+           for h, s in streams.items()}
+    return out, dropped
+
+
+def estimate_offsets(streams, timeout=60.0):
+    """Per-host wall-clock offsets (ns) relative to the lowest host id.
+
+    Returns ``(offsets, method, warnings)`` where ``method[h]`` is one
+    of ``reference`` / ``heartbeat`` / ``generation`` / ``uncorrected``.
+    """
+    hosts = sorted(streams)
+    if not hosts:
+        return {}, {}, []
+    ref = hosts[0]
+    samples = {}   # (observer, subject) -> [subject_clock - observer_clock]
+    for a, evs in streams.items():
+        for e in evs:
+            if e["cat"] != "heartbeat" or e["name"] != "observe":
+                continue
+            stamp = e["payload"].get("stamp")
+            b = e["payload"].get("rank")
+            if stamp is None or b is None:
+                continue
+            b = int(b)
+            if b == a or b not in streams:
+                continue
+            samples.setdefault((a, b), []).append(
+                float(stamp) * 1e9 - e["wall_ns"])
+    offsets = {ref: 0.0}
+    method = {ref: "reference"}
+    changed = True
+    while changed:
+        changed = False
+        for (a, b), ss in samples.items():
+            if a in offsets and b not in offsets:
+                offsets[b] = offsets[a] + statistics.median(ss)
+                method[b] = "heartbeat"
+                changed = True
+            elif b in offsets and a not in offsets:
+                offsets[a] = offsets[b] - statistics.median(ss)
+                method[a] = "heartbeat"
+                changed = True
+    # fallback: mono-offset alignment on shared generation-bump events
+    gens = {}
+    for h, evs in streams.items():
+        gens[h] = {e["payload"].get("generation"): e["wall_ns"]
+                   for e in evs
+                   if e["cat"] == "elastic" and e["name"] == "reshard"
+                   and e["payload"].get("generation") is not None}
+    changed = True
+    while changed:
+        changed = False
+        for h in hosts:
+            if h in offsets:
+                continue
+            for r in [x for x in hosts if x in offsets]:
+                shared = set(gens.get(h, ())) & set(gens.get(r, ()))
+                if shared:
+                    g = min(shared)
+                    offsets[h] = (gens[h][g] - gens[r][g]) + offsets[r]
+                    method[h] = "generation"
+                    changed = True
+                    break
+    warnings = []
+    half = float(timeout) / 2.0
+    for h in hosts:
+        if h not in offsets:
+            offsets[h] = 0.0
+            method[h] = "uncorrected"
+            warnings.append(
+                f"clock skew for host {h} UNCORRECTABLE (no heartbeat "
+                f"pairs and no shared generation events): its events "
+                f"keep raw wall-clock order and cross-host ordering "
+                f"against it is unreliable")
+        elif abs(offsets[h]) > half * 1e9:
+            warnings.append(
+                f"host {h} clock skew {offsets[h] / 1e9:+.3f}s exceeds "
+                f"timeout/2 ({half:.1f}s) — uncorrected this would fool "
+                f"the heartbeat liveness rule; timeline uses the "
+                f"corrected clock")
+    return {h: int(offsets[h]) for h in hosts}, method, warnings
+
+
+def merge(dumps, timeout=60.0):
+    """Merge dumps into one corrected timeline.
+
+    Returns ``(entries, offsets, warnings, dropped)``; each entry gains
+    ``t_ns`` — wall time mapped onto the reference host's clock."""
+    streams, dropped = _streams(dumps)
+    offsets, method, warnings = estimate_offsets(streams, timeout=timeout)
+    entries = []
+    for h, evs in streams.items():
+        off = offsets.get(h, 0)
+        for i, e in enumerate(evs):
+            e = dict(e)
+            e["t_ns"] = e["wall_ns"] - off
+            e["skew_method"] = method.get(h, "reference")
+            e["seq"] = i
+            entries.append(e)
+    entries.sort(key=lambda e: (e["t_ns"], e["host"], e["seq"]))
+    return entries, offsets, warnings, dropped
+
+
+def is_anomalous(entry):
+    cat, name = entry["cat"], entry["name"]
+    if cat == "fault":
+        return True
+    if cat == "sentinel" and name in _ANOMALOUS_SENTINEL:
+        return True
+    if cat == "heartbeat" and name == "observe" \
+            and entry["payload"].get("stale"):
+        return True
+    return False
+
+
+def _site_kind_rank(entry):
+    cat, name, p = entry["cat"], entry["name"], entry["payload"]
+    if cat == "fault":
+        return p.get("site"), p.get("kind"), p.get("rank")
+    if cat == "heartbeat":
+        return "kvstore.kv", "heartbeat_gap", p.get("rank")
+    if name == "integrity_violation":
+        return p.get("site"), "integrity_violation", None
+    if name == "divergence_trip":
+        kind = "divergence" if p.get("finite", True) else "non_finite_loss"
+        return "train.loss", kind, None
+    if name == "straggler_demoted":
+        return "kvstore.steptime", "straggler", p.get("rank")
+    return cat, name, None
+
+
+def _in_chain(entry):
+    cat, name = entry["cat"], entry["name"]
+    if is_anomalous(entry) or cat in ("terminal", "elastic", "recovery"):
+        return True
+    if cat == "fleet" and name in _CHAIN_FLEET:
+        return True
+    if cat == "checkpoint" \
+            and entry["payload"].get("outcome") not in ("ok", "written"):
+        return True
+    return False
+
+
+def analyze(dumps, timeout=60.0, chain_limit=50):
+    """The root-cause verdict over the merged timeline."""
+    dumps = load(dumps)
+    entries, offsets, warnings, dropped = merge(dumps, timeout=timeout)
+    hosts = sorted(offsets)
+    terminals = [e for e in entries if e["cat"] == "terminal"]
+    terminal = terminals[-1] if terminals else None
+    anomalies = [e for e in entries if is_anomalous(e)]
+    if terminal is not None:
+        before = [e for e in anomalies if e["t_ns"] <= terminal["t_ns"]]
+        root = before[0] if before else (anomalies[0] if anomalies
+                                         else None)
+    else:
+        root = anomalies[0] if anomalies else None
+    verdict = {
+        "hosts": hosts, "events": len(entries), "dropped": dropped,
+        "offsets_ns": offsets, "warnings": warnings,
+        "terminal": terminal, "root_cause": root, "chain": [],
+        "site": None, "kind": None, "rank": None,
+    }
+    if root is None:
+        verdict["verdict"] = "NONE"
+        return verdict
+    site, kind, rank = _site_kind_rank(root)
+    verdict["site"], verdict["kind"], verdict["rank"] = site, kind, rank
+    verdict["verdict"] = f"{site}/{kind}"
+    end_ns = terminal["t_ns"] if terminal is not None \
+        else entries[-1]["t_ns"]
+    chain = [e for e in entries
+             if root["t_ns"] <= e["t_ns"] <= end_ns and _in_chain(e)]
+    verdict["chain"] = chain[:chain_limit]
+    return verdict
+
+
+def _fmt_payload(payload, limit=5):
+    bits = []
+    for k, v in list(payload.items())[:limit]:
+        if isinstance(v, float):
+            v = f"{v:.6g}"
+        bits.append(f"{k}={v}")
+    return " ".join(bits)
+
+
+def render_timeline(entries, limit=None):
+    """The merged timeline as text, one line per event, times relative
+    to the first event on the reference clock."""
+    if not entries:
+        return "(no events)"
+    t0 = entries[0]["t_ns"]
+    shown = entries if limit is None else entries[-limit:]
+    lines = []
+    for e in shown:
+        lines.append(
+            f"+{(e['t_ns'] - t0) / 1e6:12.3f}ms host{e['host']} "
+            f"g{e['gen']} [{e['cat']}] {e['name']} "
+            f"{_fmt_payload(e['payload'])}".rstrip())
+    return "\n".join(lines)
+
+
+def chrome_trace(entries):
+    """Chrome-trace/Perfetto JSON, same shape as ``profiler.dumps()``:
+    ``{"traceEvents": [...]}`` with pid = host rank.  Events carrying a
+    ``seconds`` payload become complete (``X``) spans ending at their
+    record time; everything else is an instant (``i``)."""
+    if not entries:
+        return {"traceEvents": []}
+    t0 = entries[0]["t_ns"]
+    cats = sorted({e["cat"] for e in entries})
+    tid = {c: i for i, c in enumerate(cats)}
+    out = []
+    for e in entries:
+        ts = (e["t_ns"] - t0) / 1e3
+        base = {"name": e["name"], "cat": e["cat"], "pid": e["host"],
+                "tid": tid[e["cat"]], "args": e["payload"]}
+        seconds = e["payload"].get("seconds")
+        if isinstance(seconds, (int, float)) and seconds >= 0:
+            dur = float(seconds) * 1e6
+            out.append(dict(base, ph="X", ts=max(0.0, ts - dur), dur=dur))
+        else:
+            out.append(dict(base, ph="i", ts=ts, s="p"))
+    return {"traceEvents": out}
+
+
+def verdict_line(verdict):
+    warn = (f" [{len(verdict['warnings'])} warning(s): "
+            + "; ".join(verdict["warnings"]) + "]"
+            if verdict.get("warnings") else "")
+    if verdict["verdict"] == "NONE":
+        return (f"blackbox_verdict: NONE — no anomalous events "
+                f"({verdict['events']} events from "
+                f"{len(verdict['hosts'])} host(s)){warn}")
+    root, term = verdict["root_cause"], verdict["terminal"]
+    rank = f" rank={verdict['rank']}" if verdict["rank"] is not None else ""
+    outcome = (f"terminal {term['name']}" if term is not None
+               else "no terminal error (recovered in-run)")
+    return (f"blackbox_verdict: ROOT-CAUSE {verdict['verdict']}{rank} "
+            f"host={root['host']} gen={root['gen']} -> {outcome} "
+            f"(chain {len(verdict['chain'])} events, "
+            f"{verdict['events']} total from "
+            f"{len(verdict['hosts'])} host(s)){warn}")

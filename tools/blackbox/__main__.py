@@ -1,0 +1,65 @@
+"""``python -m tools.blackbox`` — merge per-host flight-recorder dumps
+into one pod timeline and print the root-cause verdict.
+
+    python -m tools.blackbox <ckpt_root>/blackbox --timeline
+    python -m tools.blackbox dump0.json dump1.json --trace pod.trace.json
+    python -m tools.blackbox --gate        # the ci.sh blackbox stage
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (analyze, chrome_trace, load, merge, render_timeline,
+               verdict_line)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.blackbox",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="dump files and/or directories holding "
+                         "blackbox-*.json (e.g. <ckpt_root>/blackbox)")
+    ap.add_argument("--timeout", type=float, default=60.0,
+                    help="heartbeat timeout (s) the skew warnings are "
+                         "judged against (default 60)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="print the merged text timeline")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="timeline: show only the last N events")
+    ap.add_argument("--trace", metavar="FILE",
+                    help="write a chrome-trace JSON (Perfetto-loadable)")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="print the full verdict as JSON")
+    ap.add_argument("--gate", action="store_true",
+                    help="run the CI gate instead (ignores paths); "
+                         "exits nonzero on FAIL")
+    args = ap.parse_args(argv)
+
+    if args.gate:
+        from .gate import run_gate
+        return 0 if run_gate() else 1
+    if not args.paths:
+        ap.error("no dumps given (pass paths, or --gate)")
+
+    dumps = load(args.paths)
+    entries, _offsets, _warnings, _dropped = merge(dumps,
+                                                   timeout=args.timeout)
+    if args.trace:
+        with open(args.trace, "w") as f:
+            json.dump(chrome_trace(entries), f)
+        print(f"wrote chrome trace: {args.trace} "
+              f"({len(entries)} events)")
+    if args.timeline:
+        print(render_timeline(entries, limit=args.limit))
+    verdict = analyze(dumps, timeout=args.timeout)
+    if args.as_json:
+        print(json.dumps(verdict, indent=2, default=str))
+    print(verdict_line(verdict))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

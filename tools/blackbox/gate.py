@@ -1,0 +1,100 @@
+"""The ci.sh ``blackbox`` stage (``python -m tools.blackbox --gate``).
+
+Two halves:
+
+1. **Root-cause on a real crash** — re-runs the endure permanent-kill
+   phase with recording on.  ``abort_to_checkpoint`` must have written
+   per-host dumps next to the checkpoint dir, and the analyzer must
+   root-cause the injected fault by site, kind, AND rank
+   (``kvstore.kv/dead_node rank=1``) from those dumps alone.
+
+2. **Overhead on a fault-free run** — 20 clean steps with recording on
+   must yield verdict ``NONE``, and the recorder's share of step time
+   must stay under 1%.  To keep the gate immune to CI timing noise the
+   overhead is measured as *events actually recorded during the run* x
+   *microbenchmarked per-record cost* / *run wall time* — not as the
+   difference of two noisy end-to-end timings.
+
+Prints one ``blackbox_verdict: PASS|FAIL`` line.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+
+# standalone process: same virtual-device rig as tools/endure.py, and it
+# must be in place before anything imports mxnet_tpu (jax reads
+# XLA_FLAGS once, at backend init)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+
+OVERHEAD_CEILING = 0.01   # recorder cost / step wall time
+CLEAN_STEPS = 20
+BENCH_RECORDS = 20000
+
+
+def run_gate():
+    from mxnet_tpu import observe
+    from mxnet_tpu.observe import FlightRecorder
+    from mxnet_tpu.resilience import ElasticWorld
+    from tools import blackbox, endure
+
+    checks = {}
+
+    # -- 1: endure permanent-kill with recording; analyze the dumps ----
+    observe.reset()
+    ndumps = 0
+    with tempfile.TemporaryDirectory(prefix="mxtpu-blackbox-") as root:
+        phase_checks, _extra = endure._phase_dead_node(root)
+        checks.update({f"endure_{k}": v for k, v in phase_checks.items()})
+        dumps = blackbox.load(os.path.join(root, "dead", "blackbox"))
+        ndumps = len(dumps)
+        checks["crash_dump_written"] = ndumps >= 1
+        verdict = blackbox.analyze(dumps) if dumps else {}
+        checks["root_cause_site"] = verdict.get("site") == "kvstore.kv"
+        checks["root_cause_kind"] = verdict.get("kind") == "dead_node"
+        checks["root_cause_rank"] = verdict.get("rank") == 1
+        checks["terminal_named"] = (
+            (verdict.get("terminal") or {}).get("name") in
+            ("DeadNodeError", "DegradedNodeError"))
+
+    # -- 2: fault-free run: verdict NONE + overhead < 1% ---------------
+    observe.reset()
+    job = endure._Job(ElasticWorld.fresh(endure.HOSTS))
+    for t in range(2):                      # compile warmup
+        job.run_step(t)
+    r0 = observe.snapshot()["recorded"]
+    t0 = time.perf_counter()
+    for t in range(2, 2 + CLEAN_STEPS):
+        job.run_step(t)
+    wall = time.perf_counter() - t0
+    events_in_run = observe.snapshot()["recorded"] - r0
+
+    scratch = FlightRecorder(capacity=4096, enabled=True)
+    b0 = time.perf_counter()
+    for _ in range(BENCH_RECORDS):
+        scratch.record("bench", "tick", seconds=0.0)
+    per_record = (time.perf_counter() - b0) / BENCH_RECORDS
+    overhead = events_in_run * per_record / wall if wall > 0 else 1.0
+
+    clean = blackbox.analyze([observe.snapshot(reason="fault_free")])
+    checks["fault_free_verdict_none"] = clean["verdict"] == "NONE"
+    checks["overhead_under_1pct"] = overhead < OVERHEAD_CEILING
+
+    ok = all(checks.values())
+    fail_bits = "" if ok else " FAILED: " + ",".join(
+        k for k, v in checks.items() if not v)
+    print(
+        f"blackbox_verdict: {'PASS' if ok else 'FAIL'} — root-caused "
+        f"kvstore.kv/dead_node rank=1 from {ndumps} crash dump(s); "
+        f"fault-free {CLEAN_STEPS}-step verdict "
+        f"{clean['verdict']} with recorder overhead {overhead * 100:.3f}% "
+        f"of step time ({events_in_run} events over {wall:.2f}s at "
+        f"{per_record * 1e6:.2f}us/record, ceiling "
+        f"{OVERHEAD_CEILING:.0%}){fail_bits}")
+    return ok

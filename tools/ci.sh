@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI entry (reference: ci/build.py + runtime_functions.sh stages).
 # Stages: lint | import | hloscan | census | smoke | test | chaos
-# | storm | endure | perf | dryrun | all (default: all).
+# | storm | endure | blackbox | perf | dryrun | all (default: all).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 stage="${1:-all}"
@@ -205,6 +205,17 @@ run_endure() {
     python -m tools.endure --gate
   fi
 }
+run_blackbox() {
+  # flight-recorder postmortem gate (ISSUE 17): the endure permanent-kill
+  # phase with recording on must leave crash dumps the analyzer
+  # root-causes to kvstore.kv/dead_node rank=1, and a 20-step fault-free
+  # run must yield verdict NONE with recorder overhead <1% of step time
+  # (docs/OBSERVABILITY.md "Black box / postmortem"; opt out with
+  # MXTPU_CHAOS_BLACKBOX=0)
+  if [ "${MXTPU_CHAOS_BLACKBOX:-1}" != "0" ]; then
+    python -m tools.blackbox --gate
+  fi
+}
 run_perf()   { python benchmark/opperf/opperf.py --smoke; }
 run_dryrun() {
   # pytest already runs the 4-process launcher test; skip it inside the
@@ -229,10 +240,11 @@ case "$stage" in
   chaos)   run_chaos ;;
   storm)   run_storm ;;
   endure)  run_endure ;;
+  blackbox) run_blackbox ;;
   perf)    run_perf ;;
   dryrun)  run_dryrun ;;
   all)     run_lint; run_import; run_hloscan; run_census; run_smoke
-           run_test; run_chaos; run_storm; run_endure; run_perf
-           run_dryrun ;;
+           run_test; run_chaos; run_storm; run_endure; run_blackbox
+           run_perf; run_dryrun ;;
   *) echo "unknown stage $stage" >&2; exit 2 ;;
 esac

@@ -76,7 +76,7 @@ if "xla_force_host_platform_device_count" not in \
 import numpy as onp
 
 import mxnet_tpu as mx
-from mxnet_tpu import autograd, gluon, telemetry
+from mxnet_tpu import autograd, gluon, observe, telemetry
 from mxnet_tpu.gluon import nn
 from mxnet_tpu.gluon.utils import split_and_load
 from mxnet_tpu.resilience import (CheckpointManager, ElasticSupervisor,
@@ -152,9 +152,23 @@ class _Job:
                 for k, p in self.net.collect_params().items()}
 
 
+def _blackbox_root_cause(site, kind, rank=None, dumps=None):
+    """Analyze the flight record the phase just produced (a live
+    snapshot, or on-disk crash dumps when given) and check the verdict
+    names the injected fault's site/kind (and rank when planned)."""
+    from tools import blackbox
+    if dumps is None:
+        dumps = [observe.snapshot(reason="endure")]
+    verdict = blackbox.analyze(dumps)
+    ok = (verdict["site"] == site and verdict["kind"] == kind
+          and (rank is None or verdict["rank"] == rank))
+    return ok, verdict
+
+
 def _phase_preempt(root):
     """Two preemptions, same topology: bitwise trajectory parity."""
     faultline.clear()
+    observe.reset()
     world = ElasticWorld.fresh(HOSTS)
 
     oracle = _Job(world)
@@ -189,17 +203,20 @@ def _phase_preempt(root):
     reshards = (reg.get_sample_value(
         "mxtpu_elastic_reshards_total") or 0) - res0
     sup.close()
+    bb_ok, _ = _blackbox_root_cause("collective.dispatch", "preempt")
     return {
         "preempt_bitwise": all(
             got[k].tobytes() == want[k].tobytes() for k in want),
         "preempt_recovered_2": recovered == 2,
         "preempt_no_reshard": reshards == 0,
+        "preempt_blackbox_root_cause": bb_ok,
     }, {"preempts_recovered": recovered}
 
 
 def _phase_dead_node(root):
     """Permanent host kill: re-shard 3 -> 2 and keep training."""
     faultline.clear()
+    observe.reset()
     world = ElasticWorld.fresh(HOSTS)
     pod = EmulatedPod(world.ranks)
     # one kvstore.kv arrival per live rank per liveness poll (one poll
@@ -249,7 +266,15 @@ def _phase_dead_node(root):
     lr = float(handle.trainer.learning_rate)
     want_lr = BASE_LR * (HOSTS - 1) / HOSTS
     sup.close()
+    # the abort wrote per-host crash dumps next to the checkpoint dir;
+    # the analyzer must root-cause the kill from those dumps alone
+    from tools import blackbox
+    dumps = blackbox.load(os.path.join(root, "dead", "blackbox"))
+    bb_ok, _ = _blackbox_root_cause("kvstore.kv", "dead_node", rank=1,
+                                    dumps=dumps) if dumps else (False, None)
     checks = {
+        "dead_blackbox_dumped": len(dumps) >= 1,
+        "dead_blackbox_root_cause": bb_ok,
         "resharded_once": reshards == 1,
         "dead_node_recovered": recovered >= 1,
         "survivor_world": sup.world.ranks == (0, 2),
@@ -301,6 +326,7 @@ def _phase_straggler(root):
     """Gray phase: rank 1 turns 25x slower, gets demoted and resharded
     away, and the survivors keep their pre-fault per-host pace."""
     faultline.clear()
+    observe.reset()
     world = ElasticWorld.fresh(HOSTS)
     pod = EmulatedPod(world.ranks)
     # one data.iterator arrival per rank per step (ranks in sorted
@@ -349,12 +375,14 @@ def _phase_straggler(root):
     finite = all(onp.isfinite(a).all()
                  for a in handle.params_np().values())
     sup.close()
+    bb_ok, _ = _blackbox_root_cause("data.iterator", "slow")
     checks = {
         "straggler_demoted": degraded == 1,
         "straggler_resharded": reshards == 1,
         "straggler_survivors": sup.world.ranks == (0, 2),
         "straggler_params_finite": finite,
         "straggler_throughput": ratio >= THROUGHPUT_FLOOR,
+        "straggler_blackbox_root_cause": bb_ok,
     }
     return checks, {"straggler_ratio": ratio}
 
@@ -365,6 +393,7 @@ def _phase_bitflip(root):
     keeps the parameters bitwise untouched that step."""
     del root  # no checkpoints needed: the guard must prevent the damage
     faultline.clear()
+    observe.reset()
     reg = telemetry.default_registry()
     vio0 = reg.get_sample_value(
         "mxtpu_integrity_violations_total",
@@ -407,12 +436,16 @@ def _phase_bitflip(root):
     recovered = (reg.get_sample_value(
         "mxtpu_faults_recovered_total",
         {"site": "collective.dispatch", "kind": "bitflip"}) or 0) - rec0
+    # no checkpoint root here, so the verdict comes from a live snapshot
+    bb_ok, _ = _blackbox_root_cause("collective.dispatch", "bitflip",
+                                    rank=1)
     checks = {
         "bitflip_caught": violations >= 1,
         "bitflip_step_skipped": skipped == 1,
         "bitflip_params_unchanged": before == after,
         "bitflip_recovered": recovered == 1,
         "bitflip_training_resumed": resumed != after,
+        "bitflip_blackbox_root_cause": bb_ok,
     }
     return checks, {"bitflip_violations": violations}
 
@@ -445,6 +478,7 @@ def _phase_divergence(root):
     rolls back to the newest complete checkpoint once and the run
     completes with finite parameters."""
     faultline.clear()
+    observe.reset()
     world = ElasticWorld.fresh(HOSTS)
     reg = telemetry.default_registry()
     rb0 = reg.get_sample_value("mxtpu_sentinel_rollbacks_total") or 0
@@ -471,10 +505,12 @@ def _phase_divergence(root):
                  for a in handle.params_np().values())
     steps_run = max(t for t, _dt, _s in handle.step_seconds) + 1
     sup.close()
+    bb_ok, _ = _blackbox_root_cause("data.iterator", "bitflip")
     checks = {
         "diverge_rolled_back_once": rollbacks == 1,
         "diverge_run_completed": steps_run == STEPS_B,
         "diverge_params_finite": finite,
+        "diverge_blackbox_root_cause": bb_ok,
     }
     return checks, {"diverge_rollbacks": rollbacks}
 

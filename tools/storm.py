@@ -36,7 +36,7 @@ import time
 import numpy as onp
 
 import mxnet_tpu as mx
-from mxnet_tpu import telemetry
+from mxnet_tpu import observe, telemetry
 from mxnet_tpu.gluon import nn
 from mxnet_tpu.resilience import faultline
 from mxnet_tpu.serve import DeadlineExceeded, Fleet, SLAClass
@@ -95,6 +95,7 @@ def run_storm(replicas=3, clients=6, requests=20, seed=7, kill_at=None,
     example = onp.zeros((1, IN_UNITS), onp.float32)
     compiled = fleet.warmup(example)
     faultline.clear()
+    observe.reset()
     if not no_fault:
         faultline.plan([{"site": "serve.replica", "kind": "preempt",
                          "at": int(kill_at)}])
@@ -145,10 +146,18 @@ def run_storm(replicas=3, clients=6, requests=20, seed=7, kill_at=None,
         "outputs_correct": wrong == 0,
         "sla_p99": all(v["ok"] for v in sla.values()),
     }
+    # the flight record of the storm must root-cause the injected kill
+    # (or stay clean when none was planned)
+    from tools import blackbox
+    bb = blackbox.analyze([observe.snapshot(reason="storm")])
     if not no_fault:
         checks["replica_killed"] = len(dead) == 1
         checks["fault_recovered"] = recovered >= 1
         checks["failover_measured"] = failover_n >= 1
+        checks["blackbox_root_cause"] = (bb["site"] == "serve.replica"
+                                         and bb["kind"] == "preempt")
+    else:
+        checks["blackbox_clean"] = bb["verdict"] == "NONE"
     ok = all(checks.values())
 
     p99s = ", ".join(
