@@ -22,7 +22,10 @@ import time
 
 import numpy as onp
 
+sys.path.insert(0, os.path.dirname(__file__))
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from timing_util import scan_ms  # noqa: E402
 
 B, H, D = 4, 8, 64
 
@@ -100,74 +103,6 @@ def main():
                                       block_q=bq, block_k=bk)
         return flash
 
-    def drain(x):
-        onp.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0])
-
-    def scan_ms(impl, qkv, grad):
-        """Per-iteration kernel ms via a chained lax.scan; (ms, k, ok)."""
-        q0, kk, vv = qkv
-        if grad:
-            gfn = jax.value_and_grad(
-                lambda q, k, v: impl(q, k, v).sum().astype(jnp.float32),
-                argnums=(0, 1, 2))
-
-            def body(c, _):
-                val, (gq, gk, gv) = gfn(c, kk, vv)
-                dep = (val + gq.astype(jnp.float32).sum()
-                       + gk.astype(jnp.float32).sum()
-                       + gv.astype(jnp.float32).sum()) * 1e-24
-                return c + dep.astype(c.dtype), None
-        else:
-            def body(c, _):
-                out = impl(c, kk, vv)
-                dep = out.astype(jnp.float32).sum() * 1e-24
-                return c + dep.astype(c.dtype), None
-
-        def make(n):
-            @jax.jit
-            def run(c):
-                c, _ = jax.lax.scan(body, c, None, length=n)
-                return c
-            return run
-
-        drain(q0)
-        t_sync = min((lambda t0: (drain(q0),
-                                  time.perf_counter() - t0)[1])(
-            time.perf_counter()) for _ in range(3))
-
-        # size the scan from a k=2 probe (one extra compile, but immune
-        # to wild per-T cost differences: 1 ms at T=1k, ~1 s at 8k fwd)
-        run2 = make(2)
-        drain(run2(q0))  # compile
-        t0 = time.perf_counter()
-        drain(run2(q0))
-        est = max((time.perf_counter() - t0 - t_sync) / 2, 1e-5)
-        # clamp the window to ~12 s of device time so a drift-poisoned
-        # probe estimate cannot produce a minutes-long scan
-        n = int(min(max(6.0 * t_sync / est, 8), 4096, 12.0 / est))
-        n = max(n, 8)
-        for attempt in range(2):
-            run_n = make(n)
-            drain(run_n(q0))  # compile
-            best = None
-            for _ in range(3):
-                t0 = time.perf_counter()
-                drain(run_n(q0))
-                best = min(best or 1e9, time.perf_counter() - t0)
-            work = best - t_sync
-            if work >= 2 * t_sync or attempt == 1:
-                break
-            # probe est was too high -> n too small: regrow from the
-            # measured per-iteration work (one extra compile)
-            per = max(work / n, 1e-7)
-            n2 = int(min(max(6.0 * t_sync / per, n * 4), 4096, 12.0 / per))
-            if n2 == n:
-                break  # capped: a recompile would reproduce this scan
-            n = n2
-        # floor at 1 ns/iter: noise can push work <= 0 on a fast backend,
-        # and a 0.0 would divide-by-zero in the tokens/s line
-        return max(work / n, 1e-9) * 1e3, n, work >= 2 * t_sync
-
     suffix = ("_causal" if causal else "") + \
         ("_masked" if args.masked else "") + \
         (f"_drop{int(drop * 100)}" if drop else "")
@@ -190,7 +125,9 @@ def main():
                         functools.partial(base, mask=mask_t))
                 tag = f"{name}_{kind}{suffix}"
                 try:
-                    ms, n, ok = scan_ms(impl, qkv, grad)
+                    # full dq/dk/dv backward, not just dq (grad="all")
+                    ms, n, ok = scan_ms(impl, qkv,
+                                        grad="all" if grad else False)
                     row = {
                         "metric": f"attn_{tag}_ms",
                         "seq_len": t, "value": round(ms, 3), "unit": "ms",

@@ -11,20 +11,26 @@ activation per layer.
 This script measures, per representative ResNet-50 1x1 shape at batch 128:
   (a) XLA: y = x @ w; s = sum(y); ss = sum(y*y)   (jitted together)
   (b) Pallas: fused kernel emitting y, s, ss in one pass
-Timing uses value readbacks (block_until_ready is acked early by the
-tunnel). Prints one JSON line per shape plus a summary.
+Timing is the shared scan-amortized discipline in timing_util /
+mxnet_tpu.tune.sweep (block_until_ready is acked early by the tunnel).
+Prints one JSON line per shape plus a summary.
 """
 from __future__ import annotations
 
 import functools
 import json
-import time
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
 import numpy as onp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from timing_util import scan_ms  # noqa: E402
 
 
 def _fused_kernel(x_ref, w_ref, y_ref, s_ref, ss_ref, acc_s, acc_ss):
@@ -93,38 +99,6 @@ def matmul_bn_stats_xla(x, w):
     return y.astype(x.dtype), s, ss
 
 
-def _sync(*outs):
-    for o in outs:
-        onp.asarray(o.ravel()[0])
-
-
-INNER = 30  # iterations inside one dispatch: the tunnel costs ~20 ms/call
-
-
-def _looped(fn):
-    @jax.jit
-    def run(x, w):
-        def body(carry, _):
-            xc = carry
-            y, srow, ss = fn(xc, w)
-            # serialize iterations through a scalar data dependency
-            xc = xc * (1.0 + 0.0 * srow.ravel()[0]).astype(xc.dtype)
-            return xc, (srow.ravel()[0], ss.ravel()[0], y.ravel()[0])
-        carry, outs = jax.lax.scan(body, x, None, length=INNER)
-        return carry, outs
-    return run
-
-
-def bench(fn, x, w):
-    run = _looped(fn)
-    outs = run(x, w)
-    _sync(outs[0])
-    t0 = time.perf_counter()
-    outs = run(x, w)
-    _sync(outs[0])
-    return (time.perf_counter() - t0) / INNER
-
-
 SHAPES = [  # (M=B*H*W, K=Cin, N=Cout) for batch-128 ResNet-50 1x1 convs
     (128 * 56 * 56, 64, 256),
     (128 * 56 * 56, 256, 64),
@@ -153,14 +127,15 @@ def main():
         onp.testing.assert_allclose(onp.asarray(y1, onp.float32),
                                     onp.asarray(y2, onp.float32), rtol=5e-2,
                                     atol=1.0)
-        t_xla = bench(lambda a, b: matmul_bn_stats_xla(a, b), x, w)
-        t_pal = bench(lambda a, b: matmul_bn_stats_pallas(a, b), x, w)
-        speedups.append(t_xla / t_pal)
+        ms_xla, _, ok_xla = scan_ms(matmul_bn_stats_xla, (x, w))
+        ms_pal, _, ok_pal = scan_ms(matmul_bn_stats_pallas, (x, w))
+        speedups.append(ms_xla / ms_pal)
         print(json.dumps({
             "shape": [m, k, n],
-            "xla_ms": round(t_xla * 1e3, 3),
-            "pallas_ms": round(t_pal * 1e3, 3),
-            "speedup": round(t_xla / t_pal, 3),
+            "xla_ms": round(ms_xla, 3),
+            "pallas_ms": round(ms_pal, 3),
+            "speedup": round(ms_xla / ms_pal, 3),
+            "reliable": ok_xla and ok_pal,
         }), flush=True)
     print(json.dumps({"geomean_speedup": round(
         float(onp.exp(onp.mean(onp.log(speedups)))), 3)}))

@@ -156,20 +156,22 @@ def main():
 
     from mxnet_tpu.ops import pallas_kernels as pk
 
-    if args.blocks:
-        def prod(bq, bk):
-            return lambda q, k, v: pk.flash_attention(q, k, v,
-                                                      block_q=bq,
-                                                      block_k=bk)
-        variants = {
-            "bq512_bk512": prod(512, 512),
-            "bq512_bk1024": prod(512, 1024),
-            "bq256_bk1024": prod(256, 1024),
-            "bq512_bk2048": prod(512, 2048),
-            "bq256_bk2048": prod(256, 2048),
-            "bq1024_bk512": prod(1024, 512),
-        }
-    else:
+    def prod(bq, bk):
+        return lambda q, k, v: pk.flash_attention(q, k, v,
+                                                  block_q=bq,
+                                                  block_k=bk)
+
+    def block_variants(t):
+        # the autotuner's candidate grid (mxnet_tpu.tune.kernels), not a
+        # hand-rolled list — one sweep definition for bench and tool
+        from mxnet_tpu.tune import kernels as tk
+        spec = tk.get("flash_attention")
+        sig = tk.signature("bfloat16", b=B, h=H, t=t, d=D)
+        return {f"bq{p['block_q']}_bk{p['block_k']}":
+                prod(p["block_q"], p["block_k"])
+                for p in spec.grid(sig)}
+
+    if not args.blocks:
         variants = {
             "full": lambda q, k, v: pk.flash_attention(q, k, v),
             "probe_ref": _variant_kernel("ref"),
@@ -180,6 +182,8 @@ def main():
 
     rows = []
     for t in (int(x) for x in args.seq_lens.split(",")):
+        if args.blocks:
+            variants = block_variants(t)
         qkv = [jnp.asarray(onp.random.randn(B, H, t, D), jnp.bfloat16)
                for _ in range(3)]
         flops = 4.0 * B * H * t * t * D
@@ -188,7 +192,8 @@ def main():
         kind = "fwd_bwd" if args.grad else "fwd"
         for name, impl in variants.items():
             try:
-                ms, n, ok = scan_ms(impl, qkv, grad=args.grad)
+                ms, n, ok = scan_ms(impl, qkv,
+                                    grad="all" if args.grad else False)
                 rows.append({
                     "metric": f"flash_roofline_{name}_{kind}_ms",
                     "seq_len": t, "value": round(ms, 3), "unit": "ms",

@@ -52,6 +52,11 @@ def main():
     p.add_argument("--bptt", type=int, default=35)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--output", default=None)
+    p.add_argument("--pre-tune", type=float, default=None,
+                   help="pre-autotune tokens/s baseline for this config; "
+                        "records pre_tune_tokens_per_s + speedup_vs_pre_"
+                        "tune in the artifact (PR 18 acceptance: b=32 "
+                        ">= 1.5x)")
     args = p.parse_args()
     b, t = args.batch, args.bptt
 
@@ -127,6 +132,9 @@ def main():
         "mfu_vs_197tf_bf16": round(tok_s * fpt / PEAK_BF16, 4),
         "steps_per_s": round(tok_s / (b * t), 2),
     }
+    if args.pre_tune:
+        result["pre_tune_tokens_per_s"] = round(args.pre_tune)
+        result["speedup_vs_pre_tune"] = round(tok_s / args.pre_tune, 4)
     line = json.dumps(result)
     print(line, flush=True)
     if args.output:

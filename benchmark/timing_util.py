@@ -7,105 +7,23 @@ caused).  The fix used across benchmark/: chain N calls inside one
 `lax.scan`, feeding a 1e-24-scaled summary of each output back into the
 carry so nothing is hoisted or dead-coded, measure the drain separately
 and subtract, and require scan work >= 2x drain for a `reliable` row.
+
+The implementation now lives in ``mxnet_tpu.tune.sweep`` — the
+autotuner's sweep runner — so the benches and ``tools/autotune`` share
+ONE timing/trimming discipline.  This module is the benches' import
+shim (benchmark/ is not a package).
 """
 from __future__ import annotations
 
-import time
+import os
+import sys
 
-import numpy as onp
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-
-def scan_ms(impl, args, grad=False, max_seconds=12.0):
-    """Per-call device ms of ``impl(*args)`` (or its value+grad when
-    ``grad``), via a chained lax.scan.  Returns (ms, scan_len, reliable).
-
-    The first element of ``args`` is the scan carry; the rest close over.
-    """
-    import jax
-    import jax.numpy as jnp
-
-    c0, rest = args[0], tuple(args[1:])
-
-    if grad:
-        gfn = jax.value_and_grad(
-            lambda c, *r: impl(c, *r).sum().astype(jnp.float32),
-            argnums=(0,))
-
-        def body(c, _):
-            val, (gc,) = gfn(c, *rest)
-            dep = (val + gc.astype(jnp.float32).sum()) * 1e-24
-            return c + dep.astype(c.dtype), None
-    else:
-        def body(c, _):
-            out = impl(c, *rest)
-            dep = jax.tree_util.tree_reduce(
-                lambda a, x: a + x.astype(jnp.float32).sum(),
-                out, jnp.float32(0.0)) * 1e-24
-            return c + dep.astype(c.dtype), None
-
-    def make(n):
-        @jax.jit
-        def run(c):
-            c, _ = jax.lax.scan(body, c, None, length=n)
-            return c
-        return run
-
-    def drain(x):
-        onp.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0])
-
-    drain(c0)
-    t_sync = min((lambda t0: (drain(c0),
-                              time.perf_counter() - t0)[1])(
-        time.perf_counter()) for _ in range(3))
-
-    run2 = make(2)
-    drain(run2(c0))
-    t0 = time.perf_counter()
-    drain(run2(c0))
-    est = max((time.perf_counter() - t0 - t_sync) / 2, 1e-5)
-    n = int(min(max(6.0 * t_sync / est, 8), 4096, max_seconds / est))
-    n = max(n, 8)
-    for attempt in range(2):
-        run_n = make(n)
-        drain(run_n(c0))
-        best = None
-        for _ in range(3):
-            t0 = time.perf_counter()
-            drain(run_n(c0))
-            best = min(best or 1e9, time.perf_counter() - t0)
-        work = best - t_sync
-        if work >= 2 * t_sync or attempt == 1:
-            break
-        per = max(work / n, 1e-7)
-        n2 = int(min(max(6.0 * t_sync / per, n * 4), 4096,
-                     max_seconds / per))
-        if n2 == n:
-            break
-        n = n2
-    return max(work / n, 1e-9) * 1e3, n, work >= 2 * t_sync
-
-
-DRAIN_S = 0.1   # one ~100 ms tunnel readback per window (see module doc)
-
-
-def window_iters(est_step_s, target_s=3.0, min_iters=10, max_iters=5000):
-    """Size a throughput window from a measured per-step time so the
-    tunnel drain stays a small fraction of it (~3% at the 3 s default).
-    Shared by the FusedTrainStep-style benches (bert_pretrain / rnn_lm /
-    lenet_mnist) so the drain-avoidance logic lives in one place.  The
-    iteration cap is a runaway guard only — it must stay far above
-    target_s / fastest-real-step (~2 ms) or it would silently
-    re-shorten windows for exactly the benches this exists for."""
-    return int(min(max(target_s / max(est_step_s, 1e-4), min_iters),
-                   max_iters))
-
-
-def measured_step_s(run_step, drain, n=3):
-    """Per-step seconds from ``n`` steps + one drain (DRAIN_S subtracted)
-    — the probe every bench feeds into :func:`window_iters`."""
-    import time
-    t0 = time.perf_counter()
-    for _ in range(n):
-        run_step()
-    drain()
-    return max((time.perf_counter() - t0 - DRAIN_S) / n, 1e-3)
+from mxnet_tpu.tune.sweep import (  # noqa: E402,F401
+    DRAIN_S,
+    measured_step_s,
+    scan_ms,
+    trimmed_median,
+    window_iters,
+)

@@ -195,6 +195,21 @@ MXNET_BLACKBOX_DIR           fixed directory for postmortem dumps;
                              checkpoint step dirs (``<root>/blackbox``)
                              or ``./blackbox`` with no checkpoint root
                              (read at each dump)
+MXNET_AUTOTUNE               ``0`` disables the autotune winner cache:
+                             every tuned kernel (flash attention, the
+                             scan-LSTM cell, the s2d stem, the
+                             BN-backward epilogue) silently uses its
+                             documented static default and ``tune.best``
+                             stops warning about misses (default on;
+                             read once at the first cache consult and
+                             memoized for the process —
+                             ``tune.invalidate()`` re-reads)
+MXNET_AUTOTUNE_CACHE         path of the autotune winner cache to read
+                             instead of the committed
+                             ``tools/autotune_cache.json`` (e.g. a
+                             freshly swept cache under review; read
+                             once at the first cache consult, see
+                             docs/AUTOTUNE.md)
 =========================== =================================================
 """
 from __future__ import annotations
@@ -208,7 +223,8 @@ __all__ = ["apply", "describe", "is_naive_engine", "cpu_worker_nthreads",
            "sentinel_slow_factor", "sentinel_loss_factor",
            "sentinel_rollbacks", "kvstore_integrity",
            "parallel_recipe", "recipe_strict", "blackbox_enabled",
-           "blackbox_events", "blackbox_dir"]
+           "blackbox_events", "blackbox_dir", "autotune_enabled",
+           "autotune_cache_path"]
 
 _naive_engine = False
 
@@ -382,6 +398,24 @@ def blackbox_dir(default=None):
     return v.strip()
 
 
+def autotune_enabled(default=True):
+    """Whether tuned dispatch consults the autotune winner cache at all
+    (``0`` = static defaults everywhere, no miss warnings)."""
+    v = os.environ.get("MXNET_AUTOTUNE")
+    if v is None:
+        return default
+    return v not in ("0", "")
+
+
+def autotune_cache_path(default=None):
+    """Cache-file override; None = the committed
+    ``tools/autotune_cache.json``."""
+    v = os.environ.get("MXNET_AUTOTUNE_CACHE")
+    if v is None or not v.strip():
+        return default
+    return v.strip()
+
+
 def apply():
     """Read the environment once at package import."""
     global _naive_engine
@@ -440,5 +474,6 @@ def describe():
              "MXNET_SENTINEL_ROLLBACKS", "MXNET_KVSTORE_INTEGRITY",
              "MXNET_PARALLEL_RECIPE", "MXNET_RECIPE_STRICT",
              "MXNET_BLACKBOX", "MXNET_BLACKBOX_EVENTS",
-             "MXNET_BLACKBOX_DIR"]
+             "MXNET_BLACKBOX_DIR", "MXNET_AUTOTUNE",
+             "MXNET_AUTOTUNE_CACHE"]
     return [(n, os.environ.get(n), n in __doc__) for n in names]

@@ -23,7 +23,7 @@ __all__ = [
     "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D", "GlobalMaxPool2D",
     "GlobalMaxPool3D", "GlobalAvgPool1D", "GlobalAvgPool2D",
     "GlobalAvgPool3D", "ReflectionPad2D", "PixelShuffle1D", "PixelShuffle2D",
-    "PixelShuffle3D", "DeformableConvolution",
+    "PixelShuffle3D", "DeformableConvolution", "SpaceToDepthStem",
 ]
 
 
@@ -100,6 +100,48 @@ class _Conv(HybridBlock):
     def __repr__(self):
         return (f"{type(self).__name__}({self._channels}, "
                 f"kernel_size={self._kernel}, stride={self._strides})")
+
+
+class SpaceToDepthStem(HybridBlock):
+    """The 7x7/stride-2 ResNet stem in space-to-depth form.
+
+    Takes the PACKED input — ``mx.nd.space_to_depth(x, 2)``, applied in
+    the input pipeline where the packing cost belongs — and runs the
+    algebraically-equivalent 4x4/stride-1 conv with the 7x7 kernel
+    folded at trace time (``ops/stem.py``; dense K = 4*C_in*16
+    contraction instead of the 3-channel-starved strided conv, the fix
+    that retires the census stem MFU waiver).  Bias-free by design: the
+    stem feeds a BatchNorm, and a broadcast bias add would double the
+    layer's output bytes.  The weight keeps the classic
+    ``(channels, in_channels, 7, 7)`` layout, so checkpoints exchange
+    1:1 with a ``Conv2D(channels, 7, strides=2, padding=3)`` stem and
+    gradients flow through the fold.
+    """
+
+    def __init__(self, channels, in_channels=3, weight_initializer=None,
+                 dtype="float32"):
+        super().__init__()
+        self._channels = channels
+        self._in_channels = in_channels
+        self.weight = Parameter("weight", shape=(channels, in_channels, 7, 7),
+                                dtype=dtype,
+                                init=_resolve_init(weight_initializer),
+                                allow_deferred_init=True)
+
+    def forward(self, x):
+        if x.shape[1] != 4 * self._in_channels:
+            raise ValueError(
+                f"SpaceToDepthStem wants the packed (B, {4 * self._in_channels}, "
+                f"H/2, W/2) input (space_to_depth block 2 of "
+                f"{self._in_channels} channels), got {x.shape} — apply "
+                f"mx.nd.space_to_depth(x, 2) in the input pipeline")
+        if self.weight._data is None:
+            self.weight.finish_deferred_init()
+        return npx.stem_conv(x, self.weight.data())
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._channels}, "
+                f"in_channels={self._in_channels})")
 
 
 class Conv1D(_Conv):

@@ -26,8 +26,26 @@ from ..nn.basic_layers import _resolve_init
 __all__ = ["RNN", "LSTM", "GRU"]
 
 
-def _cell_step(mode, x_proj, h, c, h2h_w, h2h_b):
-    """One timestep; x_proj is the precomputed input projection."""
+def _cell_step(mode, x_proj, h, c, h2h_w, h2h_b, gate_layout="fused"):
+    """One timestep; x_proj is the precomputed input projection.
+
+    ``gate_layout`` is the tuned LSTM recurrent-matmul shape: ``fused``
+    computes all gates as one (H, 4H) matmul then splits; ``split``
+    issues one (H, H) matmul per gate so each gate's activation chains
+    off a smaller contraction.  Which wins is shape/backend-dependent —
+    exactly why it is an autotune axis (kernel ``lstm_cell``) and not a
+    constant."""
+    if mode == "lstm" and gate_layout == "split":
+        xi, xf, xc, xo = jnp.split(x_proj, 4, axis=-1)
+        wi, wf, wc, wo = jnp.split(h2h_w, 4, axis=0)
+        bi, bf, bc, bo = jnp.split(h2h_b, 4)
+        i = jax.nn.sigmoid(xi + jnp.dot(h, wi.T) + bi)
+        f = jax.nn.sigmoid(xf + jnp.dot(h, wf.T) + bf)
+        cc = jnp.tanh(xc + jnp.dot(h, wc.T) + bc)
+        o = jax.nn.sigmoid(xo + jnp.dot(h, wo.T) + bo)
+        nc = f * c + i * cc
+        nh = o * jnp.tanh(nc)
+        return nh, nc
     g = x_proj + jnp.dot(h, h2h_w.T) + h2h_b
     if mode == "rnn_relu":
         nh = jax.nn.relu(g)
@@ -59,25 +77,42 @@ def _cell_step(mode, x_proj, h, c, h2h_w, h2h_b):
 
 
 def _run_single_direction(mode, x_tnc, h0, c0, i2h_w, i2h_b, h2h_w, h2h_b,
-                          reverse=False):
-    """scan over time for one layer/direction. x: (T, N, C)."""
+                          reverse=False, unroll=None, gate_layout=None):
+    """scan over time for one layer/direction. x: (T, N, C).
+
+    ``unroll`` (scan body replication — amortizes per-step control
+    overhead against the tiny per-step matmul) and ``gate_layout`` (see
+    `_cell_step`) are the LSTM cell's tuned parameters: left ``None``
+    they come from the autotune cache at trace time (kernel
+    ``lstm_cell``, one consult per traced shape), with the pre-tune
+    behavior — plain scan, fused 4H gate matmul — as the documented
+    static default on any miss.  Explicit values are sweep candidates
+    (tune/kernels.py forces them)."""
+    t, n, _ = x_tnc.shape
+    if mode == "lstm" and (unroll is None or gate_layout is None):
+        from ... import tune
+        tuned = tune.best(
+            "lstm_cell", tune.signature(x_tnc.dtype, b=n, t=t,
+                                        h=h0.shape[-1]),
+            {"unroll": 1, "gate_layout": "fused"})
+        unroll = tuned["unroll"] if unroll is None else unroll
+        gate_layout = tuned["gate_layout"] if gate_layout is None \
+            else gate_layout
+    unroll = 1 if unroll is None else int(unroll)
+    gate_layout = gate_layout or "fused"
     if reverse:
         x_tnc = jnp.flip(x_tnc, axis=0)
     # batch the input projection over all timesteps: one MXU matmul
     x_proj = jnp.einsum("tnc,gc->tng", x_tnc, i2h_w) + i2h_b
 
-    if mode == "gru":
-        def step(carry, xp):
-            h, c = carry
-            nh, nc = _cell_step(mode, xp, h, c, h2h_w, h2h_b)
-            return (nh, nc), nh
-    else:
-        def step(carry, xp):
-            h, c = carry
-            nh, nc = _cell_step(mode, xp, h, c, h2h_w, h2h_b)
-            return (nh, nc), nh
+    def step(carry, xp):
+        h, c = carry
+        nh, nc = _cell_step(mode, xp, h, c, h2h_w, h2h_b,
+                            gate_layout=gate_layout)
+        return (nh, nc), nh
 
-    (hT, cT), out = jax.lax.scan(step, (h0, c0), x_proj)
+    (hT, cT), out = jax.lax.scan(step, (h0, c0), x_proj,
+                                 unroll=min(unroll, t))
     if reverse:
         out = jnp.flip(out, axis=0)
     return out, hT, cT
@@ -126,6 +161,15 @@ class _RNNLayer(HybridBlock):
         p = Parameter(name, shape=shape, init=_resolve_init(init),
                       allow_deferred_init=True, dtype=dtype)
         setattr(self, name, p)
+
+    def cast(self, dtype):
+        # reference `_RNNLayer.cast` also retargets self._dtype: without
+        # it begin_state() keeps emitting float32 initial states, the
+        # scan carry promotes every gate op, and layer >= 1 of a bf16
+        # model silently computes in f32 (and the lstm_cell autotune
+        # lookup misses on dtype)
+        super().cast(dtype)
+        self._dtype = dtype
 
     def state_info(self, batch_size=0):
         raise NotImplementedError

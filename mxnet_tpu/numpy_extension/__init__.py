@@ -15,6 +15,7 @@ from ..context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpu
 from ..ndarray.ndarray import NDArray, waitall
 from ..ops import nn as _nn
 from ..ops import spatial as _spatial
+from ..ops import stem as _stem
 from ..ops import tensor_extra as _tex
 from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
 from ..ops.invoke import invoke, is_recording, is_training
@@ -37,6 +38,7 @@ __all__ = [
     "reset_arrays", "grid_generator", "bilinear_sampler",
     "spatial_transformer", "roi_pooling", "im2col", "col2im",
     "reshape", "nonzero", "index_add", "index_update", "constraint_check",
+    "stem_conv",
 ]
 
 seed = _rng.seed
@@ -51,6 +53,7 @@ def _op(fun, name, differentiable=True):
 
 activation = _op(_nn.activation, "activation")
 convolution = _op(_nn.convolution, "convolution")
+stem_conv = _op(_stem.stem_conv_auto, "stem_conv")
 deconvolution = _op(_nn.deconvolution, "deconvolution")
 fully_connected = _op(_nn.fully_connected, "fully_connected")
 pooling = _op(_nn.pooling, "pooling")

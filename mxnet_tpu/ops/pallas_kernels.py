@@ -99,16 +99,24 @@ def _prec(dt):
 
 
 def _pick_block(t, want):
-    """Largest power-of-two block <= want dividing t (>=128 when t allows,
-    else t itself for tiny sequences)."""
+    """Largest power-of-two block <= want dividing t (>=8; t itself only
+    for tiny sequences or genuinely odd T).  Cached autotune winners are
+    fed through here as TARGETS, so a bucket entry (t=1024) stays legal
+    for every concrete length in the bucket (t=1000 -> 8).
+
+    The floor is 8, not 128: T=1000-style lengths have no pow2 divisor
+    >=128, and the old whole-T fallback silently built a single-block
+    kernel whose (T, T) f32 score tile can blow VMEM at large T — a
+    small block is slow but correct; sizes below 8 lose the f32 sublane
+    tile and can't happen for even T anyway."""
     if t <= want:
         return t
     b = want
-    while b >= 128:
+    while b >= 8:
         if t % b == 0:
             return b
         b //= 2
-    return t  # no pow2 divisor >=128: degenerate, single block
+    return t  # odd T: no pow2 divisor at all — degenerate, single block
 
 
 def _causal_mask(s, qi, ki, block_q, block_k, transposed=False):
@@ -294,11 +302,28 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _resolve(t, d, block_q, block_k, scale, interpret):
-    bq = _pick_block(t, _BLOCK_TARGET_Q) if block_q is None \
-        else min(block_q, t)
-    bk = _pick_block(t, _BLOCK_TARGET_K) if block_k is None \
-        else min(block_k, t)
+def _resolve(qd, block_q, block_k, scale, interpret):
+    """Resolve block sizes for one flash launch.  Explicit blocks win;
+    otherwise the autotune cache is consulted once per (shape-bucket,
+    dtype, device) key through `tune.best` — a miss falls back to the
+    static `_BLOCK_TARGET_Q/_K` defaults with one warning.  Either way
+    the chosen sizes are TARGETS re-fitted by `_pick_block`, so a
+    cached pow2 winner stays legal for non-pow2 lengths in its bucket
+    (and bit-parity holds for the forward output and dq — the q split
+    never reorders their accumulation; dk/dv accumulate across
+    q-blocks, so only an unchanged block_q keeps them bit-stable)."""
+    b, h, t, d = qd.shape
+    tq, tk = block_q, block_k
+    if tq is None or tk is None:
+        from .. import tune
+        tuned = tune.best(
+            "flash_attention", tune.signature(qd.dtype, b=b, h=h, t=t, d=d),
+            {"block_q": _BLOCK_TARGET_Q, "block_k": _BLOCK_TARGET_K})
+        tq = tuned["block_q"] if tq is None else tq
+        tk = tuned["block_k"] if tk is None else tk
+        bq, bk = _pick_block(t, tq), _pick_block(t, tk)
+    else:
+        bq, bk = min(tq, t), min(tk, t)
     if t % bq or t % bk:
         raise ValueError(
             f"block sizes ({bq}, {bk}) must divide sequence length {t}; "
@@ -428,7 +453,7 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, nk, nh, masked,
 def _flash_forward(qd, kd, vd, mask, bias, seed, causal, scale, dropout,
                    block_q, block_k, interpret):
     b, h, t, d = qd.shape
-    bq, bk, sc, interp = _resolve(t, d, block_q, block_k, scale, interpret)
+    bq, bk, sc, interp = _resolve(qd, block_q, block_k, scale, interpret)
     nk = t // bk
     masked = mask is not None
     has_bias = bias is not None
@@ -664,7 +689,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, nq, nh, masked,
 def _flash_backward(qd, kd, vd, mask, bias, seed, out, lse, ct, causal,
                     scale, dropout, block_q, block_k, interpret, dlse=None):
     b, h, t, d = qd.shape
-    bq, bk, sc, interp = _resolve(t, d, block_q, block_k, scale, interpret)
+    bq, bk, sc, interp = _resolve(qd, block_q, block_k, scale, interpret)
     nq, nk = t // bq, t // bk
     masked = mask is not None
     has_bias = bias is not None

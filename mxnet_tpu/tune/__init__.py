@@ -1,0 +1,28 @@
+"""mxnet_tpu.tune — Pallas kernel autotuner (ROADMAP item 5).
+
+Three pieces:
+
+* :mod:`~mxnet_tpu.tune.cache` — the committed winner cache
+  (``tools/autotune_cache.json``) and the :func:`best` trace-time choke
+  point every tuned dispatch reads.
+* :mod:`~mxnet_tpu.tune.kernels` — the registry of tunable kernels
+  (flash attention blocks, scan-LSTM cell, s2d stem matmul, BN-backward
+  reduction epilogue): signatures, candidate grids, builders, and the
+  deterministic flash roofline model.
+* :mod:`~mxnet_tpu.tune.sweep` — the one timing/trimming sweep runner
+  (``benchmark/timing_util.py`` delegates here).
+
+``tools/autotune`` is the driver; docs/AUTOTUNE.md is the manual.
+"""
+from .cache import (AutotuneMiss, SCHEMA, best, default_cache_path,
+                    fingerprint, fingerprint_matches, invalidate,
+                    load_cache, lookup, make_key, save_cache, split_key)
+from .kernels import (device_kind, dtype_tag, get, names, parse_signature,
+                      pow2_bucket, signature)
+
+__all__ = [
+    "AutotuneMiss", "SCHEMA", "best", "default_cache_path", "fingerprint",
+    "fingerprint_matches", "invalidate", "load_cache", "lookup", "make_key",
+    "save_cache", "split_key", "device_kind", "dtype_tag", "get", "names",
+    "parse_signature", "pow2_bucket", "signature",
+]

@@ -1,0 +1,206 @@
+"""Autotune winner cache — the committed half of ``mxnet_tpu.tune``.
+
+ROADMAP item 5: kernel block/tiling choices shift with shape, dtype and
+toolchain, and the flash 512->1024 K-block adoption was a one-off hand
+sweep baked into a comment.  This module makes each such choice a
+committed, diffable artifact instead:
+
+* ``tools/autotune_cache.json`` holds the swept winners, keyed like the
+  serve ``ExecutableCache.warmed_grid()`` — one stable string per
+  (kernel, shape-bucket, dtype, device-kind) — under a toolchain
+  fingerprint (jax version + cache schema).
+* :func:`best` is the ONE trace-time choke point dispatch reads.  A
+  cache hit returns the committed params; a miss (unknown key, missing
+  file, fingerprint mismatch, ``MXNET_AUTOTUNE=0``) returns the caller's
+  documented static default and emits ONE :class:`AutotuneMiss` warning
+  per key — never a silent in-process sweep (a sweep inside a training
+  step would bake measurement noise into the program; sweeps happen in
+  ``tools/autotune`` where they are reviewed as diffs).
+
+Env knobs (read through ``mxnet_tpu.env`` accessors, consulted once at
+first cache load and memoized — the MXNET_DROPOUT_RNG read-at-trace
+class does not apply because the result is process-stable by design):
+``MXNET_AUTOTUNE`` (``0`` = static defaults everywhere),
+``MXNET_AUTOTUNE_CACHE`` (path override, e.g. a freshly swept cache).
+"""
+from __future__ import annotations
+
+import json
+import os
+import warnings
+
+__all__ = [
+    "SCHEMA", "AutotuneMiss", "fingerprint", "fingerprint_matches",
+    "default_cache_path", "load_cache", "save_cache", "make_key",
+    "split_key", "best", "lookup", "invalidate",
+]
+
+SCHEMA = "mxtpu-autotune-cache-v1"
+
+
+class AutotuneMiss(UserWarning):
+    """A tune.best lookup fell back to the static default (unknown key,
+    unreadable cache, or toolchain-fingerprint mismatch)."""
+
+
+def fingerprint():
+    """Toolchain fingerprint the cache is valid under.
+
+    The device kind is deliberately NOT here — it is part of every
+    entry key, so one cache serves mixed fleets; what invalidates the
+    *whole* cache is the toolchain that timed it (a jax/XLA bump can
+    move any optimum — docs/AUTOTUNE.md "re-tuning")."""
+    import jax
+    return {"schema": SCHEMA, "jax": jax.__version__}
+
+
+def fingerprint_matches(doc):
+    return (doc or {}).get("fingerprint") == fingerprint()
+
+
+def default_cache_path():
+    """``MXNET_AUTOTUNE_CACHE`` override, else the committed
+    ``tools/autotune_cache.json`` next to the package."""
+    from .. import env as _env
+    override = _env.autotune_cache_path()
+    if override:
+        return override
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "tools", "autotune_cache.json")
+
+
+def make_key(kernel, signature):
+    """``kernel|shape-bucket|dtype|device-kind`` — the warmed_grid-style
+    stable string (signature already carries dtype + device)."""
+    if "|" in kernel:
+        raise ValueError(f"kernel name must not contain '|': {kernel!r}")
+    return f"{kernel}|{signature}"
+
+
+def split_key(key):
+    """(kernel, shape_bucket, dtype, device_kind) back out of a key."""
+    parts = key.split("|")
+    if len(parts) != 4:
+        raise ValueError(
+            f"malformed cache key {key!r}: want "
+            "'kernel|shape-bucket|dtype|device'")
+    return tuple(parts)
+
+
+def empty_cache():
+    return {"schema": SCHEMA, "fingerprint": fingerprint(), "entries": {}}
+
+
+def load_cache(path=None):
+    """Parse a cache file (no fingerprint check — callers decide what a
+    mismatch means: ``best`` warns and falls back, the CI gate FAILS)."""
+    path = path or default_cache_path()
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != SCHEMA:
+        raise ValueError(
+            f"{path}: schema {doc.get('schema')!r} != {SCHEMA!r}")
+    if not isinstance(doc.get("entries"), dict):
+        raise ValueError(f"{path}: 'entries' must be an object")
+    for key, ent in doc["entries"].items():
+        split_key(key)
+        if not isinstance(ent.get("params"), dict):
+            raise ValueError(f"{path}: entry {key!r} has no params object")
+    return doc
+
+
+def save_cache(doc, path=None):
+    """Canonical JSON (sorted keys, trailing newline) so review diffs
+    are stable line-per-entry."""
+    path = path or default_cache_path()
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+# --------------------------------------------------------------------------
+# the trace-time choke point
+# --------------------------------------------------------------------------
+_memo = {"loaded": False, "doc": None, "enabled": None, "warned": set()}
+
+
+def invalidate():
+    """Forget the memoized cache + warn-once state (tests, re-tunes)."""
+    _memo.update(loaded=False, doc=None, enabled=None, warned=set())
+
+
+def _warn_once(token, message):
+    if token in _memo["warned"]:
+        return
+    _memo["warned"].add(token)
+    warnings.warn(message, AutotuneMiss, stacklevel=3)
+
+
+def _load_memo():
+    if _memo["loaded"]:
+        return _memo["doc"]
+    from .. import env as _env
+    _memo["enabled"] = _env.autotune_enabled()
+    doc = None
+    if _memo["enabled"]:
+        path = default_cache_path()
+        try:
+            doc = load_cache(path)
+        except FileNotFoundError:
+            _warn_once(("missing", path),
+                       f"autotune cache {path} not found — every tuned "
+                       f"kernel runs on its static default "
+                       f"(tools/autotune --update-cache to sweep)")
+        except (ValueError, json.JSONDecodeError) as e:
+            _warn_once(("unreadable", path),
+                       f"autotune cache {path} unreadable ({e}) — "
+                       f"falling back to static defaults")
+        else:
+            if not fingerprint_matches(doc):
+                _warn_once(
+                    ("fingerprint", path),
+                    f"autotune cache {path} was swept under "
+                    f"{doc.get('fingerprint')} but this toolchain is "
+                    f"{fingerprint()} — the optima may have moved; using "
+                    f"static defaults (re-sweep with tools/autotune "
+                    f"--update-cache)")
+                doc = None
+    _memo["doc"] = doc
+    _memo["loaded"] = True
+    return doc
+
+
+def lookup(kernel, signature):
+    """Raw cache probe: params dict on hit, None on any miss (silent —
+    ``best`` owns the warning policy)."""
+    doc = _load_memo()
+    if doc is None:
+        return None
+    ent = doc["entries"].get(make_key(kernel, signature))
+    return dict(ent["params"]) if ent else None
+
+
+def best(kernel, signature, default):
+    """The committed winner for ``(kernel, signature)``, else ``default``.
+
+    Called at trace time from dispatch (flash ``_resolve``, the scan-LSTM
+    layer, the s2d stem); the return value is baked into the traced
+    program, exactly like the block constants it replaces.  Misses warn
+    ONCE per key and never sweep in-process."""
+    params = lookup(kernel, signature)
+    if params is not None:
+        return params
+    if _memo["enabled"] is False or _memo["doc"] is not None:
+        # disabled -> silent by contract; loaded cache but unknown key
+        # -> warn (the shape was never swept)
+        if _memo["doc"] is not None:
+            _warn_once(
+                ("miss", kernel, signature),
+                f"autotune cache has no entry for "
+                f"{make_key(kernel, signature)!r} — using the static "
+                f"default {default}; sweep it with tools/autotune "
+                f"--kernel {kernel} --update-cache")
+        return dict(default)
+    return dict(default)

@@ -56,3 +56,5 @@ def rng():
 def pytest_configure(config):
     config.addinivalue_line("markers", "seed: fixed-seed test")
     config.addinivalue_line("markers", "serial: serial-only test")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 'not slow' run")

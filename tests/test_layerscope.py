@@ -159,13 +159,20 @@ def test_dp_census_cross_checks_xla_aggregate(dp_doc):
     assert xla and 0.5 < dp_doc["totals"]["flops"] / xla < 2.0
 
 
-def test_resnet_known_offenders_are_waived(resnet_doc):
-    floors = [f for f in resnet_doc["findings"] if f["rule"] == "mfu-floor"]
-    keys = {f["key"] for f in floors}
-    assert any("stem" in k for k in keys)
-    assert any("bn" in k and k.endswith("@bwd") for k in keys)
-    assert floors and all(f["waived"] and f["reason"] for f in floors)
-    assert not [f for f in resnet_doc["findings"] if not f["waived"]]
+def test_resnet_waivers_retired_floors_pass(resnet_doc):
+    # PR 18: the stem and BN-backward floors pass outright (s2d stem +
+    # fused conv+BN units), so the contract carries no waivers and the
+    # census emits no findings at all
+    assert resnet_doc["findings"] == []
+    assert not resnet_doc["contract"].get("waivers")
+    floors = resnet_doc["contract"]["mfu_floors"]
+    assert floors == {"stem": 0.50, "bn@bwd": 0.10}
+    by_key = {f"{r['layer']}@{r['phase']}": r for r in resnet_doc["rows"]}
+    assert by_key["_ResNetProfile/stem@fwd"]["mfu_sol"] >= 0.50
+    assert by_key["_ResNetProfile/stem@bwd"]["mfu_sol"] >= 0.50
+    bn_bwd = [r for r in resnet_doc["rows"]
+              if "bn" in r["layer"] and r["phase"] == "bwd"]
+    assert bn_bwd and all(r["mfu_sol"] >= 0.10 for r in bn_bwd)
 
 
 def test_json_artifact_round_trips(dp_doc):

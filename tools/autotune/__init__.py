@@ -1,0 +1,33 @@
+"""autotune: Pallas kernel parameter sweeps with a committed winner cache.
+
+ROADMAP item 5's last open edge: kernel block/tile choices (flash
+attention block_q/block_k, the scan-LSTM cell unroll, the s2d stem and
+BN-backward-epilogue tiles) used to be constants justified by one-off
+hand sweeps in comments.  This tool makes each choice a reviewed,
+diffable artifact:
+
+* the sweep half (``--sweep`` / ``--update-cache``) runs every
+  registered kernel's candidate grid — deterministic roofline scoring
+  (``--mode model``) or real timing with the benchmark/timing_util.py
+  discipline (``--mode time``, optionally one subprocess per candidate)
+  — and persists winners into ``tools/autotune_cache.json``;
+* the gate half (the default command; what ``tools/ci.sh autotune``
+  runs) verifies the committed cache hloscan-style: fingerprint match,
+  full registry coverage, no stale entries, and — for kernels with a
+  deterministic model — that the committed winner is re-derived
+  bit-for-bit by the model.  Exit 0 clean / 1 findings / 2 usage error.
+
+Dispatch reads the cache at trace time through the one
+``mxnet_tpu.tune.best`` choke point; a miss falls back to the kernel's
+documented static default with ONE warning, never a silent in-process
+sweep.  See docs/AUTOTUNE.md for cache-key anatomy and the re-tune
+policy.
+
+Usage::
+
+    python -m tools.autotune                     # verify committed cache
+    python -m tools.autotune --sweep             # sweep + tables, no write
+    python -m tools.autotune --sweep --kernel flash_attention
+    python -m tools.autotune --update-cache      # sweep and commit winners
+"""
+from .driver import main, render_sweep, run_sweeps, verify_cache  # noqa: F401

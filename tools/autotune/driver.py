@@ -1,0 +1,299 @@
+"""autotune driver: sweep, table, cache update, CI gate.
+
+Exit status mirrors hloscan/layerscope: 0 when the committed cache is
+clean, 1 when any finding is live, 2 on usage error.  Findings are not
+baselinable — the cache is itself the reviewed artifact, so a stale or
+drifted entry must be fixed (re-sweep with ``--update-cache``), not
+grandfathered.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+JSON_SCHEMA_VERSION = 1
+
+#: Every rule the cache gate can emit, for the verdict lines.
+RULES = ("cache-readable", "fingerprint", "coverage", "stale-entry",
+         "model-drift")
+
+
+def expected_entries(kernels_filter=None):
+    """``{cache key: (kernel, signature)}`` for the registry — the
+    coverage contract the committed cache must satisfy."""
+    from mxnet_tpu.tune import cache, kernels
+    out = {}
+    for name in kernels.names():
+        if kernels_filter and name not in kernels_filter:
+            continue
+        spec = kernels.get(name)
+        for sig in spec.signatures():
+            out[cache.make_key(name, sig)] = (name, sig)
+    return out
+
+
+# --------------------------------------------------------------------------
+# the gate
+# --------------------------------------------------------------------------
+def verify_cache(path=None, kernels_filter=None):
+    """Verify the committed cache against the live registry + toolchain.
+
+    Returns ``(findings, info)``: findings are ``{"rule", "key",
+    "message"}`` dicts (empty == clean); info carries the verified
+    entry count and cache path for reporting."""
+    from mxnet_tpu.tune import cache, kernels, sweep
+
+    path = path or cache.default_cache_path()
+    findings = []
+
+    def finding(rule, key, message):
+        findings.append({"rule": rule, "key": key, "message": message})
+
+    try:
+        doc = cache.load_cache(path)
+    except FileNotFoundError:
+        finding("cache-readable", path,
+                f"committed cache {path} is missing — every tuned kernel "
+                f"would run on static defaults; sweep it with "
+                f"tools/autotune --update-cache")
+        return findings, {"path": path, "entries": 0}
+    except (ValueError, json.JSONDecodeError) as e:
+        finding("cache-readable", path, f"{path} unreadable: {e}")
+        return findings, {"path": path, "entries": 0}
+
+    if not cache.fingerprint_matches(doc):
+        finding("fingerprint", "fingerprint",
+                f"cache swept under {doc.get('fingerprint')} but this "
+                f"toolchain is {cache.fingerprint()} — optima may have "
+                f"moved; re-sweep with tools/autotune --update-cache")
+
+    expected = expected_entries(kernels_filter)
+    entries = doc.get("entries", {})
+
+    for key, ent in sorted(entries.items()):
+        if kernels_filter and cache.split_key(key)[0] not in kernels_filter:
+            continue
+        if key not in expected:
+            finding("stale-entry", key,
+                    f"cache entry {key!r} matches no registered "
+                    f"(kernel, signature) — the kernel or its shape "
+                    f"bucket was renamed or removed; prune it")
+            continue
+        name, sig = expected[key]
+        spec = kernels.get(name)
+        params = ent["params"]
+        grid = spec.grid(sig)
+        if params not in grid and params != spec.default(sig):
+            finding("stale-entry", key,
+                    f"cache entry {key!r} pins {params} which is no "
+                    f"longer in the swept grid — re-sweep")
+
+    for key, (name, sig) in sorted(expected.items()):
+        if key not in entries:
+            finding("coverage", key,
+                    f"no cache entry for registered kernel signature "
+                    f"{key!r} — sweep it with tools/autotune --kernel "
+                    f"{name} --update-cache")
+
+    # kernels with a deterministic model: the committed winner must be
+    # re-derivable bit-for-bit, on any machine, with no device
+    for key, (name, sig) in sorted(expected.items()):
+        ent = entries.get(key)
+        if ent is None or ent.get("mode") == "time":
+            continue
+        spec = kernels.get(name)
+        if spec._model_time is None:
+            continue
+        got = sweep.sweep_kernel(name, sig, mode="model")["winner"]
+        if got != ent["params"]:
+            finding("model-drift", key,
+                    f"cache entry {key!r} pins {ent['params']} but the "
+                    f"roofline model derives {got} — the model or grid "
+                    f"changed under the committed winner; re-sweep with "
+                    f"--update-cache (or fix the model)")
+
+    return findings, {"path": path, "entries": len(entries)}
+
+
+# --------------------------------------------------------------------------
+# sweeps
+# --------------------------------------------------------------------------
+def run_sweeps(kernels_filter=None, mode=None, isolate=False, repeats=3,
+               log=None):
+    """Sweep every registered (kernel, signature) — ``mode=None`` picks
+    ``model`` when the kernel has one, else ``time``."""
+    from mxnet_tpu.tune import kernels, sweep
+    results = []
+    for name in kernels.names():
+        if kernels_filter and name not in kernels_filter:
+            continue
+        spec = kernels.get(name)
+        m = mode or ("model" if spec._model_time is not None else "time")
+        for sig in spec.signatures():
+            results.append(sweep.sweep_kernel(
+                name, sig, mode=m, isolate=isolate, repeats=repeats,
+                log=log))
+    return results
+
+
+def _fmt_score(row):
+    if "error" in row:
+        return f"ERROR {row['error'][:48]}"
+    if "ms" in row:
+        return f"{row['ms']:9.3f} ms"
+    return f"{row['modeled_s'] * 1e6:9.2f} us(model)"
+
+
+def render_sweep(result, out=None):
+    out = out or sys.stdout
+    lines = [f"autotune: {result['kernel']} [{result['signature']}] "
+             f"mode={result['mode']}"]
+    best = result["winner"]
+    default = result["default"]
+    for row in sorted(result["rows"],
+                      key=lambda r: r.get("ms", r.get("modeled_s",
+                                                      float("inf")))):
+        marks = []
+        if row["params"] == best:
+            marks.append("WINNER")
+        if row["params"] == default:
+            marks.append("default")
+        pstr = " ".join(f"{k}={v}" for k, v in sorted(row["params"].items()))
+        lines.append(f"  {pstr:<36} {_fmt_score(row):>22}"
+                     f"{('  <- ' + ','.join(marks)) if marks else ''}")
+    if result["speedup_vs_default"] is not None:
+        lines.append(f"  winner vs default: "
+                     f"{result['speedup_vs_default']:.3f}x")
+    text = "\n".join(lines) + "\n"
+    out.write(text)
+    return text
+
+
+def update_cache(results, path=None):
+    """Fold sweep winners into the cache.  Existing entries survive a
+    partial (``--kernel``-filtered) sweep only when the fingerprint
+    still matches — a toolchain bump invalidates everything."""
+    from mxnet_tpu.tune import cache
+    path = path or cache.default_cache_path()
+    doc = None
+    try:
+        old = cache.load_cache(path)
+        if cache.fingerprint_matches(old):
+            doc = old
+    except (OSError, ValueError, json.JSONDecodeError):
+        pass
+    if doc is None:
+        doc = cache.empty_cache()
+    for r in results:
+        key = cache.make_key(r["kernel"], r["signature"])
+        doc["entries"][key] = {
+            "params": r["winner"],
+            "mode": r["mode"],
+            "speedup_vs_default": r["speedup_vs_default"],
+        }
+    return cache.save_cache(doc, path)
+
+
+# --------------------------------------------------------------------------
+# reporting
+# --------------------------------------------------------------------------
+def verdict_lines(findings):
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f["rule"], []).append(f)
+    out = []
+    for rule in RULES:
+        n = len(by_rule.get(rule, ()))
+        verdict = "PASS" if n == 0 else f"FAIL  [{n}]"
+        out.append(f"autotune {rule:<18} {verdict}")
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m tools.autotune",
+        description="Pallas kernel autotuner: sweep candidate grids, "
+                    "commit winners, gate the committed cache "
+                    "(docs/AUTOTUNE.md).")
+    p.add_argument("--cache", default=None, metavar="PATH",
+                   help="cache file (default: tools/autotune_cache.json "
+                        "or MXNET_AUTOTUNE_CACHE)")
+    p.add_argument("--kernel", action="append", dest="kernels",
+                   metavar="NAME",
+                   help="restrict to one kernel (repeatable; see "
+                        "--list-kernels)")
+    p.add_argument("--sweep", action="store_true",
+                   help="run sweeps and print candidate tables "
+                        "(no cache write)")
+    p.add_argument("--update-cache", action="store_true",
+                   help="run sweeps and persist winners to the cache")
+    p.add_argument("--mode", choices=("model", "time"), default=None,
+                   help="force scoring mode (default: model when the "
+                        "kernel has one, else time)")
+    p.add_argument("--isolate", action="store_true",
+                   help="time mode: one subprocess per candidate "
+                        "(crash isolation)")
+    p.add_argument("--repeats", type=int, default=3,
+                   help="time mode: repeats per candidate (trimmed "
+                        "median; default 3)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--verdicts", action="store_true",
+                   help="append per-rule PASS/FAIL verdict lines")
+    p.add_argument("--list-kernels", action="store_true")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    from mxnet_tpu.tune import kernels
+    if args.list_kernels:
+        for name in kernels.names():
+            print(name)
+        return 0
+    if args.kernels:
+        unknown = [k for k in args.kernels if k not in kernels.names()]
+        if unknown:
+            p.error(f"unknown kernel(s) {unknown}; have {kernels.names()}")
+
+    out = sys.stdout
+    log = (lambda s: print(s, file=sys.stderr)) if args.verbose else None
+
+    if args.sweep or args.update_cache:
+        results = run_sweeps(kernels_filter=args.kernels, mode=args.mode,
+                             isolate=args.isolate, repeats=args.repeats,
+                             log=log)
+        if args.format == "json":
+            json.dump({"version": JSON_SCHEMA_VERSION, "tool": "autotune",
+                       "sweeps": results}, out, indent=2)
+            out.write("\n")
+        else:
+            for r in results:
+                render_sweep(r, out=out)
+        if args.update_cache:
+            path = update_cache(results, path=args.cache)
+            out.write(f"autotune: cache updated — {path}\n")
+        return 0
+
+    findings, info = verify_cache(path=args.cache,
+                                  kernels_filter=args.kernels)
+    if args.format == "json":
+        json.dump({"version": JSON_SCHEMA_VERSION, "tool": "autotune",
+                   "cache": info["path"], "entries": info["entries"],
+                   "findings": findings,
+                   "summary": {"live": len(findings)}}, out, indent=2)
+        out.write("\n")
+    else:
+        for f in findings:
+            out.write(f"autotune: [{f['rule']}] {f['message']}\n")
+        verdict = "clean" if not findings else \
+            f"{len(findings)} live finding{'s' if len(findings) != 1 else ''}"
+        out.write(f"autotune: {verdict} — {info['entries']} cache "
+                  f"entr{'y' if info['entries'] == 1 else 'ies'} "
+                  f"({info['path']})\n")
+    if args.verdicts:
+        for line in verdict_lines(findings):
+            out.write(line + "\n")
+    return 1 if findings else 0

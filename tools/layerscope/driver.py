@@ -79,13 +79,13 @@ def render_table(doc, out=None):
         line = (f"{row['layer'][:34]:<34} {row['phase']:<3} "
                 f"{row['pct_time']:>5.1f}% "
                 f"{_fmt_flops(row['flops']):>8} "
-                f"{row['intensity'] if row['intensity'] is None else format(row['intensity'], '.1f'):>7} "
+                f"{'-' if row['intensity'] is None else format(row['intensity'], '.1f'):>7} "
                 f"{row['mfu_sol']:>7.1%}")
         if measured:
             tf = row["tf_per_s"]
-            line += (f" {tf if tf is None else format(tf, '.2f'):>7}"
-                     f" {row['gb_per_s'] if row['gb_per_s'] is None else format(row['gb_per_s'], '.1f'):>7}"
-                     f" {row['mfu'] if row['mfu'] is None else format(row['mfu'], '.1%'):>7}")
+            line += (f" {'-' if tf is None else format(tf, '.2f'):>7}"
+                     f" {'-' if row['gb_per_s'] is None else format(row['gb_per_s'], '.1f'):>7}"
+                     f" {'-' if row['mfu'] is None else format(row['mfu'], '.1%'):>7}")
         line += f"  {row['bound']}{mark}"
         lines.append(line)
     text = "\n".join(lines) + "\n"

@@ -333,11 +333,38 @@ assert dp == tp, f"recipe dp2.tp2 diverged from the dp oracle: {dp} vs {tp}"
 print(f"smoke: recipe dp2.tp2 parity ok (3-step losses {tp})")
 EOF
 
+# 3e. autotune dispatch gate (ISSUE 18): the flash blocks the kernel
+# would actually launch with must come from the committed cache entry —
+# if dispatch silently falls back to static defaults (cache unreadable,
+# fingerprint drift, signature mismatch) this fires.  The full cache
+# gate (coverage, stale entries, model re-derivation) runs in ci.sh's
+# autotune stage.
+python - <<'EOF'
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import tune
+from mxnet_tpu.ops.pallas_kernels import _pick_block, _resolve
+
+b, h, t, d = 8, 8, 4096, 64   # the attention bench shape
+entry = tune.lookup("flash_attention",
+                    tune.signature(jnp.bfloat16, b=b, h=h, t=t, d=d))
+assert entry is not None, \
+    "committed cache has no flash_attention entry for the bench shape"
+qd = jax.ShapeDtypeStruct((b, h, t, d), jnp.bfloat16)
+bq, bk, _, _ = _resolve(qd, None, None, None, None)
+want = (_pick_block(t, entry["block_q"]), _pick_block(t, entry["block_k"]))
+assert (bq, bk) == want, \
+    f"flash dispatch chose {(bq, bk)} but the cache pins {want}"
+print(f"smoke: autotuned flash blocks ok (bq={bq}, bk={bk} from cache)")
+EOF
+
 # 4. the driver entry points compile on the virtual mesh (the full
-# hloscan + census + recipe dryrun riders run in ci.sh's dryrun stage,
-# not here — the recipe parity gate above covers 3d's quick check)
+# hloscan + census + recipe + autotune dryrun riders run in ci.sh's
+# dryrun stage, not here — 3d/3e above cover the quick checks)
 MXTPU_DRYRUN_HLOSCAN=0 MXTPU_DRYRUN_CENSUS=0 MXTPU_DRYRUN_RESILIENCE=0 \
   MXTPU_DRYRUN_FLEET=0 MXTPU_DRYRUN_GRAY=0 MXTPU_DRYRUN_RECIPE=0 \
+  MXTPU_DRYRUN_AUTOTUNE=0 \
   python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
