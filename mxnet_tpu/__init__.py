@@ -8,6 +8,16 @@ the map from the reference (`sxjscience/mxnet`) to this design.  Import as::
 """
 from __future__ import annotations
 
+import os as _os
+
+# Lock-acquisition witness (tools/lockscan's runtime half): the factory
+# patch must land BEFORE any package import creates a lock, so this is
+# the first package code to run.  Reads os.environ directly — the env
+# helpers themselves live behind imports that create locks.
+if _os.environ.get("MXNET_LOCKSCAN_WITNESS", "") not in ("", "0"):
+    from . import lockwitness as _lockwitness
+
+    _lockwitness.install()
 
 import jax as _jax
 

@@ -66,6 +66,7 @@ def lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
+        # lockscan: disable=blocking-under-lock -- build-once barrier: the compile MUST run under _lock so concurrent importers block until the .so exists instead of racing the compiler; cold-start only, never on a hot path
         if not _build():
             return None
         try:
@@ -297,6 +298,7 @@ def img_lib():
         _img_tried = True
         srcs = [os.path.join(_SRC, f) for f in _IMG_SRC_NAMES
                 if os.path.exists(os.path.join(_SRC, f))]
+        # lockscan: disable=blocking-under-lock -- build-once barrier: same contract as lib() above — concurrent importers must block on _lock until the .so exists; cold-start only
         if not _compile(srcs, _IMG_SO, extra=["-ljpeg", "-pthread"]):
             return None
         try:

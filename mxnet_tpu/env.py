@@ -210,6 +210,24 @@ MXNET_AUTOTUNE_CACHE         path of the autotune winner cache to read
                              freshly swept cache under review; read
                              once at the first cache consult, see
                              docs/AUTOTUNE.md)
+MXNET_LOCKSCAN_WITNESS       ``1`` installs the lock-acquisition
+                             witness (``mxnet_tpu.lockwitness``) as the
+                             very first package import: every
+                             package-created Lock/RLock/Condition is
+                             wrapped, held->acquired order edges are
+                             recorded per thread, an acquisition that
+                             closes a cycle raises
+                             ``LockOrderViolation``, and a process with
+                             recorded violations exits 70.  On in ci.sh
+                             chaos/storm/endure; read at import only —
+                             set before ``import mxnet_tpu``
+                             (docs/STATIC_ANALYSIS.md "Concurrency
+                             contracts")
+MXNET_LOCKSCAN_REPORT        path where the witness dumps its observed
+                             order graph (JSON) at process exit, for
+                             ``python -m tools.lockscan --crosscheck``
+                             against the static model (read at exit;
+                             only meaningful with the witness on)
 =========================== =================================================
 """
 from __future__ import annotations
@@ -224,7 +242,8 @@ __all__ = ["apply", "describe", "is_naive_engine", "cpu_worker_nthreads",
            "sentinel_rollbacks", "kvstore_integrity",
            "parallel_recipe", "recipe_strict", "blackbox_enabled",
            "blackbox_events", "blackbox_dir", "autotune_enabled",
-           "autotune_cache_path"]
+           "autotune_cache_path", "lockscan_witness",
+           "lockscan_report_path"]
 
 _naive_engine = False
 
@@ -416,6 +435,27 @@ def autotune_cache_path(default=None):
     return v.strip()
 
 
+def lockscan_witness(default=False):
+    """Whether the lock-acquisition witness is requested.  NOTE: the
+    install itself happens at the top of ``mxnet_tpu/__init__`` from a
+    direct environ read (the witness must patch the lock factories
+    before any package import creates one) — this helper only reports
+    the setting."""
+    v = os.environ.get("MXNET_LOCKSCAN_WITNESS")
+    if v is None:
+        return default
+    return v not in ("0", "")
+
+
+def lockscan_report_path(default=None):
+    """Where the witness dumps its observed order graph at exit; None =
+    no dump.  (Read at exit by ``mxnet_tpu.lockwitness``.)"""
+    v = os.environ.get("MXNET_LOCKSCAN_REPORT")
+    if v is None or not v.strip():
+        return default
+    return v.strip()
+
+
 def apply():
     """Read the environment once at package import."""
     global _naive_engine
@@ -475,5 +515,6 @@ def describe():
              "MXNET_PARALLEL_RECIPE", "MXNET_RECIPE_STRICT",
              "MXNET_BLACKBOX", "MXNET_BLACKBOX_EVENTS",
              "MXNET_BLACKBOX_DIR", "MXNET_AUTOTUNE",
-             "MXNET_AUTOTUNE_CACHE"]
+             "MXNET_AUTOTUNE_CACHE", "MXNET_LOCKSCAN_WITNESS",
+             "MXNET_LOCKSCAN_REPORT"]
     return [(n, os.environ.get(n), n in __doc__) for n in names]

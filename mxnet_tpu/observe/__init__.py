@@ -6,12 +6,13 @@ errors/signals/demand, and the ``tools/blackbox`` analyzer that merges
 N per-host dumps into one clock-skew-corrected pod timeline with a
 root-cause verdict (docs/OBSERVABILITY.md "Black box / postmortem").
 """
+from ..lockwitness import LockOrderViolation  # noqa: F401  (observability surface)
 from .flightrec import (FlightRecorder, SCHEMA_VERSION, configure,
                         default_recorder, dump, enabled, events,
                         install_signal_handlers, record, reset,
                         set_generation, set_rank, set_step, snapshot)
 
-__all__ = ["FlightRecorder", "SCHEMA_VERSION", "configure",
-           "default_recorder", "dump", "enabled", "events",
+__all__ = ["FlightRecorder", "LockOrderViolation", "SCHEMA_VERSION",
+           "configure", "default_recorder", "dump", "enabled", "events",
            "install_signal_handlers", "record", "reset",
            "set_generation", "set_rank", "set_step", "snapshot"]

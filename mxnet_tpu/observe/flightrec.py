@@ -35,6 +35,7 @@ disables recording entirely, ``MXNET_BLACKBOX_EVENTS`` sizes the ring
 """
 from __future__ import annotations
 
+import _thread
 import json
 import os
 import signal
@@ -245,35 +246,66 @@ def set_step(step):
 _signals_installed = False
 
 
+def _signal_dumper(read_fd, prev_handlers):
+    """Deferred dump worker.  The handler only ``os.write``s the signum
+    to a pre-opened pipe (async-signal-safe); this daemon thread does
+    the lock-taking work — record + dump + chain — that a handler must
+    never do (lockscan signal-unsafe: the signal may have landed on the
+    thread that holds the recorder lock)."""
+    while True:
+        try:
+            data = os.read(read_fd, 1)
+        except OSError:
+            return
+        if not data:
+            return
+        signum = int(data[0])
+        _recorder.record("terminal", "signal", signum=signum)
+        try:
+            _recorder.dump(reason="signal%d" % signum)
+        except OSError:  # mxlint: disable=swallowed-exception -- a failed postmortem dump must never mask the signal itself; the chain below still runs
+            pass
+        prev = prev_handlers.get(signum)
+        if prev is signal.default_int_handler:
+            # the stock Ctrl-C disposition: KeyboardInterrupt belongs on
+            # the main thread, not on this worker
+            _thread.interrupt_main()
+        elif callable(prev):
+            prev(signum, None)
+        elif prev == signal.SIG_DFL:
+            # emulate the default terminate disposition —
+            # signal.signal() may only be called from the main thread
+            os._exit(128 + signum)
+
+
 def install_signal_handlers():
     """Dump the flight record on SIGTERM/SIGINT, then chain to the
-    previous handler (faulthandler-style).  Idempotent; silently a no-op
-    off the main thread or when recording is disabled."""
+    previous handler (faulthandler-style).  Self-pipe shape: the
+    installed handler only writes the signum to a pre-opened pipe fd
+    and returns; a daemon worker performs the actual record + dump
+    off-handler.  Idempotent; silently a no-op off the main thread or
+    when recording is disabled."""
     global _signals_installed
     if _signals_installed or not _recorder.enabled:
         return False
+    rfd = wfd = None
     try:
-        prev_term = signal.getsignal(signal.SIGTERM)
-        prev_int = signal.getsignal(signal.SIGINT)
+        prev = {signal.SIGTERM: signal.getsignal(signal.SIGTERM),
+                signal.SIGINT: signal.getsignal(signal.SIGINT)}
+        rfd, wfd = os.pipe()
 
-        def _handler(signum, frame,
-                     _prev={signal.SIGTERM: prev_term,
-                            signal.SIGINT: prev_int}):
-            _recorder.record("terminal", "signal", signum=int(signum))
-            try:
-                _recorder.dump(reason="signal%d" % signum)
-            except OSError:  # mxlint: disable=swallowed-exception -- a failed postmortem dump must never mask the signal itself; the chained handler below still runs
-                pass
-            prev = _prev.get(signum)
-            if callable(prev):
-                prev(signum, frame)
-            elif prev == signal.SIG_DFL:
-                signal.signal(signum, signal.SIG_DFL)
-                signal.raise_signal(signum)
+        def _handler(signum, frame):
+            os.write(wfd, bytes([int(signum)]))
 
         signal.signal(signal.SIGTERM, _handler)
         signal.signal(signal.SIGINT, _handler)
     except ValueError:  # mxlint: disable=swallowed-exception -- signal.signal raises off the main thread; recording works fine without the dump-on-signal path there
+        if rfd is not None:
+            os.close(rfd)
+            os.close(wfd)
         return False
+    # mxlint: disable=daemon-thread-no-shutdown -- true process-lifetime singleton: the dumper must outlive everything joinable to catch a terminal signal, and install is once-per-process
+    threading.Thread(target=_signal_dumper, args=(rfd, prev),
+                     name="flightrec-signal-dumper", daemon=True).start()
     _signals_installed = True
     return True

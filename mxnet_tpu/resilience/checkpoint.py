@@ -737,18 +737,26 @@ class CheckpointManager:
 
     # -- async plumbing ---------------------------------------------------
     def _ensure_worker(self):
-        if self._worker is not None and self._worker.is_alive():
-            return
-        self._q = queue.Queue()
-        self._stop = threading.Event()
-        t = threading.Thread(target=self._drain, daemon=True,
-                             name="mxtpu-ckpt-writer")
-        t.start()
-        self._worker = t
+        """The live writer queue, spawning the worker if needed.  The
+        whole check-and-replace is one critical section: two racing
+        ``save()`` calls used to BOTH see a dead worker and BOTH replace
+        ``self._q``, stranding whichever queue lost the race (writes
+        silently never hit disk).  The worker drains the queue it was
+        born with, so a later generation can never steal its items."""
+        with self._lock:
+            if self._worker is not None and self._worker.is_alive():
+                return self._q
+            q = queue.Queue()
+            t = threading.Thread(target=self._drain, args=(q,),
+                                 daemon=True, name="mxtpu-ckpt-writer")
+            self._q = q
+            self._worker = t
+            t.start()
+        return q
 
-    def _drain(self):
+    def _drain(self, q):
         while True:
-            item = self._q.get()
+            item = q.get()
             if item is None:
                 return
             step, arrays, meta = item
@@ -758,7 +766,7 @@ class CheckpointManager:
                 with self._lock:
                     self._error = e
             finally:
-                self._q.task_done()
+                q.task_done()
 
     def _raise_pending(self):
         with self._lock:
@@ -774,8 +782,7 @@ class CheckpointManager:
         if not self._async:
             self._commit(step, arrays, meta)
             return
-        self._ensure_worker()
-        self._q.put((int(step), arrays, meta))
+        self._ensure_worker().put((int(step), arrays, meta))
 
     def _commit(self, step, arrays, meta):
         try:
@@ -793,17 +800,24 @@ class CheckpointManager:
     def wait(self):
         """Block until every queued write is on disk; re-raise the first
         writer error if one occurred."""
-        if self._q is not None:
-            self._q.join()
+        with self._lock:
+            q = self._q
+        if q is not None:
+            q.join()
         self._raise_pending()
 
     def close(self):
-        """Flush pending writes and reap the worker thread."""
-        if self._worker is not None:
-            self._q.join()
-            self._q.put(None)  # wake + exit
-            self._worker.join(timeout=30)
+        """Flush pending writes and reap the worker thread.  Ownership
+        of the (queue, worker) pair is taken under the lock; the joins
+        happen OUTSIDE it so a slow flush never blocks a concurrent
+        wait()/save() on the lock itself."""
+        with self._lock:
+            q, worker = self._q, self._worker
             self._worker = None
+        if worker is not None:
+            q.join()
+            q.put(None)  # wake + exit
+            worker.join(timeout=30)
         self._raise_pending()
 
     def __enter__(self):

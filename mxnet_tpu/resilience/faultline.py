@@ -332,6 +332,7 @@ def _arrive(site, payload=False):
     key = f"{site}#payload" if payload else site
     with _state.lock:
         if _state.specs is None:
+            # lockscan: disable=blocking-under-lock -- once-per-process env-plan load: the @path read happens exactly once, and racing arrivals MUST block on it so the first injection cannot slip past an empty plan
             _state.specs = _load_env_plan()
         n = _state.counts.get(key, 0) + 1
         _state.counts[key] = n

@@ -305,9 +305,15 @@ class Endpoint:
             model = self._models[version]
             if self._example_arrays is None:
                 self._example_arrays = [onp.asarray(a) for a in arrays]
-            cache = self._build_cache(model, arrays)
-            self._caches[version] = cache
-            return cache
+        # the device_put in ExecutableCache() happens OUTSIDE the model
+        # lock (lockscan blocking-under-lock): a cold-version build must
+        # not stall submit()'s version pinning. Racing builders are
+        # benign — setdefault keeps the first. The version cannot be
+        # retired mid-build: the caller's request is still in flight, so
+        # _retire's drain check keeps it alive.
+        cache = self._build_cache(model, arrays)
+        with self._model_lock:
+            return self._caches.setdefault(version, cache)
 
     def _ensure_executable(self, arrays):
         """Build the live version's cache (analysis/capture entry)."""

@@ -621,9 +621,16 @@ class Fleet:
         """Precompile every live replica's bucket grid; also seeds the
         re-admission probe payload.  Returns total executables built."""
         with self._lock:
-            if self._example_arrays is None:
-                self._example_arrays = [
-                    self._to_numpy(x)[:1].copy() for x in example_inputs]
+            need_seed = self._example_arrays is None
+        if need_seed:
+            # device->host sync happens OUTSIDE the fleet lock (lockscan
+            # blocking-under-lock): submit/dispatch must not stall behind
+            # a warmup transfer; the publish under the lock is a cheap
+            # idempotent flip
+            arrays = [self._to_numpy(x)[:1].copy() for x in example_inputs]
+            with self._lock:
+                if self._example_arrays is None:
+                    self._example_arrays = arrays
         return sum(rep.endpoint.warmup(*example_inputs)
                    for rep in self.replicas if rep.state != DEAD)
 

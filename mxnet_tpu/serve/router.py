@@ -136,9 +136,11 @@ class PriorityRouter:
     def pop(self, timeout=None):
         """Highest-priority item, or None after ``timeout`` seconds."""
         with self._cv:
-            if not self._heap:
-                self._cv.wait(timeout)
-            if not self._heap:
+            # wait_for re-checks the predicate across spurious wakeups and
+            # notifies consumed by a faster sibling (lockscan
+            # condition-wait-no-predicate) — a bare wait() here returned
+            # None early whenever two dispatchers raced one notify
+            if not self._cv.wait_for(lambda: self._heap, timeout):
                 return None
             return heapq.heappop(self._heap)[2]
 

@@ -1,0 +1,54 @@
+"""Clean: the same operations, outside the lock or bounded."""
+import queue
+import subprocess
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self._run)
+
+    def good_get(self):
+        with self._lock:
+            pending = self._q.get(timeout=0.1)   # bounded: allowed
+        return pending
+
+    def good_get_nonblocking(self):
+        with self._lock:
+            return self._q.get(block=False)
+
+    def good_join(self):
+        with self._lock:
+            t = self._t
+        t.join()
+
+    def good_result(self, fut):
+        with self._lock:
+            done = fut
+        return done.result()
+
+    def good_io(self, path):
+        with open(path) as f:
+            data = f.read()
+        with self._lock:
+            return data
+
+    def good_subprocess(self):
+        subprocess.run(["true"])
+        with self._lock:
+            pass
+
+    def good_sleep(self):
+        time.sleep(0.01)
+        with self._lock:
+            pass
+
+    def good_str_join(self, parts):
+        with self._lock:
+            return ", ".join(parts)   # str.join, not Thread.join
+
+    def _run(self):
+        pass

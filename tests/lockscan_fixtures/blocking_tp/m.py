@@ -1,0 +1,43 @@
+"""TP: six blocking operations under a held lock (one interprocedural)."""
+import queue
+import subprocess
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self._run)
+
+    def bad_get(self):
+        with self._lock:
+            return self._q.get()
+
+    def bad_join(self):
+        with self._lock:
+            self._t.join()
+
+    def bad_result(self, fut):
+        with self._lock:
+            return fut.result()
+
+    def bad_io(self, path):
+        with self._lock:
+            with open(path) as f:
+                return f.read()
+
+    def bad_subprocess(self):
+        with self._lock:
+            subprocess.run(["true"])
+
+    def bad_indirect(self):
+        with self._lock:
+            self._helper()
+
+    def _helper(self):
+        time.sleep(0.1)
+
+    def _run(self):
+        pass

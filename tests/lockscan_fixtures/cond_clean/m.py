@@ -1,0 +1,24 @@
+"""Clean: predicate-looped wait, wait_for, and an owned notify."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def good_wait_loop(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait(0.1)
+            return self._items.pop()
+
+    def good_wait_for(self):
+        with self._cv:
+            self._cv.wait_for(lambda: self._items, timeout=0.1)
+            return self._items.pop() if self._items else None
+
+    def good_notify(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify()

@@ -1,0 +1,17 @@
+"""TP: wait without a predicate loop + notify outside the lock."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def bad_wait(self):
+        with self._cv:
+            self._cv.wait(1.0)
+            return self._items.pop()
+
+    def bad_notify(self, item):
+        self._items.append(item)
+        self._cv.notify()

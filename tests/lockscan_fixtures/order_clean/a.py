@@ -1,0 +1,25 @@
+"""Clean: same two classes, one consistent order (A before B)."""
+import threading
+
+from b import B
+
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peer = B()
+
+    def ping(self):
+        with self._lock:
+            self.peer.pong_locked()
+
+    def pong_inner(self):
+        with self._lock:
+            pass
+
+
+_singleton = A()
+
+
+def helper_unlocked():
+    return _singleton

@@ -1,0 +1,17 @@
+import threading
+
+import a as amod
+
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def pong_locked(self):
+        with self._lock:
+            pass
+
+    def reverse(self):
+        amod.helper_unlocked()
+        with self._lock:
+            pass

@@ -1,0 +1,31 @@
+"""TP: two-class lock-order cycle, closed across modules.
+
+A.ping holds A._lock and calls (attr-typed) B.pong_locked -> edge
+A._lock -> B._lock.  b.reverse holds B._lock and calls (module-alias)
+helper_locked -> (module-var receiver) A.pong_inner -> edge
+B._lock -> A._lock.  One lock-order-cycle finding.
+"""
+import threading
+
+from b import B
+
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peer = B()
+
+    def ping(self):
+        with self._lock:
+            self.peer.pong_locked()
+
+    def pong_inner(self):
+        with self._lock:
+            pass
+
+
+_singleton = A()
+
+
+def helper_locked():
+    _singleton.pong_inner()

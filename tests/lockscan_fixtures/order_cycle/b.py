@@ -1,0 +1,16 @@
+import threading
+
+import a as amod
+
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def pong_locked(self):
+        with self._lock:
+            pass
+
+    def reverse(self):
+        with self._lock:
+            amod.helper_locked()

@@ -1,0 +1,16 @@
+"""TP: non-reentrant Lock re-acquired through a call — single-thread
+deadlock, reported as a self-edge lock-order-cycle."""
+import threading
+
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            pass

@@ -1,0 +1,15 @@
+"""Clean: the same re-entry shape is legal on an RLock."""
+import threading
+
+
+class S:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            pass

@@ -1,0 +1,13 @@
+"""Clean: self-pipe handler — only os.write of a pre-opened fd."""
+import os
+import signal
+
+_rfd, _wfd = os.pipe()
+
+
+def _handler(signum, frame):
+    os.write(_wfd, bytes([int(signum)]))
+
+
+def install():
+    signal.signal(signal.SIGTERM, _handler)

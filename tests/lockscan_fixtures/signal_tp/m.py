@@ -1,0 +1,21 @@
+"""TP: signal handler acquires a lock and does file I/O (reachable)."""
+import signal
+import threading
+
+_lock = threading.Lock()
+_log = []
+
+
+def _flush():
+    with open("/tmp/fixture.log", "w") as f:
+        f.write("\n".join(_log))
+
+
+def _handler(signum, frame):
+    with _lock:
+        _log.append(str(signum))
+    _flush()
+
+
+def install():
+    signal.signal(signal.SIGTERM, _handler)

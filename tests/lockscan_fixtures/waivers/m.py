@@ -1,0 +1,25 @@
+"""Waiver grammar: one reasoned waiver (honored), one bare (bad-waiver),
+and a mxlint-tagged waiver that lockscan must NOT honor."""
+import queue
+import threading
+
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def waived(self):
+        with self._lock:
+            # lockscan: disable=blocking-under-lock -- fixture: single-consumer barrier by construction
+            return self._q.get()
+
+    def bare(self):
+        with self._lock:
+            # lockscan: disable=blocking-under-lock
+            return self._q.get()
+
+    def wrong_tool(self):
+        with self._lock:
+            # mxlint: disable=blocking-under-lock -- wrong tag, lockscan must ignore it
+            return self._q.get()

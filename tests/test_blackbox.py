@@ -306,3 +306,84 @@ def test_cli_merges_and_prints_verdict(tmp_path, capsys):
         assert json.load(f)["traceEvents"]
     # a directory of dumps loads the same as explicit paths
     assert len(blackbox.load(str(tmp_path))) == 3
+
+
+# -- signal-path audit (ISSUE 20 satellite) -----------------------------------
+
+def test_sigterm_mid_run_dumps_then_terminates(tmp_path):
+    """A real SIGTERM delivered mid-run: the handler itself only writes
+    one byte to a pre-opened pipe (async-signal-safe); the deferred
+    dumper thread records, dumps, then chains to the previous
+    disposition (SIG_DFL here -> exit 128+15)."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    script = (
+        "import os, time\n"
+        "from mxnet_tpu.observe import flightrec\n"
+        "assert flightrec.install_signal_handlers()\n"
+        "flightrec.record('test', 'alive', pid=os.getpid())\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(120)\n"
+    )
+    env = dict(os.environ, MXNET_BLACKBOX="1",
+               MXNET_BLACKBOX_DIR=str(tmp_path), JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, "-c", script], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 128 + signal.SIGTERM       # chained to SIG_DFL
+    dumps = [n for n in os.listdir(tmp_path) if n.endswith(".json")]
+    assert len(dumps) == 1
+    payload = json.load(open(tmp_path / dumps[0]))
+    assert payload["reason"] == "signal%d" % signal.SIGTERM
+    names = [(e[4], e[5]) for e in payload["events"]]
+    assert ("test", "alive") in names
+    assert ("terminal", "signal") in names  # recorded OFF-handler
+
+
+def test_sigint_chains_to_callable_prev_handler(tmp_path, monkeypatch):
+    """In-process SIGINT: the deferred dumper calls a callable previous
+    handler (off the handler, on the worker thread) after dumping."""
+    import signal
+    import threading
+    import time
+    from mxnet_tpu.observe import flightrec
+
+    monkeypatch.setenv("MXNET_BLACKBOX_DIR", str(tmp_path))
+    seen = threading.Event()
+    chained = []
+
+    def prev_handler(signum, frame):
+        chained.append(signum)
+        seen.set()
+
+    old_int = signal.getsignal(signal.SIGINT)
+    old_term = signal.getsignal(signal.SIGTERM)
+    old_installed = flightrec._signals_installed
+    flightrec._signals_installed = False
+    signal.signal(signal.SIGINT, prev_handler)
+    try:
+        assert flightrec.install_signal_handlers()
+        os.kill(os.getpid(), signal.SIGINT)
+        assert seen.wait(timeout=30)        # the chain actually ran
+        assert chained == [signal.SIGINT]
+        # the dump landed before the chain call
+        deadline = time.time() + 10
+        while time.time() < deadline and not os.listdir(tmp_path):
+            time.sleep(0.05)
+        dumps = [n for n in os.listdir(tmp_path) if n.endswith(".json")]
+        assert dumps
+        payload = json.load(open(tmp_path / dumps[0]))
+        assert payload["reason"] == "signal%d" % signal.SIGINT
+    finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)
+        flightrec._signals_installed = old_installed

@@ -516,3 +516,70 @@ def test_fleet_sla_report_shape(rng):
     r = report["interactive"]
     assert r["p99_ms"] is not None and r["ok"] is True
     assert report["standard"]["p99_ms"] is None   # no traffic, no claim
+
+
+# -- concurrency fuzz (ISSUE 20 satellite) ------------------------------------
+
+def test_submit_shutdown_eject_fuzz(rng):
+    """Thread-fuzz the triangle lockscan audits statically: N submitter
+    threads race replica ejection/re-admission and a draining shutdown.
+    Every future obtained from submit() resolves exactly once — with a
+    result or a typed error, never a strand, never a double-set."""
+    net = _mlp()
+    fleet = Fleet(net, replicas=2, name="t_fuzz", max_batch_size=4,
+                  max_latency_ms=1)
+    x = rng.standard_normal((1, 8)).astype(onp.float32)
+    fleet.warmup(x)
+
+    futs, resolved = [], []
+    record_lock = threading.Lock()
+    stop = threading.Event()
+    submit_errors = []
+
+    def _on_done(fut):
+        with record_lock:
+            resolved.append(fut)
+
+    def submitter():
+        while not stop.is_set():
+            try:
+                f = fleet.submit(x, cls="standard", timeout_ms=60_000)
+            except FleetClosed:
+                return               # legal outcome of racing shutdown
+            except Exception as e:   # anything else is a real bug
+                submit_errors.append(e)
+                return
+            f.add_done_callback(_on_done)
+            with record_lock:
+                futs.append(f)
+            time.sleep(0.002)        # bound the drain backlog
+
+    threads = [threading.Thread(target=submitter, name=f"fuzz-{i}")
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 1.2
+    while time.time() < deadline:
+        # flap replica 1 through the ejection state machine mid-traffic
+        fleet.replicas[1].record_failure()
+        time.sleep(0.03)
+        fleet.replicas[1].record_success()
+        time.sleep(0.03)
+    fleet.shutdown(drain=True)       # races the still-running submitters
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert not submit_errors, submit_errors
+
+    assert futs                      # traffic actually flowed
+    for f in futs:
+        assert f.done()              # drained or failed — never stranded
+        try:
+            out = f.result(timeout=0)
+            assert out.shape == (1, 4)
+        except (FleetClosed, DeadlineExceeded, NoHealthyReplica):
+            pass                     # typed failures are legal under churn
+    # exactly-once: every future fired its done callback exactly once
+    assert len(resolved) == len(futs)
+    assert len({id(f) for f in resolved}) == len(futs)

@@ -1,0 +1,419 @@
+"""lockscan framework tests (ISSUE 20).
+
+Fixture-based true-positive/clean pairs per rule (including the
+two-class lock-order cycle and the blocking-under-lock grid), waiver
+and baseline round-trips, finding-ID stability, the crosscheck
+semantics between the static model and a runtime witness report, the
+witness itself (an injected out-of-order acquisition is caught and the
+process exits 70), and the self-clean gate: lockscan run on this
+repo's own sources must exit 0 against the EMPTY committed baseline.
+"""
+import io
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from tools.lockscan import driver
+from tools.lockscan import model as lockmodel
+from tools.lockscan.rules import all_rules
+from tools.mxlint import core
+
+REPO = core.REPO_ROOT
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lockscan_fixtures")
+
+
+def _scan(fixture, rule=None):
+    root = os.path.join(FIXTURES, fixture)
+    findings, _n, _model = driver.scan([root], repo_root=root)
+    if rule is not None:
+        findings = [f for f in findings if f.rule == rule]
+    return findings
+
+
+def _unwaived(findings):
+    return [f for f in findings if not f.waived]
+
+
+def _model_of(fixture):
+    root = os.path.join(FIXTURES, fixture)
+    model, _ctxs, _n, _pf = lockmodel.build([root], repo_root=root)
+    return model
+
+
+# -- per-rule TP/clean pairs -----------------------------------------------
+@pytest.mark.parametrize("rule,tp,clean,n_expected", [
+    ("lock-order-cycle", "order_cycle", "order_clean", 1),
+    ("lock-order-cycle", "self_deadlock", "self_reentrant", 1),
+    ("blocking-under-lock", "blocking_tp", "blocking_clean", 6),
+    ("condition-wait-no-predicate", "cond_tp", "cond_clean", 1),
+    ("notify-outside-lock", "cond_tp", "cond_clean", 1),
+    ("signal-unsafe", "signal_tp", "signal_clean", 2),
+])
+def test_rule_fixture_pair(rule, tp, clean, n_expected):
+    hits = _unwaived(_scan(tp, rule))
+    assert len(hits) == n_expected, \
+        f"{rule} on {tp}: {[(f.path, f.line, f.message) for f in hits]}"
+    assert all(f.id for f in hits)
+    misses = _scan(clean, rule)
+    assert not misses, \
+        f"{rule} false positives on {clean}: " \
+        f"{[(f.path, f.line, f.message) for f in misses]}"
+
+
+def test_two_class_cycle_names_both_locks():
+    """The order_cycle fixture closes A._lock -> B._lock -> A._lock
+    through an attr-typed call, a module-alias call, and a module-var
+    receiver — the finding must name both lock keys."""
+    (hit,) = _scan("order_cycle", "lock-order-cycle")
+    assert "a.py:A._lock" in hit.message
+    assert "b.py:B._lock" in hit.message
+
+
+def test_self_deadlock_vs_reentrant_kind():
+    (hit,) = _scan("self_deadlock", "lock-order-cycle")
+    assert "re-acquired" in hit.message
+    assert not _scan("self_reentrant")       # RLock re-entry: zero findings
+
+
+def test_blocking_covers_the_grid_and_reports_the_call_chain():
+    descs = " | ".join(f.message for f in _scan("blocking_tp",
+                                                "blocking-under-lock"))
+    for needle in ("queue.Queue.get()", "Thread.join()", "Future.result()",
+                   "open()", "subprocess.run()", "time.sleep()"):
+        assert needle in descs, needle
+    # the interprocedural one names its path to the sleep
+    assert "via Worker._helper" in descs
+
+
+def test_clean_fixtures_are_fully_clean():
+    for fixture in ("order_clean", "blocking_clean", "cond_clean",
+                    "signal_clean", "self_reentrant"):
+        findings = _scan(fixture)
+        assert not findings, (fixture, [(f.rule, f.line) for f in findings])
+
+
+def test_rule_names_unique_and_documented():
+    rules = all_rules()
+    names = [r.name for r in rules]
+    assert len(set(names)) == len(names)
+    assert all(r.description for r in rules)
+    assert len(rules) == 5
+
+
+# -- waivers ---------------------------------------------------------------
+def test_waiver_grammar():
+    """Reasoned lockscan waiver suppresses; a bare one is itself a
+    finding and waives nothing; an mxlint-tagged waiver is ignored."""
+    findings = _scan("waivers")
+    blocking = [f for f in findings if f.rule == "blocking-under-lock"]
+    assert len(blocking) == 3
+    waived = [f for f in blocking if f.waived]
+    assert len(waived) == 1
+    assert "fixture" in waived[0].waive_reason
+    assert len(_unwaived(blocking)) == 2     # bare + wrong-tool forms
+    bad = [f for f in findings if f.rule == "bad-waiver"]
+    assert len(bad) == 1 and "lockscan" in bad[0].message
+
+
+# -- stable finding IDs ----------------------------------------------------
+def test_finding_ids_stable_across_unrelated_edits(tmp_path):
+    src = os.path.join(FIXTURES, "blocking_tp", "m.py")
+    work = tmp_path / "m.py"
+    shutil.copy(src, work)
+    ids_before = sorted(
+        f.id for f in driver.scan([str(tmp_path)],
+                                  repo_root=str(tmp_path))[0])
+    assert len(ids_before) == 6
+    # push every finding down two lines: IDs must not move
+    work.write_text("# unrelated banner\n# more banner\n" +
+                    open(src).read())
+    ids_after = sorted(
+        f.id for f in driver.scan([str(tmp_path)],
+                                  repo_root=str(tmp_path))[0])
+    assert ids_before == ids_after
+
+
+def test_finding_ids_change_when_the_line_changes(tmp_path):
+    src = open(os.path.join(FIXTURES, "blocking_tp", "m.py")).read()
+    work = tmp_path / "m.py"
+    work.write_text(src)
+    before = {f.id for f in driver.scan([str(tmp_path)],
+                                        repo_root=str(tmp_path))[0]}
+    work.write_text(src.replace("return self._q.get()",
+                                "return self._q.get()  # changed"))
+    after = {f.id for f in driver.scan([str(tmp_path)],
+                                       repo_root=str(tmp_path))[0]}
+    assert before != after
+
+
+# -- baseline round-trip ---------------------------------------------------
+def test_baseline_roundtrip(tmp_path):
+    fixture = os.path.join(FIXTURES, "blocking_tp")
+    baseline = str(tmp_path / "baseline.json")
+    out = io.StringIO()
+    assert driver.run([fixture], baseline_path=baseline, metrics=False,
+                      repo_root=fixture, out=out) == 1
+    assert driver.run([fixture], baseline_path=baseline, metrics=False,
+                      update_baseline=True, repo_root=fixture, out=out) == 0
+    data = json.load(open(baseline))
+    assert data["version"] == driver.JSON_SCHEMA_VERSION
+    assert len(data["findings"]) == 6
+    for entry in data["findings"].values():
+        assert {"rule", "path", "qualname", "message"} <= set(entry)
+    out = io.StringIO()
+    assert driver.run([fixture], baseline_path=baseline, metrics=False,
+                      repo_root=fixture, out=out) == 0
+    assert "baselined" in out.getvalue()
+
+
+def test_stale_baseline_entries_fail(tmp_path):
+    """A baseline naming findings that no longer exist FAILS the run —
+    the debt was paid, so the entry must be pruned in the same change."""
+    fixture = os.path.join(FIXTURES, "blocking_clean")
+    baseline = str(tmp_path / "baseline.json")
+    json.dump({"version": 1, "findings": {
+        "deadbeef0000": {"rule": "blocking-under-lock",
+                         "path": "gone.py", "qualname": "f",
+                         "message": "fixed long ago"}}},
+              open(baseline, "w"))
+    out = io.StringIO()
+    assert driver.run([fixture], baseline_path=baseline, metrics=False,
+                      repo_root=fixture, out=out) == 1
+    assert "FAIL" in out.getvalue() and "deadbeef0000" in out.getvalue()
+    assert driver.run([fixture], baseline_path=baseline, metrics=False,
+                      update_baseline=True, repo_root=fixture,
+                      out=io.StringIO()) == 0
+    assert json.load(open(baseline))["findings"] == {}
+
+
+def test_committed_baseline_is_empty():
+    """ISSUE 20 policy: the repo baseline ships EMPTY — every live
+    finding is fixed or carries a reasoned waiver, never grandfathered."""
+    data = json.load(open(driver.DEFAULT_BASELINE))
+    assert data["findings"] == {}
+
+
+# -- reporters -------------------------------------------------------------
+def test_json_reporter_schema():
+    out = io.StringIO()
+    fixture = os.path.join(FIXTURES, "cond_tp")
+    rc = driver.run([fixture], baseline_path=None, fmt="json",
+                    metrics=False, repo_root=fixture, out=out)
+    assert rc == 1
+    payload = json.loads(out.getvalue())
+    assert payload["version"] == driver.JSON_SCHEMA_VERSION
+    assert payload["tool"] == "lockscan"
+    assert payload["files_scanned"] == 1
+    assert payload["summary"]["total"] == payload["summary"]["unbaselined"] \
+        == len(payload["findings"]) == 2
+    for f in payload["findings"]:
+        assert {"id", "rule", "path", "line", "col", "qualname", "message",
+                "waived", "waive_reason", "baselined"} <= set(f)
+
+
+def test_verdict_lines_cover_every_rule():
+    fixture = os.path.join(FIXTURES, "blocking_tp")
+    findings, n_files, _m = driver.scan([fixture], repo_root=fixture)
+    lines = driver.verdict_lines(findings, n_files)
+    assert len(lines) == len(all_rules())
+    by_rule = {line.split()[1]: line for line in lines}
+    assert "FAIL (6)" in by_rule["blocking-under-lock"]
+    assert "PASS" in by_rule["lock-order-cycle"]
+    assert all("[1 files]" in line for line in lines)
+
+
+# -- cycle finder ----------------------------------------------------------
+def test_find_cycles_canonical_and_deduped():
+    cycles = lockmodel.find_cycles([("a", "b"), ("b", "a"),
+                                    ("b", "c"), ("c", "b"),
+                                    ("x", "x"), ("a", "z")])
+    assert ("a", "b") in cycles
+    assert ("b", "c") in cycles
+    assert ("x",) in cycles            # self-loop is a 1-cycle
+    assert len(cycles) == 3            # each found exactly once
+
+
+# -- crosscheck: static model vs witness report ----------------------------
+def test_crosscheck_detects_merged_cycle():
+    """order_clean is acyclic statically (A -> B); an observed B -> A
+    closes the cycle and must be a problem."""
+    model = _model_of("order_clean")
+    problems, _un = lockmodel.crosscheck(
+        model, [("b.py:B._lock", "a.py:A._lock")])
+    assert any("cycle" in p for p in problems)
+
+
+def test_crosscheck_tolerates_only_leaf_locks():
+    model = _model_of("order_clean")
+    # B._lock nests nothing (leaf): an unmodeled edge into it is fine
+    problems, unmodeled = lockmodel.crosscheck(
+        model, [("ghost", "b.py:B._lock")])
+    assert not problems and len(unmodeled) == 1
+    # A._lock has outgoing edges: an unmodeled edge into it means the
+    # static pass is under-approximating
+    problems, _un = lockmodel.crosscheck(model, [("ghost", "a.py:A._lock")])
+    assert any("under-approximating" in p for p in problems)
+
+
+def test_crosscheck_maps_witness_site_names():
+    """The witness names wrapped locks by creation site relpath:line;
+    crosscheck must map those through the model's site index."""
+    model = _model_of("order_clean")
+    (info,) = [li for li in model.locks.values()
+               if li.key == "a.py:A._lock"]
+    site_name = f"{info.relpath}:{info.line}"
+    problems, unmodeled = lockmodel.crosscheck(
+        model, [(site_name, "b.py:B._lock")])
+    assert not problems and not unmodeled    # mapped onto the static edge
+
+
+def test_crosscheck_in_driver_flags_witness_violations(tmp_path):
+    report = tmp_path / "report.json"
+    report.write_text(json.dumps({
+        "version": 1, "edges": [], "acyclic": False,
+        "violations": ["B -> A inverts A -> B"]}))
+    model = _model_of("order_clean")
+    out = io.StringIO()
+    assert driver.run_crosscheck(model, str(report), out=out) == 1
+    assert "witness-reported violation" in out.getvalue()
+
+
+# -- the runtime witness ---------------------------------------------------
+def test_witness_catches_injected_inversion():
+    """Tentpole acceptance (a): acquire A then B on one thread, then
+    B then A — the second path is refused at acquire time."""
+    from mxnet_tpu import lockwitness
+
+    lockwitness.reset()
+    try:
+        a = lockwitness.named_lock("wA")
+        b = lockwitness.named_lock("wB")
+        with a:
+            with b:
+                pass
+        assert ("wA", "wB") in lockwitness.observed_edges()
+        with b:
+            with pytest.raises(lockwitness.LockOrderViolation,
+                               match="wB.*wA|wA.*wB"):
+                with a:
+                    pass
+        assert lockwitness.violations()
+        assert not lockwitness.check_acyclic() or lockwitness.violations()
+        # the refused acquire left nothing held: A is free again
+        assert a.acquire(blocking=False)
+        a.release()
+    finally:
+        lockwitness.reset()
+
+
+def test_witness_violation_exits_70(tmp_path):
+    """A process that observed an inversion (even a caught one) must
+    not exit green: the atexit hook reports and exits 70."""
+    report = tmp_path / "report.json"
+    script = textwrap.dedent(f"""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "lockwitness", {os.path.join(REPO, "mxnet_tpu", "lockwitness.py")!r})
+        lw = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lw)
+        lw.install()
+        a, b = lw.named_lock("A"), lw.named_lock("B")
+        with a:
+            with b:
+                pass
+        try:
+            with b:
+                with a:
+                    pass
+        except lw.LockOrderViolation:
+            pass                    # caught — the exit code still tells
+    """)
+    env = dict(os.environ, MXNET_LOCKSCAN_REPORT=str(report))
+    r = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 70, r.stderr
+    assert "lockwitness: FAIL" in r.stderr
+    payload = json.load(open(report))
+    assert payload["violations"] and not payload["acyclic"]
+    assert ["A", "B"] in payload["edges"]
+
+
+def test_witness_fleet_run_consistent_with_static_model(tmp_path):
+    """Tentpole acceptance (b): a real fleet run under the witness
+    produces an acyclic observed graph, and crosscheck against the
+    static model is clean (the chaos-gate loop in miniature)."""
+    report = tmp_path / "report.json"
+    script = textwrap.dedent("""
+        import numpy as onp
+        import mxnet_tpu as mx
+        from mxnet_tpu import lockwitness
+        from mxnet_tpu.gluon import nn
+        from mxnet_tpu.serve import Fleet
+        assert lockwitness.installed()      # env var took effect at import
+        net = nn.HybridSequential()
+        net.add(nn.Dense(4))
+        net.initialize()
+        net(mx.np.zeros((1, 8)))
+        with Fleet(net, replicas=1, name="w_smoke", max_batch_size=2,
+                   max_latency_ms=1) as fleet:
+            fleet.warmup(onp.ones((1, 8), dtype=onp.float32))
+            futs = [fleet.submit(onp.ones((1, 8), dtype=onp.float32),
+                                 cls="standard", timeout_ms=60_000)
+                    for _ in range(4)]
+            for f in futs:
+                f.result(timeout=60)
+    """)
+    env = dict(os.environ, MXNET_LOCKSCAN_WITNESS="1",
+               MXNET_LOCKSCAN_REPORT=str(report), JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, cwd=REPO,
+                       timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.load(open(report))
+    assert payload["acyclic"] and not payload["violations"]
+    assert payload["edges"]                 # the run did nest locks
+    # the observed graph must be explainable by the static model
+    model, _c, _n, _p = lockmodel.build()
+    problems, _unmodeled = lockmodel.crosscheck(
+        model, [tuple(e) for e in payload["edges"]])
+    assert not problems, problems
+
+
+# -- the gate itself -------------------------------------------------------
+def test_lockscan_self_clean():
+    """`python -m tools.lockscan` on the repo exits 0 against the EMPTY
+    committed baseline: every live finding is fixed or carries a
+    reasoned waiver (the CI gate in tools/ci.sh)."""
+    r = subprocess.run([sys.executable, "-m", "tools.lockscan",
+                        "--no-metrics"],
+                       capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_cli_reports_fixture_findings_nonzero():
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.lockscan",
+         "tests/lockscan_fixtures/blocking_tp", "--no-baseline",
+         "--no-metrics"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert r.returncode == 1
+    assert "[blocking-under-lock]" in r.stdout
+
+
+def test_cli_list_rules():
+    r = subprocess.run([sys.executable, "-m", "tools.lockscan",
+                        "--list-rules"],
+                       capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert r.returncode == 0
+    for name in ("lock-order-cycle", "blocking-under-lock",
+                 "condition-wait-no-predicate", "notify-outside-lock",
+                 "signal-unsafe"):
+        assert name in r.stdout

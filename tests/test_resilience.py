@@ -628,3 +628,44 @@ def test_data_iterator_fault_reraises_at_next():
         for _ in range(8):
             next(pf)
     pf.close()
+
+
+# -- async writer lifecycle race (ISSUE 20 satellite) -------------------------
+
+def test_async_writer_respawn_race_loses_no_steps(tmp_path):
+    """Regression for the lockscan-found CheckpointManager race: two
+    save() calls racing the worker (re)spawn used to BOTH see a dead
+    worker and BOTH replace the queue, stranding whichever queue lost —
+    writes silently never hit disk.  The whole check-and-replace is now
+    one critical section and the worker drains the queue it was born
+    with: every step saved by any thread, across close()/respawn
+    cycles, must be durably on disk."""
+    import threading
+
+    mgr = CheckpointManager(tmp_path, keep=100, async_write=True, rank=0)
+    next_step = 1
+    for _round in range(3):          # round 0: cold spawn; later: respawn
+        steps = list(range(next_step, next_step + 16))
+        next_step += 16
+        chunks = [steps[i::4] for i in range(4)]
+        barrier = threading.Barrier(4)
+
+        def saver(chunk):
+            barrier.wait()           # all hit _ensure_worker together
+            for s in chunk:
+                mgr.save(s, {"w": onp.full(2, float(s))}, {"step": s})
+
+        threads = [threading.Thread(target=saver, args=(c,))
+                   for c in chunks]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+        mgr.close()                  # flush + reap: next round respawns
+        assert ckpt.list_steps(str(tmp_path)) == list(range(1, next_step))
+    # a post-close save still works (fresh worker) and still flushes
+    mgr.save(next_step, {"w": onp.zeros(2)}, {})
+    mgr.wait()
+    assert next_step in ckpt.list_steps(str(tmp_path))
+    mgr.close()

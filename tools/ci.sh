@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI entry (reference: ci/build.py + runtime_functions.sh stages).
-# Stages: lint | import | hloscan | census | autotune | smoke | test
-# | chaos | storm | endure | blackbox | perf | dryrun | all
+# Stages: lint | lockscan | import | hloscan | census | autotune | smoke
+# | test | chaos | storm | endure | blackbox | perf | dryrun | all
 # (default: all).
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -18,6 +18,17 @@ run_lint() {
   # (docs/STATIC_ANALYSIS.md; waive with `# mxlint: disable=<rule> --
   # <reason>`, grandfather with --update-baseline)
   python -m tools.mxlint
+}
+run_lockscan() {
+  # concurrency-contract gate (ISSUE 20): interprocedural lock-order /
+  # blocking-under-lock analysis over the package — lock-order cycles,
+  # blocking calls under held locks, predicate-free Condition.wait,
+  # notify outside the owning lock, lock-taking signal handlers — clean
+  # against the EMPTY committed baseline (docs/STATIC_ANALYSIS.md
+  # "Concurrency contracts"; waive with `# lockscan: disable=<rule> --
+  # <reason>`).  The runtime half (the acquisition witness) rides the
+  # chaos/storm/endure stages below via MXNET_LOCKSCAN_WITNESS.
+  python -m tools.lockscan --verdicts
 }
 run_import() {
   # hard gate (ISSUE 1): bare import + zero collection errors, so an
@@ -65,9 +76,10 @@ run_test()   {
   # masked/dropout flash parity first (ISSUE 3): the kernel tier BERT
   # training rides must fail fast and loud before anything else runs
   python -m pytest tests/test_flash_attention.py -q
-  # the two static-analysis gates' own suites next (ISSUEs 5+7): a
+  # the three static-analysis gates' own suites next (ISSUEs 5+7+20): a
   # broken checker is worse than no checker
-  python -m pytest tests/test_mxlint.py tests/test_hloscan.py -q
+  python -m pytest tests/test_mxlint.py tests/test_hloscan.py \
+    tests/test_lockscan.py -q
   # telemetry next: the observability layer every later perf PR reads
   # its numbers from fails fast and loud (ISSUE 2)
   python -m pytest tests/test_telemetry.py -q
@@ -80,6 +92,13 @@ run_test()   {
   python -m pytest tests/ -q -x
 }
 run_chaos()  {
+  # runtime lock-acquisition witness (ISSUE 20): every process in this
+  # gate (and storm/endure below) runs with the lockwitness factory shim
+  # installed — an out-of-order acquisition aborts that process with
+  # exit 70 and fails the stage; the env-plan run additionally dumps its
+  # observed acquisition graph and crosschecks it against the static
+  # model (MXNET_LOCKSCAN_WITNESS=0 opts out)
+  export MXNET_LOCKSCAN_WITNESS="${MXNET_LOCKSCAN_WITNESS:-1}"
   # chaos gate (ISSUE 9): deterministic fault injection + recovery — the
   # resume-parity fence, the retry/step-guard policies, and the atomic
   # checkpoint round-trip must all survive without process death
@@ -89,6 +108,7 @@ run_chaos()  {
   # plan()) must fire in a fresh interpreter and be retried away, visible
   # in mxtpu_faults_recovered_total
   MXNET_FAULTLINE='[{"site": "kvstore.pushpull", "kind": "timeout", "at": 1}]' \
+  MXNET_LOCKSCAN_REPORT="/tmp/lockscan-chaos-$$.json" \
   python - <<'EOF'
 import numpy as onp
 import mxnet_tpu as mx
@@ -105,6 +125,15 @@ rec = telemetry.default_registry().get_sample_value(
 assert rec == 1, rec
 print("ci: env-plan KV timeout injected and recovered")
 EOF
+  # the witness run above dumped its observed acquisition graph — the
+  # merged static+observed order must be acyclic and every observed edge
+  # explained by the static model (ISSUE 20)
+  if [ "${MXNET_LOCKSCAN_WITNESS}" != "0" ] && \
+     [ -f "/tmp/lockscan-chaos-$$.json" ]; then
+    python -m tools.lockscan --no-metrics \
+      --crosscheck "/tmp/lockscan-chaos-$$.json"
+    rm -f "/tmp/lockscan-chaos-$$.json"
+  fi
   # quantized preempt/resume parity (ISSUE 11): the resume-parity fence
   # again, but through the block-scaled int8 bucketed path — its
   # error-feedback residuals ride the SAME kvres/bucketres checkpoint
@@ -200,7 +229,8 @@ run_storm() {
   # mxtpu_faults_recovered_total + mxtpu_fleet_failover_seconds
   # (docs/SERVING.md "Fleet"; opt out with MXTPU_CHAOS_STORM=0)
   if [ "${MXTPU_CHAOS_STORM:-1}" != "0" ]; then
-    python -m tools.storm --gate
+    MXNET_LOCKSCAN_WITNESS="${MXNET_LOCKSCAN_WITNESS:-1}" \
+      python -m tools.storm --gate
   fi
 }
 run_endure() {
@@ -214,7 +244,8 @@ run_endure() {
   # (docs/RESILIENCE.md "Elastic recovery"; opt out with
   # MXTPU_CHAOS_ENDURE=0)
   if [ "${MXTPU_CHAOS_ENDURE:-1}" != "0" ]; then
-    python -m tools.endure --gate
+    MXNET_LOCKSCAN_WITNESS="${MXNET_LOCKSCAN_WITNESS:-1}" \
+      python -m tools.endure --gate
   fi
 }
 run_blackbox() {
@@ -244,6 +275,7 @@ run_dryrun() {
 
 case "$stage" in
   lint)    run_lint ;;
+  lockscan) run_lockscan ;;
   import)  run_import ;;
   hloscan) run_hloscan ;;
   census)  run_census ;;
@@ -256,7 +288,8 @@ case "$stage" in
   blackbox) run_blackbox ;;
   perf)    run_perf ;;
   dryrun)  run_dryrun ;;
-  all)     run_lint; run_import; run_hloscan; run_census; run_autotune
+  all)     run_lint; run_lockscan; run_import; run_hloscan; run_census
+           run_autotune
            run_smoke; run_test; run_chaos; run_storm; run_endure
            run_blackbox; run_perf; run_dryrun ;;
   *) echo "unknown stage $stage" >&2; exit 2 ;;

@@ -1,0 +1,15 @@
+"""lockscan — interprocedural lock-order / blocking-under-lock analysis.
+
+Static pass over the whole ``mxnet_tpu`` package (lock discovery,
+cross-class acquisition-order graph, blocking-call reachability,
+condition-variable discipline, signal-handler safety) plus the
+crosscheck against the opt-in runtime witness
+(``mxnet_tpu.lockwitness``, ``MXNET_LOCKSCAN_WITNESS=1``).  Contract
+discipline mirrors mxlint/hloscan: stable finding IDs, reason-REQUIRED
+``# lockscan: disable=<rule> -- <reason>`` waivers, an EMPTY committed
+``tools/lockscan_baseline.json`` where stale entries FAIL, text/JSON
+reporters, ``mxtpu_lockscan_findings`` telemetry, exit 0/1/2.
+See docs/STATIC_ANALYSIS.md "Concurrency contracts".
+"""
+from .driver import main, run, scan, verdict_lines  # noqa: F401
+from .model import LockModel, build, crosscheck, find_cycles  # noqa: F401

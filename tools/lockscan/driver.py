@@ -1,0 +1,265 @@
+"""lockscan driver: build the lock model, check, waive, baseline, report.
+
+Exit status mirrors mxlint/hloscan: 0 when every finding is waived or
+baselined AND the baseline is current, 1 when an unbaselined finding
+remains OR the baseline names findings that no longer exist (stale
+entries are paid debts — prune them in the same change via
+``--update-baseline``), 2 on usage error.
+
+``--crosscheck REPORT.json`` additionally verifies a runtime witness
+report (written by ``mxnet_tpu.lockwitness`` when
+``MXNET_LOCKSCAN_REPORT`` is set): the merged static+observed
+acquisition graph must be acyclic, and every observed edge into a
+non-leaf lock must exist in the static model.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.mxlint import core
+
+from . import model as lockmodel
+from .rules import all_rules
+
+DEFAULT_BASELINE = os.path.join(core.REPO_ROOT, "tools",
+                                "lockscan_baseline.json")
+
+JSON_SCHEMA_VERSION = 1
+
+
+def scan(paths=None, rules=None, repo_root=None):
+    """Build the model and run ``rules`` (default: all) over it.
+    Returns (findings, n_files, model); waivers applied, IDs assigned,
+    no baseline."""
+    rules = all_rules() if rules is None else rules
+    model, ctx_by_path, n_files, parse_findings = lockmodel.build(
+        paths, repo_root=repo_root)
+    by_file = {}
+    for f in parse_findings:
+        by_file.setdefault(f.path, []).append(f)
+    for rule in rules:
+        for f in rule.check(model) or ():
+            by_file.setdefault(f.path, []).append(f)
+    findings = []
+    for relpath, ctx in ctx_by_path.items():
+        findings.extend(core.apply_waivers(by_file.pop(relpath, []), ctx,
+                                           tool="lockscan"))
+    for leftover in by_file.values():    # parse errors: no ctx, no waivers
+        findings.extend(leftover)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    core.assign_ids(findings, ctx_by_path)
+    return findings, n_files, model
+
+
+def load_baseline(path):
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return data.get("findings", {})
+
+
+def write_baseline(path, findings):
+    """Grandfather every current unwaived finding (``--update-baseline``)."""
+    entries = {
+        f.id: {"rule": f.rule, "path": f.path, "qualname": f.qualname,
+               "message": f.message}
+        for f in findings if not f.waived}
+    payload = {
+        "comment": "lockscan grandfathered findings — entries are debts, "
+                   "not permissions; remove as they are fixed. Stale "
+                   "entries FAIL the scan. Regenerate with "
+                   "`python -m tools.lockscan --update-baseline`.",
+        "version": JSON_SCHEMA_VERSION,
+        "findings": dict(sorted(entries.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return entries
+
+
+def verdict_lines(findings, n_files, rules=None):
+    """Per-rule ``lockscan <rule> PASS|FAIL`` lines for the dryrun rider —
+    a rule FAILs when any unwaived, unbaselined finding of it exists."""
+    rules = all_rules() if rules is None else rules
+    live = {}
+    for f in findings:
+        if not f.waived and not f.baselined:
+            live[f.rule] = live.get(f.rule, 0) + 1
+    lines = []
+    for rule in rules:
+        n = live.get(rule.name, 0)
+        verdict = "PASS" if not n else f"FAIL ({n})"
+        lines.append(f"lockscan {rule.name:28s} {verdict}  "
+                     f"[{n_files} files]")
+    return lines
+
+
+def publish_metrics(findings):
+    """Mirror the finding census into the telemetry registry (best
+    effort: lockscan must work without mxnet_tpu importable)."""
+    try:
+        from mxnet_tpu import telemetry
+    except Exception:  # mxlint: disable=swallowed-exception -- lockscan must run without mxnet_tpu importable; the False return IS the report
+        return False
+    g = telemetry.gauge(
+        "mxtpu_lockscan_findings",
+        "lockscan findings by rule and disposition",
+        labelnames=("rule", "disposition"))
+    per = {}
+    for f in findings:
+        disp = "waived" if f.waived else (
+            "baselined" if f.baselined else "live")
+        per[(f.rule, disp)] = per.get((f.rule, disp), 0) + 1
+    for rule in all_rules():
+        for disp in ("live", "waived", "baselined"):
+            g.labels(rule=rule.name, disposition=disp).set(
+                per.get((rule.name, disp), 0))
+    return True
+
+
+def report_text(findings, n_files, stale_ids, out=sys.stdout):
+    unbaselined = [f for f in findings if not f.waived and not f.baselined]
+    for f in unbaselined:
+        out.write(f"{f.path}:{f.line}:{f.col + 1}: [{f.rule}] "
+                  f"{f.message}  (id {f.id})\n")
+    n_w = sum(1 for f in findings if f.waived)
+    n_b = sum(1 for f in findings if f.baselined)
+    if stale_ids:
+        out.write(f"lockscan: FAIL — {len(stale_ids)} baseline entr"
+                  f"{'y names a finding' if len(stale_ids) == 1 else 'ies name findings'} "
+                  f"that no longer exist{'s' if len(stale_ids) == 1 else ''} "
+                  f"(debt paid — prune it in the same change with "
+                  f"--update-baseline): {', '.join(sorted(stale_ids))}\n")
+    verdict = "clean" if not unbaselined else \
+        f"{len(unbaselined)} unbaselined finding" + \
+        ("s" if len(unbaselined) != 1 else "")
+    out.write(f"lockscan: {verdict} — {n_files} files, "
+              f"{len(findings)} findings ({n_w} waived, {n_b} baselined)\n")
+
+
+def report_json(findings, n_files, stale_ids, out=sys.stdout):
+    unbaselined = [f for f in findings if not f.waived and not f.baselined]
+    payload = {
+        "version": JSON_SCHEMA_VERSION,
+        "tool": "lockscan",
+        "files_scanned": n_files,
+        "findings": [f.to_json() for f in findings],
+        "stale_baseline_ids": sorted(stale_ids),
+        "summary": {
+            "total": len(findings),
+            "waived": sum(1 for f in findings if f.waived),
+            "baselined": sum(1 for f in findings if f.baselined),
+            "unbaselined": len(unbaselined),
+        },
+    }
+    json.dump(payload, out, indent=2)
+    out.write("\n")
+
+
+def run_crosscheck(model, report_path, out=sys.stdout):
+    """Verify a witness report against the static model; 0 = consistent."""
+    try:
+        with open(report_path, "r", encoding="utf-8") as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        out.write(f"lockscan: crosscheck FAIL — cannot read "
+                  f"{report_path}: {e}\n")
+        return 1
+    edges = [tuple(e) for e in report.get("edges", ())]
+    problems, unmodeled = lockmodel.crosscheck(model, edges)
+    if report.get("violations"):
+        for v in report["violations"]:
+            problems.append(f"witness-reported violation: {v}")
+    for p in problems:
+        out.write(f"lockscan: crosscheck FAIL — {p}\n")
+    tolerated = len(unmodeled) - sum(
+        1 for p in problems if "under-approximating" in p)
+    out.write(f"lockscan: crosscheck {'FAIL' if problems else 'ok'} — "
+              f"{len(edges)} observed edges, {len(unmodeled)} unmodeled "
+              f"({tolerated} into leaf locks, tolerated), "
+              f"{len(problems)} problems\n")
+    return 1 if problems else 0
+
+
+def run(paths=None, baseline_path=None, update_baseline=False,
+        fmt="text", verdicts=False, metrics=True, crosscheck_path=None,
+        out=sys.stdout, repo_root=None):
+    """Full pipeline; returns the process exit code."""
+    findings, n_files, model = scan(paths, repo_root=repo_root)
+    baseline = {}
+    if baseline_path:
+        baseline = load_baseline(baseline_path)
+        for f in findings:
+            if not f.waived and f.id in baseline:
+                f.baselined = True
+    if update_baseline:
+        if not baseline_path:
+            out.write("lockscan: --update-baseline needs --baseline PATH\n")
+            return 2
+        entries = write_baseline(baseline_path, findings)
+        out.write(f"lockscan: baseline written — {len(entries)} entr"
+                  f"{'y' if len(entries) == 1 else 'ies'} -> "
+                  f"{baseline_path}\n")
+        return 0
+    present = {f.id for f in findings if not f.waived}
+    stale_ids = set(baseline) - present
+    if metrics:
+        publish_metrics(findings)
+    (report_json if fmt == "json" else report_text)(
+        findings, n_files, stale_ids, out=out)
+    if verdicts:
+        for line in verdict_lines(findings, n_files):
+            out.write(line + "\n")
+    rc_cross = 0
+    if crosscheck_path:
+        rc_cross = run_crosscheck(model, crosscheck_path, out=out)
+    failed = any(not f.waived and not f.baselined for f in findings)
+    return 1 if (failed or stale_ids or rc_cross) else 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m tools.lockscan",
+        description="Interprocedural lock-order / blocking-under-lock "
+                    "analysis with a runtime acquisition witness "
+                    "(docs/STATIC_ANALYSIS.md).")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to scan (default: mxnet_tpu/)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON of grandfathered finding IDs "
+                        "(default: tools/lockscan_baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report everything)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings")
+    p.add_argument("--verdicts", action="store_true",
+                   help="append per-rule PASS/FAIL verdict lines")
+    p.add_argument("--no-metrics", action="store_true",
+                   help="skip publishing the finding census to telemetry")
+    p.add_argument("--crosscheck", metavar="REPORT",
+                   help="verify a witness report (MXNET_LOCKSCAN_REPORT "
+                        "dump) against the static model")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.name:30s} {rule.description}")
+        return 0
+
+    return run(paths=args.paths or None,
+               baseline_path=None if args.no_baseline else args.baseline,
+               update_baseline=args.update_baseline,
+               fmt=args.format, verdicts=args.verdicts,
+               metrics=not args.no_metrics,
+               crosscheck_path=args.crosscheck)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

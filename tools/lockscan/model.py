@@ -1,0 +1,588 @@
+"""lockscan lock model: discovery, interprocedural summaries, events.
+
+The model is built once per scan from the parsed project (mxlint's
+:class:`~tools.mxlint.core.ProjectIndex` does symbol/call resolution;
+this module adds the concurrency semantics on top):
+
+* **Locks** — every ``self.X = threading.Lock/RLock/Condition()``
+  attribute and every module-level ``_lock = threading.Lock()`` gets a
+  stable key ``"<relpath>:<Class>.<attr>"`` / ``"<relpath>:<name>"``
+  plus a creation-site index the runtime witness's report maps back
+  onto.
+* **Edges** — walking every function with a per-thread-style held
+  stack: each acquisition (lexical ``with lock:`` or one reached
+  through a resolved call chain) while ``h`` is held adds the order
+  edge ``h -> acquired``, with the evidence site and call chain kept
+  for the report.
+* **Events** — blocking operations under a held lock,
+  ``Condition.wait`` calls and whether a predicate loop encloses them,
+  ``notify`` calls and whether the owning lock is lexically held, and
+  the closure of work reachable from installed signal handlers.
+
+Summaries are memoized per function and recursion-guarded, so the walk
+is linear in project size even with call cycles.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.mxlint import core
+
+#: constructor type tags (from ProjectIndex attr/var inference) that are
+#: lock objects, and whether re-acquiring one on the same thread
+#: deadlocks (plain Lock) or not (RLock; Condition wraps an RLock).
+LOCK_KINDS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+}
+
+#: module/function calls that block the calling thread.  Receiver-typed
+#: entries (queue get, thread join, future result) are handled in
+#: :meth:`_Walker._classify_blocking` with extra context.
+_BLOCKING_NAME_CALLS = {
+    "sleep": "time.sleep() blocks the holder",
+    "fsync": "os.fsync() blocks on storage",
+    "open": "open() is file I/O",
+}
+_BLOCKING_ATTR_CALLS = {
+    "sleep": "time.sleep() blocks the holder",
+    "fsync": "os.fsync() blocks on storage",
+    "block_until_ready": "device sync blocks until the accelerator drains",
+    "asnumpy": "asnumpy() is a device->host sync",
+    "device_put": "jax.device_put() is host->device traffic",
+}
+_SUBPROCESS_CALLS = {"run", "call", "check_call", "check_output"}
+
+
+@dataclass
+class LockInfo:
+    key: str            # "<relpath>:<Class>.<attr>" or "<relpath>:<var>"
+    kind: str           # Lock | RLock | Condition
+    relpath: str
+    line: int           # creation-site line (witness report maps here)
+
+
+@dataclass
+class Edge:
+    """One piece of evidence that ``src`` is held while ``dst`` is
+    acquired.  ``chain`` is the resolved call path ("" when lexical)."""
+    src: str
+    dst: str
+    relpath: str
+    line: int
+    qualname: str
+    chain: str = ""
+
+
+@dataclass
+class BlockingEvent:
+    held: tuple         # lock keys held, outermost first
+    desc: str           # what blocks, e.g. "queue.Queue.get() without timeout"
+    relpath: str
+    line: int
+    qualname: str
+    chain: str = ""
+
+
+@dataclass
+class WaitEvent:
+    cond: str
+    relpath: str
+    line: int
+    qualname: str
+    in_loop: bool
+    wait_for: bool
+
+
+@dataclass
+class NotifyEvent:
+    cond: str
+    relpath: str
+    line: int
+    qualname: str
+    held: bool          # owning Condition lexically held at the call
+
+
+@dataclass
+class SignalEvent:
+    """Blocking/locking work reachable from an installed signal handler."""
+    handler: str        # handler qualname
+    desc: str           # offending operation
+    relpath: str        # site of the signal.signal() installation
+    line: int
+    qualname: str
+    chain: str
+
+
+@dataclass
+class _Summary:
+    """What calling this function does, as seen by a caller that may be
+    holding locks: every lock key it can acquire (transitively) and
+    every blocking op it exposes that is NOT already under one of its
+    own locks (those are reported at the inner site instead)."""
+    acquires: dict = field(default_factory=dict)   # key -> (site, chain)
+    blocking: list = field(default_factory=list)   # (desc, site, chain)
+
+
+class LockModel:
+    def __init__(self, ctxs):
+        self.ctxs = {ctx.relpath: ctx for ctx in ctxs}
+        self.index = core.ProjectIndex(ctxs)
+        self.locks = {}          # key -> LockInfo
+        self.site_index = {}     # (relpath, line) -> key
+        self.edges = []          # list[Edge]
+        self.blocking = []       # list[BlockingEvent]
+        self.waits = []          # list[WaitEvent]
+        self.notifies = []       # list[NotifyEvent]
+        self.signals = []        # list[SignalEvent]
+        self._summaries = {}     # id(fn) -> _Summary
+        self._in_progress = set()
+        self._discover_locks()
+        self._walk_all()
+        self._walk_signal_handlers()
+
+    # -- lock discovery ----------------------------------------------------
+    def _discover_locks(self):
+        for relpath, ctx in self.ctxs.items():
+            mod = self.index.modules.get(relpath)
+            if mod is None:
+                continue
+            for name, tag in mod.var_types.items():
+                if tag in LOCK_KINDS:
+                    self._add_lock(f"{relpath}:{name}", LOCK_KINDS[tag],
+                                   relpath, self._var_line(mod, name))
+            for cls in mod.classes.values():
+                for attr, tag in cls.attr_types.items():
+                    if tag in LOCK_KINDS:
+                        self._add_lock(
+                            f"{relpath}:{cls.name}.{attr}", LOCK_KINDS[tag],
+                            relpath, self._attr_line(cls, attr))
+
+    def _add_lock(self, key, kind, relpath, line):
+        self.locks[key] = LockInfo(key=key, kind=kind, relpath=relpath,
+                                   line=line)
+        self.site_index[(relpath, line)] = key
+
+    @staticmethod
+    def _var_line(mod, name):
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    node.targets[0].id == name:
+                return node.lineno
+        return 1
+
+    @staticmethod
+    def _attr_line(cls, attr):
+        for m in cls.methods.values():
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self" and t.attr == attr:
+                        return node.lineno
+        return cls.node.lineno
+
+    # -- lock expression resolution ----------------------------------------
+    def lock_key_of(self, expr, mod, cls):
+        """Lock key named by ``expr`` in (mod, cls) scope, or None."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and cls is not None:
+                return self._class_lock(cls.key, expr.attr)
+            # `with _state.lock:` — module-level instance of a project class
+            tkey = mod.var_types.get(expr.value.id)
+            if tkey is not None:
+                return self._class_lock(tkey, expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            lk = f"{mod.relpath}:{expr.id}"
+            return lk if lk in self.locks else None
+        return None
+
+    def _class_lock(self, class_key, attr):
+        # walk project bases so subclasses see inherited locks
+        seen, stack = set(), [class_key]
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            c = self.index.class_by_key(key)
+            if c is None:
+                continue
+            lk = f"{c.relpath}:{c.name}.{attr}"
+            if lk in self.locks:
+                return lk
+            stack.extend(c.base_keys)
+        return None
+
+    # -- interprocedural walk ----------------------------------------------
+    def _walk_all(self):
+        for relpath in sorted(self.ctxs):
+            mod = self.index.modules.get(relpath)
+            if mod is None:
+                continue
+            for fn in mod.functions.values():
+                self.summarize(fn, mod, None)
+            for cls in mod.classes.values():
+                for fn in cls.methods.values():
+                    self.summarize(fn, mod, cls)
+
+    def summarize(self, fn, mod, cls):
+        key = id(fn)
+        if key in self._summaries:
+            return self._summaries[key]
+        if key in self._in_progress:        # recursion: fixpoint = empty
+            return _Summary()
+        self._in_progress.add(key)
+        summary = _Summary()
+        walker = _Walker(self, mod, cls, fn, summary)
+        walker.run()
+        self._in_progress.discard(key)
+        self._summaries[key] = summary
+        return summary
+
+    # -- signal safety ------------------------------------------------------
+    def _walk_signal_handlers(self):
+        for relpath, ctx in self.ctxs.items():
+            mod = self.index.modules.get(relpath)
+            if mod is None:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr == "signal" and
+                        isinstance(node.func.value, ast.Name) and
+                        node.func.value.id == "signal" and
+                        len(node.args) >= 2):
+                    continue
+                handler = node.args[1]
+                targets = self._resolve_handler(handler, mod, ctx, node)
+                for hmod, hcls, hfn in targets:
+                    hname = hfn.name if hcls is None else \
+                        f"{hcls.name}.{hfn.name}"
+                    sub = self.summarize(hfn, hmod, hcls)
+                    for lk, (site, chain) in sorted(sub.acquires.items()):
+                        self.signals.append(SignalEvent(
+                            handler=hname,
+                            desc=f"acquires {lk}"
+                                 f"{' via ' + chain if chain else ''}",
+                            relpath=relpath, line=node.lineno,
+                            qualname=ctx.qualname_at(node.lineno),
+                            chain=chain))
+                    for desc, site, chain in sub.blocking:
+                        self.signals.append(SignalEvent(
+                            handler=hname,
+                            desc=f"{desc}"
+                                 f"{' via ' + chain if chain else ''}",
+                            relpath=relpath, line=node.lineno,
+                            qualname=ctx.qualname_at(node.lineno),
+                            chain=chain))
+
+    def _resolve_handler(self, handler, mod, ctx, site):
+        """The function object(s) a handler expression names."""
+        if isinstance(handler, ast.Name):
+            if handler.id in mod.functions:
+                return [(mod, None, mod.functions[handler.id])]
+            imp = mod.imports.get(handler.id)
+            if imp and imp[0] == "symbol":
+                tgt = self.index.by_dotted.get(imp[1])
+                if tgt and imp[2] in tgt.functions:
+                    return [(tgt, None, tgt.functions[imp[2]])]
+        elif isinstance(handler, ast.Attribute) and \
+                isinstance(handler.value, ast.Name) and \
+                handler.value.id == "self":
+            qn = ctx.qualname_at(site.lineno)
+            cls = mod.classes.get(qn.split(".")[0])
+            if cls is not None:
+                owner, fn = self.index.method_of(cls.key, handler.attr)
+                if fn is not None:
+                    return [(self.index.modules[owner.relpath], owner, fn)]
+        # nested def registered as handler: find an enclosing-scope def
+        if isinstance(handler, ast.Name):
+            qn = ctx.qualname_at(site.lineno)
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node.name == handler.id and \
+                        node.lineno <= site.lineno:
+                    return [(mod, None, node)]
+        return []
+
+
+class _Walker:
+    """One function's body walk with a held-lock stack."""
+
+    def __init__(self, model, mod, cls, fn, summary):
+        self.model = model
+        self.mod = mod
+        self.cls = cls
+        self.fn = fn
+        self.summary = summary
+        self.ctx = model.ctxs[mod.relpath]
+        self.qualname = self.ctx.qualname_at(fn.lineno)
+
+    def run(self):
+        for stmt in self.fn.body:
+            self._visit(stmt, held=(), loops=0)
+
+    # -- traversal ---------------------------------------------------------
+    def _visit(self, node, held, loops):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return      # nested defs are walked when (if) resolved as calls
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            self._visit_with(node, held, loops)
+            return
+        if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            loops += 1
+        if isinstance(node, ast.Call):
+            self._visit_call(node, held, loops)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held, loops)
+
+    def _visit_with(self, node, held, loops):
+        inner = held
+        for item in node.items:
+            expr = item.context_expr
+            # `with lock:` / `with cond:` (a bare Call like
+            # `with open(...)` is visited as a call, not an acquisition)
+            lk = self.model.lock_key_of(expr, self.mod, self.cls)
+            if lk is not None:
+                self._acquire(lk, node.lineno, inner, chain="")
+                inner = inner + (lk,)
+            else:
+                self._visit(expr, held, loops)
+        for stmt in node.body:
+            self._visit(stmt, inner, loops)
+
+    def _acquire(self, lk, line, held, chain):
+        for h in held:
+            if h == lk:
+                continue    # re-acquisition is not an ordering edge
+            self.model.edges.append(Edge(
+                src=h, dst=lk, relpath=self.mod.relpath, line=line,
+                qualname=self.qualname, chain=chain))
+        if lk in held and self.model.locks[lk].kind == "Lock":
+            # re-acquiring a non-reentrant Lock on the same thread is a
+            # guaranteed self-deadlock: model it as a self-edge
+            self.model.edges.append(Edge(
+                src=lk, dst=lk, relpath=self.mod.relpath, line=line,
+                qualname=self.qualname, chain=chain))
+        self.summary.acquires.setdefault(
+            lk, ((self.mod.relpath, line), chain))
+
+    # -- calls -------------------------------------------------------------
+    def _visit_call(self, node, held, loops):
+        cond = self._condition_receiver(node)
+        if cond is not None:
+            meth = node.func.attr
+            if meth in ("wait", "wait_for"):
+                self.model.waits.append(WaitEvent(
+                    cond=cond, relpath=self.mod.relpath, line=node.lineno,
+                    qualname=self.qualname, in_loop=loops > 0,
+                    wait_for=meth == "wait_for"))
+            elif meth in ("notify", "notify_all"):
+                self.model.notifies.append(NotifyEvent(
+                    cond=cond, relpath=self.mod.relpath, line=node.lineno,
+                    qualname=self.qualname, held=cond in held))
+
+        desc = self._classify_blocking(node)
+        if desc is not None:
+            self._blocked(desc, node.lineno, held, chain="")
+
+        for tmod, tcls, tfn in self.model.index.resolve_call(
+                node, self.mod, self.cls):
+            sub = self.model.summarize(tfn, tmod, tcls)
+            callee = tfn.name if tcls is None else f"{tcls.name}.{tfn.name}"
+            for lk, (site, chain) in sub.acquires.items():
+                link = f"{callee} -> {chain}" if chain else callee
+                self._acquire_via_call(lk, node.lineno, held, link)
+            for bdesc, site, chain in sub.blocking:
+                link = f"{callee} -> {chain}" if chain else callee
+                self._blocked(bdesc, node.lineno, held, link)
+
+    def _acquire_via_call(self, lk, line, held, chain):
+        for h in held:
+            if h == lk:
+                continue    # re-acquisition is not an ordering edge
+            self.model.edges.append(Edge(
+                src=h, dst=lk, relpath=self.mod.relpath, line=line,
+                qualname=self.qualname, chain=chain))
+        if lk in held and self.model.locks[lk].kind == "Lock":
+            self.model.edges.append(Edge(
+                src=lk, dst=lk, relpath=self.mod.relpath, line=line,
+                qualname=self.qualname, chain=chain))
+        self.summary.acquires.setdefault(
+            lk, ((self.mod.relpath, line), chain))
+
+    def _blocked(self, desc, line, held, chain):
+        if held:
+            self.model.blocking.append(BlockingEvent(
+                held=held, desc=desc, relpath=self.mod.relpath, line=line,
+                qualname=self.qualname, chain=chain))
+        else:
+            self.summary.blocking.append(
+                (desc, (self.mod.relpath, line), chain))
+
+    def _condition_receiver(self, node):
+        if not isinstance(node.func, ast.Attribute):
+            return None
+        lk = self.model.lock_key_of(node.func.value, self.mod, self.cls)
+        if lk is not None and self.model.locks[lk].kind == "Condition":
+            return lk
+        return None
+
+    def _classify_blocking(self, node):
+        func = node.func
+        kwargs = {kw.arg for kw in node.keywords}
+        if isinstance(func, ast.Name):
+            if func.id == "open":
+                return _BLOCKING_NAME_CALLS["open"]
+            if func.id in ("sleep", "fsync") and self._is_imported_from(
+                    func.id, ("time", "os")):
+                return _BLOCKING_NAME_CALLS[func.id]
+            if func.id == "device_put":
+                return _BLOCKING_ATTR_CALLS["device_put"]
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv = func.value
+        recv_mod = recv.id if isinstance(recv, ast.Name) else None
+        if attr in _SUBPROCESS_CALLS and recv_mod == "subprocess":
+            return f"subprocess.{attr}() blocks on a child process"
+        if attr in _BLOCKING_ATTR_CALLS:
+            if attr in ("sleep", "fsync"):
+                return _BLOCKING_ATTR_CALLS[attr] \
+                    if recv_mod in ("time", "os") else None
+            return _BLOCKING_ATTR_CALLS[attr]
+        rtype = self.model.index.receiver_type(recv, self.mod, self.cls)
+        if attr == "get" and rtype == "queue.Queue":
+            if "timeout" in kwargs or len(node.args) >= 2 or \
+                    self._block_false(node):
+                return None
+            return "queue.Queue.get() without timeout parks the holder"
+        if attr == "join":
+            if rtype == "threading.Thread":
+                return "Thread.join() blocks until the worker exits"
+            return None
+        if attr == "result" and not isinstance(recv, ast.Constant):
+            # a bounded result(timeout) still parks the holder for up to
+            # the timeout — flagged the same
+            return "Future.result() parks the holder on another thread"
+        return None
+
+    @staticmethod
+    def _block_false(node):
+        for kw in node.keywords:
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return True
+        if node.args and isinstance(node.args[0], ast.Constant) and \
+                node.args[0].value is False:
+            return True
+        return False
+
+    def _is_imported_from(self, name, modules):
+        imp = self.mod.imports.get(name)
+        return bool(imp and imp[0] == "symbol" and imp[1] in modules)
+
+
+# --------------------------------------------------------------------------
+# graph utilities (shared by the order rule and the witness crosscheck)
+# --------------------------------------------------------------------------
+def find_cycles(edge_pairs):
+    """Elementary cycles in the digraph given as (src, dst) pairs,
+    canonicalized (rotated to start at the smallest key) and deduped.
+    Self-loops come out as 1-cycles."""
+    graph = {}
+    for s, d in edge_pairs:
+        graph.setdefault(s, set()).add(d)
+    cycles = set()
+
+    def dfs(start, node, path, on_path):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                i = path.index(min(path))
+                cycles.add(tuple(path[i:] + path[:i]))
+            elif nxt not in on_path and nxt > start:
+                # only explore nodes > start: each cycle is found exactly
+                # once, rooted at its smallest node
+                dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return sorted(cycles)
+
+
+def crosscheck(model, observed_edges, observed_names=None):
+    """Compare a witness run's observed acquisition edges against the
+    static model.  ``observed_edges`` is an iterable of (src, dst) lock
+    names as the witness emits them — either ``"relpath:line"`` creation
+    sites (mapped through the model's site index) or already-static
+    keys/explicit ``named_lock`` names.
+
+    Returns (problems, unmodeled): ``problems`` is a list of strings —
+    a cycle in the merged static+observed graph, or an observed edge
+    into a NON-leaf lock the static pass missed (under-approximation).
+    Edges into leaf locks (no outgoing edges anywhere) are tolerated:
+    statically-unresolvable receivers like telemetry child locks can
+    never invert an order through a lock that nests nothing."""
+    def map_name(name):
+        if name in model.locks:
+            return name
+        relpath, _, line = name.rpartition(":")
+        if line.isdigit() and (relpath, int(line)) in model.site_index:
+            return model.site_index[(relpath, int(line))]
+        return name
+
+    observed = [(map_name(s), map_name(d)) for s, d in observed_edges]
+    static_pairs = {(e.src, e.dst) for e in model.edges}
+    merged = static_pairs | set(observed)
+    problems = []
+    for cyc in find_cycles(merged):
+        problems.append("cycle in merged static+observed graph: " +
+                        " -> ".join(cyc + (cyc[0],)))
+    out_degree = {}
+    for s, d in merged:
+        out_degree.setdefault(s, 0)
+        out_degree[s] += 1
+    unmodeled = sorted({(s, d) for s, d in observed
+                        if (s, d) not in static_pairs})
+    for s, d in unmodeled:
+        if out_degree.get(d, 0) > 0:
+            problems.append(
+                f"observed edge {s} -> {d} missing from the static model "
+                f"and {d} is not a leaf lock — the analyzer is "
+                f"under-approximating")
+    return problems, unmodeled
+
+
+def build(paths=None, repo_root=None):
+    """Parse the scan roots and build the model.  Returns
+    (model, ctx_by_path, n_files, parse_findings)."""
+    root = repo_root or core.REPO_ROOT
+    if paths is None:
+        paths = [core.REPO_ROOT + "/mxnet_tpu"]
+    ctxs = []
+    parse_findings = []
+    n_files = 0
+    import os
+    for abspath in core.iter_py_files(paths, repo_root=root):
+        n_files += 1
+        try:
+            ctxs.append(core.load_file(abspath, repo_root=root,
+                                       tool="lockscan"))
+        except SyntaxError as e:
+            parse_findings.append(core.Finding(
+                rule="parse-error",
+                path=os.path.relpath(abspath, root).replace(os.sep, "/"),
+                line=e.lineno or 1, col=e.offset or 0,
+                message=f"file does not parse: {e.msg}"))
+        except UnicodeDecodeError:
+            continue
+    model = LockModel(ctxs)
+    return model, {c.relpath: c for c in ctxs}, n_files, parse_findings

@@ -1,0 +1,35 @@
+"""lockscan rule registry.
+
+Unlike mxlint's per-file rules, every lockscan rule reads the finished
+interprocedural :class:`~tools.lockscan.model.LockModel`: a rule is a
+class with a unique ``name`` (the waiver token), a one-line
+``description``, and a ``check(model)`` hook yielding
+:class:`~tools.mxlint.core.Finding`.  Waivers use the mxlint grammar
+with the ``lockscan`` tag::
+
+    with self._lock:  # lockscan: disable=blocking-under-lock -- build-once barrier
+"""
+from __future__ import annotations
+
+
+class Rule:
+    name = ""
+    description = ""
+
+    def check(self, model):
+        return []
+
+
+def all_rules():
+    """Fresh instances of every shipped rule."""
+    from .blocking import BlockingUnderLock
+    from .condition import ConditionWaitNoPredicate, NotifyOutsideLock
+    from .order import LockOrderCycle
+    from .signal_safe import SignalUnsafe
+    return [
+        LockOrderCycle(),
+        BlockingUnderLock(),
+        ConditionWaitNoPredicate(),
+        NotifyOutsideLock(),
+        SignalUnsafe(),
+    ]

@@ -1,0 +1,34 @@
+"""blocking-under-lock: a blocking operation runs while a lock is held.
+
+Anything parked under a lock parks every other thread that wants the
+lock too — ``Future.result``/``Thread.join`` turn into deadlocks the
+moment the worker being waited on needs the held lock, unbounded
+``queue.get`` and device syncs turn tail latency into lock hold time,
+and file I/O under a hot-path lock is a p99 cliff.  Interprocedural:
+the blocking call may be several resolved calls below the ``with``.
+"""
+from __future__ import annotations
+
+from tools.mxlint.core import Finding
+
+from . import Rule
+
+
+class BlockingUnderLock(Rule):
+    name = "blocking-under-lock"
+    description = ("blocking call (result/join/get-no-timeout/device "
+                   "sync/file I/O/subprocess) while a lock is held")
+
+    def check(self, model):
+        seen = set()
+        for ev in model.blocking:
+            key = (ev.relpath, ev.line, ev.desc, ev.chain)
+            if key in seen:
+                continue
+            seen.add(key)
+            held = ", ".join(ev.held)
+            via = f" via {ev.chain}" if ev.chain else ""
+            yield Finding(
+                rule=self.name, path=ev.relpath, line=ev.line, col=0,
+                qualname=ev.qualname,
+                message=f"{ev.desc} while holding {held}{via}")

@@ -1,0 +1,48 @@
+"""Condition-variable discipline: predicate loops and owned notifies.
+
+``Condition.wait`` returns on spurious wakeups and on notifies meant
+for other waiters, so a wait outside a re-check loop acts on a state
+that may not hold — ``wait_for`` (which loops internally) or a
+``while``-enclosed ``wait`` are the only safe shapes.  ``notify``
+without the condition's lock held races the waiter's predicate check
+and raises RuntimeError at runtime.
+"""
+from __future__ import annotations
+
+from tools.mxlint.core import Finding
+
+from . import Rule
+
+
+class ConditionWaitNoPredicate(Rule):
+    name = "condition-wait-no-predicate"
+    description = ("Condition.wait() outside a predicate re-check loop "
+                   "(spurious wakeups; use wait_for or while-wrap)")
+
+    def check(self, model):
+        for ev in model.waits:
+            if ev.wait_for or ev.in_loop:
+                continue
+            yield Finding(
+                rule=self.name, path=ev.relpath, line=ev.line, col=0,
+                qualname=ev.qualname,
+                message=f"{ev.cond}.wait() has no enclosing predicate "
+                        f"loop — a spurious wakeup proceeds on a stale "
+                        f"state; use wait_for(pred, timeout)")
+
+
+class NotifyOutsideLock(Rule):
+    name = "notify-outside-lock"
+    description = ("Condition.notify()/notify_all() without the owning "
+                   "lock lexically held")
+
+    def check(self, model):
+        for ev in model.notifies:
+            if ev.held:
+                continue
+            yield Finding(
+                rule=self.name, path=ev.relpath, line=ev.line, col=0,
+                qualname=ev.qualname,
+                message=f"{ev.cond}.notify() outside `with {ev.cond.split(':')[-1]}:` "
+                        f"— races the waiter's predicate check and raises "
+                        f"RuntimeError('cannot notify on un-acquired lock')")

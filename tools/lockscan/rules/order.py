@@ -1,0 +1,45 @@
+"""lock-order-cycle: a cycle in the acquisition-order graph.
+
+Two threads walking the same cycle from different entry edges deadlock;
+a self-edge on a non-reentrant ``threading.Lock`` deadlocks a single
+thread on its own.  The finding is anchored at the evidence site of the
+cycle's first edge (smallest lock key first, so the anchor is stable),
+and the message spells out every edge with its site and call chain.
+"""
+from __future__ import annotations
+
+from tools.mxlint.core import Finding
+
+from . import Rule
+from ..model import find_cycles
+
+
+class LockOrderCycle(Rule):
+    name = "lock-order-cycle"
+    description = ("cycle in the lock acquisition-order graph "
+                   "(potential deadlock; self-edge on a plain Lock "
+                   "is a single-thread deadlock)")
+
+    def check(self, model):
+        evidence = {}
+        for e in model.edges:
+            evidence.setdefault((e.src, e.dst), e)
+        for cyc in find_cycles(evidence):
+            hops = list(zip(cyc, cyc[1:] + cyc[:1]))
+            sites = []
+            for src, dst in hops:
+                e = evidence[(src, dst)]
+                via = f" via {e.chain}" if e.chain else ""
+                sites.append(f"{src} -> {dst} at {e.relpath}:{e.line}"
+                             f" ({e.qualname}){via}")
+            anchor = evidence[hops[0]]
+            if len(cyc) == 1:
+                msg = (f"non-reentrant Lock {cyc[0]} re-acquired while "
+                       f"already held: {sites[0]}")
+            else:
+                msg = ("lock-order cycle " +
+                       " -> ".join(cyc + (cyc[0],)) + ": " +
+                       "; ".join(sites))
+            yield Finding(rule=self.name, path=anchor.relpath,
+                          line=anchor.line, col=0, message=msg,
+                          qualname=anchor.qualname)

@@ -1,0 +1,34 @@
+"""signal-unsafe: locks or blocking work reachable from a signal handler.
+
+A signal handler runs *on top of* whatever bytecode the main thread was
+executing — if that thread holds the lock the handler wants, the
+handler deadlocks the process at the exact moment (SIGTERM on
+preemption) it most needs to make progress.  The safe shape is the
+classic self-pipe: the handler only sets a flag or ``os.write``s a
+pre-opened fd, and a normal thread does the real work.
+"""
+from __future__ import annotations
+
+from tools.mxlint.core import Finding
+
+from . import Rule
+
+
+class SignalUnsafe(Rule):
+    name = "signal-unsafe"
+    description = ("signal handler reaches a lock acquisition or "
+                   "blocking call (handler may interrupt the holder)")
+
+    def check(self, model):
+        seen = set()
+        for ev in model.signals:
+            key = (ev.relpath, ev.line, ev.handler, ev.desc)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield Finding(
+                rule=self.name, path=ev.relpath, line=ev.line, col=0,
+                qualname=ev.qualname,
+                message=f"handler {ev.handler} {ev.desc} — a handler "
+                        f"interrupting the holder deadlocks; only set a "
+                        f"flag or os.write a pre-opened fd")

@@ -36,10 +36,19 @@ DEFAULT_ROOTS = ("mxnet_tpu", "tools", "benchmark")
 
 _SKIP_DIRS = {"__pycache__", ".git", "results"}
 
-_WAIVER_RE = re.compile(
-    r"#\s*mxlint:\s*(disable|disable-file)="
-    r"(?P<rules>[A-Za-z0-9_,-]+)"
-    r"(?:\s*--\s*(?P<reason>\S.*?))?\s*$")
+
+def _waiver_re(tool):
+    """Waiver-comment regex for ``tool`` — mxlint and lockscan share the
+    grammar (`# <tool>: disable=<rules> -- <reason>`), each matching only
+    its own tag so the two checkers' waivers never shadow each other."""
+    return re.compile(
+        r"#\s*" + re.escape(tool) + r":\s*(disable|disable-file)="
+        r"(?P<rules>[A-Za-z0-9_,-]+)"
+        r"(?:\s*--\s*(?P<reason>\S.*?))?\s*$")
+
+
+_WAIVER_RE = _waiver_re("mxlint")
+_WAIVER_RES = {"mxlint": _WAIVER_RE}
 
 
 @dataclass
@@ -156,7 +165,7 @@ def _build_scopes(tree):
     return scopes
 
 
-def _parse_waivers(source):
+def _parse_waivers(source, tool="mxlint"):
     waivers = []
     try:
         import io
@@ -167,8 +176,11 @@ def _parse_waivers(source):
         comments = [(i + 1, line[line.index("#"):])
                     for i, line in enumerate(source.splitlines())
                     if "#" in line]
+    if tool not in _WAIVER_RES:
+        _WAIVER_RES[tool] = _waiver_re(tool)
+    pattern = _WAIVER_RES[tool]
     for line, text in comments:
-        m = _WAIVER_RE.search(text)
+        m = pattern.search(text)
         if not m:
             continue
         rules = tuple(r.strip() for r in m.group("rules").split(",")
@@ -179,8 +191,9 @@ def _parse_waivers(source):
     return waivers
 
 
-def load_file(abspath, repo_root=None):
-    """Parse one file into a :class:`FileContext` (None on read error)."""
+def load_file(abspath, repo_root=None, tool="mxlint"):
+    """Parse one file into a :class:`FileContext` (None on read error).
+    ``tool`` selects which checker's waiver comments are honored."""
     root = repo_root or REPO_ROOT
     with open(abspath, "r", encoding="utf-8") as f:
         source = f.read()
@@ -188,7 +201,7 @@ def load_file(abspath, repo_root=None):
     tree = ast.parse(source, filename=relpath)
     ctx = FileContext(abspath=abspath, relpath=relpath, source=source,
                       lines=source.splitlines(), tree=tree)
-    ctx.waivers = _parse_waivers(source)
+    ctx.waivers = _parse_waivers(source, tool=tool)
     ctx._scopes = _build_scopes(tree)
     for node in ast.walk(tree):
         if isinstance(node, ast.stmt):
@@ -217,7 +230,7 @@ def assign_ids(findings, ctx_by_path):
     return findings
 
 
-def apply_waivers(findings, ctx):
+def apply_waivers(findings, ctx, tool="mxlint"):
     """Mark findings covered by a (reasoned) waiver; emit ``bad-waiver``
     findings for waivers missing the required reason."""
     out = []
@@ -255,7 +268,7 @@ def apply_waivers(findings, ctx):
         if not w.reason:
             out.append(Finding(
                 rule="bad-waiver", path=ctx.relpath, line=w.line, col=0,
-                message="mxlint waiver without a reason — append "
+                message=f"{tool} waiver without a reason — append "
                         "`-- <why this is safe>` (unreasoned waivers are "
                         "worse than findings: they hide intent)",
                 qualname=ctx.qualname_at(w.line)))
@@ -409,3 +422,323 @@ def enclosing_function_lines(tree):
                     if ln is not None:
                         lines.add(ln)
     return lines
+
+
+# --------------------------------------------------------------------------
+# project-wide call resolution (shared by mxlint rules and tools/lockscan)
+# --------------------------------------------------------------------------
+#: Constructor calls whose result type is worth tracking even though the
+#: class is not defined in this project (queue ops have their own
+#: blocking semantics; threading primitives are lock objects).
+_BUILTIN_TYPES = {
+    ("queue", "Queue"): "queue.Queue",
+    ("queue", "SimpleQueue"): "queue.Queue",
+    ("queue", "LifoQueue"): "queue.Queue",
+    ("queue", "PriorityQueue"): "queue.Queue",
+    ("threading", "Lock"): "threading.Lock",
+    ("threading", "RLock"): "threading.RLock",
+    ("threading", "Condition"): "threading.Condition",
+    ("threading", "Event"): "threading.Event",
+    ("threading", "Thread"): "threading.Thread",
+}
+
+
+class ClassEntry:
+    """One project class: its methods, resolved attribute types, bases."""
+
+    __slots__ = ("relpath", "name", "node", "methods", "attr_types",
+                 "base_keys")
+
+    def __init__(self, relpath, name, node):
+        self.relpath = relpath
+        self.name = name
+        self.node = node
+        self.methods = {m.name: m for m in node.body
+                        if isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        self.attr_types = {}    # "attr" -> class key or builtin type tag
+        self.base_keys = []     # resolved project base-class keys
+
+    @property
+    def key(self):
+        return f"{self.relpath}:{self.name}"
+
+
+class ModuleEntry:
+    """One project module: classes, module functions, imports, globals."""
+
+    __slots__ = ("relpath", "dotted", "tree", "classes", "functions",
+                 "imports", "var_types")
+
+    def __init__(self, relpath, dotted, tree):
+        self.relpath = relpath
+        self.dotted = dotted
+        self.tree = tree
+        self.classes = {}       # local name -> ClassEntry
+        self.functions = {}     # local name -> FunctionDef (module level)
+        self.imports = {}       # local name -> ("module", dotted) or
+        #                          ("symbol", dotted_module, original_name)
+        self.var_types = {}     # module-level var -> class key / type tag
+
+
+def _dotted_name(relpath):
+    parts = relpath[:-3].split("/")      # strip ".py"
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class ProjectIndex:
+    """Whole-project symbol index + best-effort static call resolution.
+
+    Resolution is deliberately conservative: ``self.method()``,
+    ``self.attr.method()`` (attribute types inferred from constructor
+    assignments), module functions, imported symbols, and module-alias
+    attribute calls resolve; anything dynamic (dict lookups, callables
+    passed as values, inheritance across unknown bases) resolves to
+    nothing rather than to a guess.
+    """
+
+    def __init__(self, ctxs):
+        self.modules = {}            # relpath -> ModuleEntry
+        self.by_dotted = {}          # dotted -> ModuleEntry
+        self.classes = {}            # class key -> ClassEntry
+        self._class_name_index = {}  # bare name -> [class keys]
+        self._owner = {}             # id(funcnode) -> (ModuleEntry, ClassEntry|None)
+        for ctx in ctxs:
+            self._add_module(ctx)
+        for mod in self.modules.values():
+            self._resolve_imports(mod)
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                self._infer_class(mod, cls)
+            self._infer_module_vars(mod)
+
+    # -- construction ------------------------------------------------------
+    def _add_module(self, ctx):
+        mod = ModuleEntry(ctx.relpath, _dotted_name(ctx.relpath), ctx.tree)
+        self.modules[ctx.relpath] = mod
+        self.by_dotted[mod.dotted] = mod
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                entry = ClassEntry(ctx.relpath, node.name, node)
+                mod.classes[node.name] = entry
+                self.classes[entry.key] = entry
+                self._class_name_index.setdefault(node.name, []).append(
+                    entry.key)
+                for m in entry.methods.values():
+                    self._owner[id(m)] = (mod, entry)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod.functions[node.name] = node
+                self._owner[id(node)] = (mod, None)
+
+    def _resolve_imports(self, mod):
+        pkg_parts = mod.dotted.split(".")
+        if not mod.relpath.endswith("/__init__.py") and \
+                mod.relpath != "__init__.py":
+            pkg_parts = pkg_parts[:-1]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else \
+                        alias.name.split(".")[0]
+                    mod.imports[local] = ("module", target)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                    src = ".".join(base + ((node.module or "").split(".")
+                                           if node.module else []))
+                else:
+                    src = node.module or ""
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if f"{src}.{alias.name}" in self.by_dotted:
+                        mod.imports[local] = ("module",
+                                              f"{src}.{alias.name}")
+                    else:
+                        mod.imports[local] = ("symbol", src, alias.name)
+
+    def _type_of_ctor(self, mod, func):
+        """The type key constructed by calling ``func`` (a Call's .func),
+        or None when it is not a recognizable constructor."""
+        if isinstance(func, ast.Name):
+            if func.id in mod.classes:
+                return mod.classes[func.id].key
+            imp = mod.imports.get(func.id)
+            if imp and imp[0] == "symbol":
+                target = self.by_dotted.get(imp[1])
+                if target and imp[2] in target.classes:
+                    return target.classes[imp[2]].key
+                if (imp[1], imp[2]) in _BUILTIN_TYPES:
+                    return _BUILTIN_TYPES[(imp[1], imp[2])]
+        elif isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            owner = func.value.id
+            imp = mod.imports.get(owner)
+            dotted = imp[1] if imp and imp[0] == "module" else owner
+            target = self.by_dotted.get(dotted)
+            if target and func.attr in target.classes:
+                return target.classes[func.attr].key
+            if (dotted, func.attr) in _BUILTIN_TYPES:
+                return _BUILTIN_TYPES[(dotted, func.attr)]
+        return None
+
+    def _infer_class(self, mod, cls):
+        for base in cls.node.bases:
+            key = None
+            if isinstance(base, ast.Name):
+                if base.id in mod.classes:
+                    key = mod.classes[base.id].key
+                else:
+                    imp = mod.imports.get(base.id)
+                    if imp and imp[0] == "symbol":
+                        target = self.by_dotted.get(imp[1])
+                        if target and imp[2] in target.classes:
+                            key = target.classes[imp[2]].key
+            elif isinstance(base, ast.Attribute):
+                key = self._type_of_ctor(
+                    mod, base) if False else None  # attribute bases: rare
+            if key:
+                cls.base_keys.append(key)
+        for m in cls.methods.values():
+            for node in ast.walk(m):
+                if not (isinstance(node, ast.Assign) and len(node.targets)
+                        == 1):
+                    continue
+                t = node.targets[0]
+                if not (isinstance(t, ast.Attribute) and
+                        isinstance(t.value, ast.Name) and
+                        t.value.id == "self"):
+                    continue
+                if isinstance(node.value, ast.Call):
+                    key = self._type_of_ctor(mod, node.value.func)
+                    if key and t.attr not in cls.attr_types:
+                        cls.attr_types[t.attr] = key
+
+    def _infer_module_vars(self, mod):
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    isinstance(node.value, ast.Call):
+                key = self._type_of_ctor(mod, node.value.func)
+                if key:
+                    mod.var_types[node.targets[0].id] = key
+
+    # -- lookup ------------------------------------------------------------
+    def owner_of(self, funcnode):
+        """(ModuleEntry, ClassEntry-or-None) that defines ``funcnode``."""
+        return self._owner.get(id(funcnode), (None, None))
+
+    def class_by_key(self, key):
+        return self.classes.get(key)
+
+    def method_of(self, class_key, name, _seen=None):
+        """Resolve ``name`` on ``class_key``, walking project bases."""
+        _seen = _seen or set()
+        if class_key in _seen:
+            return None, None
+        _seen.add(class_key)
+        cls = self.classes.get(class_key)
+        if cls is None:
+            return None, None
+        if name in cls.methods:
+            return cls, cls.methods[name]
+        for base in cls.base_keys:
+            owner, fn = self.method_of(base, name, _seen)
+            if fn is not None:
+                return owner, fn
+        return None, None
+
+    def attr_type(self, class_key, attr, _seen=None):
+        """Type key of ``self.<attr>`` on ``class_key`` (bases walked)."""
+        _seen = _seen or set()
+        if class_key in _seen:
+            return None
+        _seen.add(class_key)
+        cls = self.classes.get(class_key)
+        if cls is None:
+            return None
+        if attr in cls.attr_types:
+            return cls.attr_types[attr]
+        for base in cls.base_keys:
+            t = self.attr_type(base, attr, _seen)
+            if t is not None:
+                return t
+        return None
+
+    def resolve_call(self, call, mod, cls):
+        """Targets of ``call`` made from (``mod``, ``cls`` or None):
+        a list of (ModuleEntry, ClassEntry-or-None, FunctionDef).
+        Empty when the target is dynamic or outside the project."""
+        func = call.func
+        out = []
+        if isinstance(func, ast.Name):
+            if func.id in mod.functions:
+                out.append((mod, None, mod.functions[func.id]))
+            elif func.id in mod.classes:
+                e = mod.classes[func.id]
+                owner, init = self.method_of(e.key, "__init__")
+                if init is not None:
+                    out.append((self.modules[owner.relpath], owner, init))
+            else:
+                imp = mod.imports.get(func.id)
+                if imp and imp[0] == "symbol":
+                    target = self.by_dotted.get(imp[1])
+                    if target:
+                        if imp[2] in target.functions:
+                            out.append((target, None,
+                                        target.functions[imp[2]]))
+                        elif imp[2] in target.classes:
+                            e = target.classes[imp[2]]
+                            owner, init = self.method_of(e.key, "__init__")
+                            if init is not None:
+                                out.append((self.modules[owner.relpath],
+                                            owner, init))
+        elif isinstance(func, ast.Attribute):
+            recv = func.value
+            if isinstance(recv, ast.Name) and recv.id == "self" and cls:
+                owner, fn = self.method_of(cls.key, func.attr)
+                if fn is not None:
+                    out.append((self.modules[owner.relpath], owner, fn))
+            elif isinstance(recv, ast.Name):
+                imp = mod.imports.get(recv.id)
+                if imp and imp[0] == "module":
+                    target = self.by_dotted.get(imp[1])
+                    if target:
+                        if func.attr in target.functions:
+                            out.append((target, None,
+                                        target.functions[func.attr]))
+                        elif func.attr in target.classes:
+                            e = target.classes[func.attr]
+                            owner, init = self.method_of(e.key, "__init__")
+                            if init is not None:
+                                out.append((self.modules[owner.relpath],
+                                            owner, init))
+                else:
+                    tkey = mod.var_types.get(recv.id)
+                    if tkey:
+                        owner, fn = self.method_of(tkey, func.attr)
+                        if fn is not None:
+                            out.append((self.modules[owner.relpath],
+                                        owner, fn))
+            elif isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self" and cls:
+                tkey = self.attr_type(cls.key, recv.attr)
+                if tkey:
+                    owner, fn = self.method_of(tkey, func.attr)
+                    if fn is not None:
+                        out.append((self.modules[owner.relpath], owner, fn))
+        return out
+
+    def receiver_type(self, expr, mod, cls):
+        """Best-effort type key of an expression used as a receiver:
+        ``self.attr`` / module-level var / bare name."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and cls:
+            return self.attr_type(cls.key, expr.attr)
+        if isinstance(expr, ast.Name):
+            return mod.var_types.get(expr.id)
+        return None

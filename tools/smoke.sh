@@ -29,6 +29,13 @@ fi
 rm -f "$collect_log"
 echo "smoke: collect-only 0 errors"
 
+# 0b. quick concurrency-contract gate (ISSUE 20): the interprocedural
+# lock-order / blocking-under-lock scan is pure-AST (no package import)
+# and must stay clean against the EMPTY committed baseline — a new lock
+# ordering or a blocking call slipped under a lock can never land
+python -m tools.lockscan --verdicts --no-metrics
+echo "smoke: lockscan concurrency contracts ok"
+
 python - <<'EOF'
 import mxnet_tpu as mx
 import numpy as onp
@@ -364,7 +371,7 @@ EOF
 # dryrun stage, not here — 3d/3e above cover the quick checks)
 MXTPU_DRYRUN_HLOSCAN=0 MXTPU_DRYRUN_CENSUS=0 MXTPU_DRYRUN_RESILIENCE=0 \
   MXTPU_DRYRUN_FLEET=0 MXTPU_DRYRUN_GRAY=0 MXTPU_DRYRUN_RECIPE=0 \
-  MXTPU_DRYRUN_AUTOTUNE=0 \
+  MXTPU_DRYRUN_AUTOTUNE=0 MXTPU_DRYRUN_LOCKSCAN=0 \
   python -c "
 import __graft_entry__ as g
 g.dryrun_multichip(8)
